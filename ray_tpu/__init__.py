@@ -1,0 +1,270 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Ray-class capabilities (tasks, actors, objects, placement groups, Train /
+Data / Tune / RLlib libraries) designed TPU-first: collectives run inside
+jitted XLA programs over ICI, the scheduler understands TPU slice
+topology, and the AI libraries are JAX-native.
+
+Public API parity target: reference python/ray/__init__.py
+(init/remote/get/put/wait/kill/get_actor/...).
+
+The core never imports jax — device work only happens in library code
+(ray_tpu.train, ray_tpu.models, ...) inside worker processes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import get_global_worker, global_worker_maybe
+from ray_tpu.actor import ActorClass, ActorHandle, method
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "method",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "ObjectRef",
+    "ActorHandle",
+    "exceptions",
+    "__version__",
+]
+
+_init_lock = threading.RLock()
+_node_processes = None  # NodeProcesses if this driver started the cluster
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[dict] = None,
+    object_store_memory: Optional[int] = None,
+    namespace: Optional[str] = None,
+    ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
+    runtime_env: Optional[dict] = None,
+    _system_config: Optional[dict] = None,
+    **kwargs,
+):
+    """Start a new cluster (or connect to an existing one) and connect this
+    process as a driver (reference: python/ray/_private/worker.py:1270)."""
+    global _node_processes
+    from ray_tpu._private import node as node_mod
+
+    with _init_lock:
+        worker = get_global_worker()
+        if worker.connected:
+            if ignore_reinit_error:
+                return RayContext(worker)
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True to ignore.")
+        CONFIG.initialize(_system_config)
+        if object_store_memory is not None:
+            CONFIG._overrides["object_store_memory_cap"] = int(object_store_memory)
+
+        if address is None and os.environ.get("RAY_TPU_ADDRESS"):
+            address = os.environ["RAY_TPU_ADDRESS"]
+        if address == "auto":
+            try:
+                with open(node_mod.CLUSTER_ADDRESS_FILE) as f:
+                    address = f.read().strip()
+            except OSError:
+                raise ConnectionError(
+                    "address='auto' but no running cluster found. Start one with "
+                    "`ray_tpu start --head` or call init() with no address."
+                )
+
+        if address is None:
+            procs = node_mod.start_head(
+                num_cpus=num_cpus, num_tpus=num_tpus, resources=resources
+            )
+            _node_processes = procs
+            gcs_address = procs.gcs_address
+            raylet_address = procs.raylet_address
+        else:
+            gcs_address = address
+            raylet_address = node_mod.head_raylet_address(gcs_address)
+
+        worker.connect_driver(
+            gcs_address,
+            raylet_address,
+            namespace,
+            {"namespace": namespace or "", "runtime_env": runtime_env or {}},
+        )
+        return RayContext(worker)
+
+
+class RayContext:
+    def __init__(self, worker):
+        self._worker = worker
+        self.address_info = {
+            "gcs_address": worker.gcs_client.address if worker.gcs_client else None,
+            "raylet_address": worker.raylet_client.address if worker.raylet_client else None,
+            "node_id": worker.node_id.hex() if worker.node_id else None,
+            "session_dir": worker.session_info.get("session_dir"),
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+    def __getitem__(self, key):
+        return self.address_info[key]
+
+
+def shutdown():
+    global _node_processes
+    with _init_lock:
+        worker = global_worker_maybe()
+        if worker is not None and worker.connected:
+            worker.disconnect()
+        if _node_processes is not None:
+            _node_processes.terminate()
+            _node_processes = None
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    w = global_worker_maybe()
+    return w is not None and w.connected
+
+
+def remote(*args, **kwargs):
+    """@ray_tpu.remote decorator for functions and classes
+    (reference: python/ray/_private/worker.py:3330)."""
+
+    def make(target):
+        import inspect
+
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs or None)
+        return RemoteFunction(target, kwargs or None)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword arguments only, e.g. @remote(num_cpus=2)")
+    return make
+
+
+def put(value: Any) -> ObjectRef:
+    return get_global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    worker = get_global_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"ray_tpu.get takes an ObjectRef or a list of them, got {type(refs)}")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"ray_tpu.get list must contain only ObjectRefs, got {type(r)}")
+    return worker.get(list(refs), timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("ray_tpu.wait takes a list of ObjectRefs")
+    if num_returns <= 0 or num_returns > len(refs):
+        raise ValueError(f"num_returns must be in [1, {len(refs)}]")
+    return get_global_worker().wait(list(refs), num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("ray_tpu.kill() only works on actor handles; use cancel() for tasks")
+    get_global_worker().kill_actor(actor._id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    raise NotImplementedError("task cancellation lands with the task-manager milestone")
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    from ray_tpu.actor import get_actor_handle_from_spec
+
+    worker = get_global_worker()
+    reply = worker.get_named_actor(name, namespace)
+    return get_actor_handle_from_spec(ActorID(reply["actor_id"]), reply["spec"])
+
+
+def nodes() -> List[dict]:
+    worker = get_global_worker()
+    info = worker.gcs_client.call("get_cluster_info")
+    out = []
+    for n in info["nodes"].values():
+        out.append(
+            {
+                "NodeID": NodeID(n["node_id"]).hex(),
+                "Alive": n["state"] == "ALIVE",
+                "Resources": n["resources_total"],
+                "RayletAddress": n["raylet_address"],
+                "IsHead": n.get("is_head", False),
+                "Hostname": n.get("hostname", ""),
+                "Labels": n.get("labels", {}),
+            }
+        )
+    return out
+
+
+def cluster_resources() -> dict:
+    return get_global_worker().gcs_client.call("cluster_resources")
+
+
+def available_resources() -> dict:
+    return get_global_worker().gcs_client.call("available_resources")
+
+
+def timeline(filename: Optional[str] = None):
+    from ray_tpu.util.state import timeline as _timeline
+
+    return _timeline(filename)
+
+
+# Lazy submodules: heavy libraries (jax imports) load on first access.
+_LAZY_SUBMODULES = ("util", "train", "data", "tune", "rllib", "serve", "workflow", "dag",
+                    "models", "ops", "parallel", "autoscaler", "air", "experimental")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"ray_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute '{name}'")

@@ -1,0 +1,177 @@
+"""Common runtime structures: task specs, resource sets, scheduling strategies.
+
+Equivalents of the reference's task spec builder and resource model
+(reference: src/ray/common/task/, src/ray/common/scheduling/resource_set.h,
+cluster_resource_data.h).  Resources are arbitrary named floats — CPU, TPU,
+memory, object_store_memory are predefined; custom names (e.g.
+"TPU-v5e-8-head", "node:10.0.0.1") flow through unchanged, which is how
+slice-topology-aware placement works.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+
+# Predefined resource names.
+CPU = "CPU"
+TPU = "TPU"
+GPU = "GPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+RESOURCE_EPSILON = 1e-9
+
+
+class ResourceSet(dict):
+    """Named float resources with fixed-point-ish comparisons (reference:
+    src/ray/common/scheduling/fixed_point.h — we quantize to 1e-4)."""
+
+    QUANTUM = 1e-4
+
+    @classmethod
+    def of(cls, d: Optional[Dict[str, float]]) -> "ResourceSet":
+        rs = cls()
+        if d:
+            for k, v in d.items():
+                if v is None:
+                    continue
+                v = round(float(v) / cls.QUANTUM) * cls.QUANTUM
+                if v < 0:
+                    raise ValueError(f"negative resource {k}={v}")
+                if v > 0:
+                    rs[k] = v
+        return rs
+
+    def fits_in(self, avail: "ResourceSet") -> bool:
+        for k, v in self.items():
+            if avail.get(k, 0.0) + RESOURCE_EPSILON < v:
+                return False
+        return True
+
+    def subtract(self, other: "ResourceSet"):
+        for k, v in other.items():
+            self[k] = self.get(k, 0.0) - v
+            if abs(self[k]) < RESOURCE_EPSILON:
+                self[k] = 0.0
+
+    def add(self, other: "ResourceSet"):
+        for k, v in other.items():
+            self[k] = self.get(k, 0.0) + v
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet(self)
+
+
+@dataclass
+class SchedulingStrategy:
+    """DEFAULT (hybrid), SPREAD, node-affinity, or placement group."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    """Everything a raylet/worker needs to schedule and run one task.
+
+    Mirrors the information content of the reference TaskSpec proto
+    (reference: src/ray/protobuf/common.proto TaskSpec) in plain Python.
+    """
+
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    # Function lives in the GCS function table under this key.
+    function_key: bytes
+    # Args: list of ("v", bytes) inline values or ("ref", ObjectID).
+    args: List[Tuple[str, Any]]
+    num_returns: int
+    resources: ResourceSet
+    # Actor fields
+    is_actor_creation: bool = False
+    is_actor_task: bool = False
+    actor_id: Optional[ActorID] = None
+    # Ordering for actor tasks (per caller,handle)
+    sequence_number: int = 0
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    # Actor options
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+    runtime_env: Optional[dict] = None
+    # Owner (for refcounting / error routing)
+    owner_worker_id: Optional[WorkerID] = None
+    owner_address: Optional[str] = None
+    method_name: Optional[str] = None
+    # Attempt counter (filled by raylet on retries)
+    attempt_number: int = 0
+    detached: bool = False
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    raylet_address: str
+    object_store_dir: str
+    resources_total: ResourceSet
+    labels: Dict[str, str] = field(default_factory=dict)
+    state: str = "ALIVE"  # ALIVE | DEAD
+    start_time: float = field(default_factory=time.time)
+    is_head: bool = False
+    hostname: str = ""
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    class_name: str
+    state: str = "PENDING_CREATION"  # DEPENDENCIES_UNREADY|PENDING_CREATION|ALIVE|RESTARTING|DEAD
+    node_id: Optional[NodeID] = None
+    raylet_address: Optional[str] = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: Optional[str] = None
+    creation_spec: Optional[TaskSpec] = None
+    detached: bool = False
+    pid: int = 0
+
+
+@dataclass
+class Bundle:
+    resources: ResourceSet
+    node_id: Optional[NodeID] = None
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    name: Optional[str]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    bundles: List[Bundle]
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED | RESCHEDULING
+    creator_job: Optional[JobID] = None

@@ -1,0 +1,111 @@
+"""Single-source config/flag table.
+
+Equivalent of the reference's RAY_CONFIG macro table (reference:
+src/ray/common/ray_config_def.h — 220 entries, overridable via RAY_<name>
+env vars and `_system_config` at init).  Here the table is a dict of typed
+defaults; every entry is overridable via the ``RAY_TPU_<name>`` environment
+variable and via ``ray_tpu.init(_system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_CONFIG_DEFS: Dict[str, Any] = {
+    # --- core object store ---
+    # Objects smaller than this are stored inline (in the owner / control
+    # plane) instead of in the shared-memory store.
+    "max_direct_call_object_size": 100 * 1024,
+    # Default object store capacity as a fraction of system memory.
+    "object_store_memory_fraction": 0.3,
+    # Absolute cap on default object store size (bytes).
+    "object_store_memory_cap": 8 * 1024**3,
+    # Chunk size for node-to-node object transfer.
+    "object_manager_chunk_size": 4 * 1024**2,
+    # --- scheduling ---
+    "worker_lease_timeout_ms": 30_000,
+    # Top-k fraction of nodes considered by the hybrid scheduling policy.
+    "scheduler_top_k_fraction": 0.2,
+    "scheduler_spread_threshold": 0.5,
+    # Workers prestarted per node (0 = num_cpus).
+    "num_prestart_workers": 0,
+    # Max idle workers kept around per node.
+    "idle_worker_pool_size": 8,
+    "idle_worker_killing_time_ms": 300_000,
+    # --- health / failure detection ---
+    "health_check_period_ms": 1_000,
+    "health_check_timeout_ms": 10_000,
+    "health_check_failure_threshold": 5,
+    "task_retry_delay_ms": 100,
+    # Default max retries for normal tasks.
+    "task_max_retries": 3,
+    # --- rpc ---
+    "rpc_connect_timeout_s": 30,
+    "rpc_call_timeout_s": 120,
+    # Chaos testing: "method:drop:N" spec list, see rpc.py (reference:
+    # src/ray/rpc/rpc_chaos.h).
+    "testing_rpc_failure": "",
+    # Artificial delay injected into every rpc handler, microseconds.
+    "testing_asio_delay_us": 0,
+    # --- task events / observability ---
+    "task_events_buffer_size": 10_000,
+    "metrics_report_interval_ms": 5_000,
+    # --- gcs ---
+    "gcs_storage": "memory",  # or "file"
+    "maximum_gcs_dead_node_cache": 100,
+    # --- collectives ---
+    "collective_chunk_bytes": 16 * 1024**2,
+    # --- logging ---
+    "log_to_driver": True,
+}
+
+
+class _Config:
+    """Process-wide config; values resolved env > system_config > default."""
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def initialize(self, system_config: Dict[str, Any] | None):
+        if not system_config:
+            return
+        for k, v in system_config.items():
+            if k not in _CONFIG_DEFS:
+                raise ValueError(f"Unknown system config: {k}")
+            self._overrides[k] = v
+
+    def get(self, name: str):
+        if name not in _CONFIG_DEFS:
+            raise KeyError(name)
+        env = os.environ.get(f"RAY_TPU_{name}")
+        if env is not None:
+            default = _CONFIG_DEFS[name]
+            if isinstance(default, bool):
+                return env.lower() in ("1", "true", "yes")
+            if isinstance(default, int):
+                return int(env)
+            if isinstance(default, float):
+                return float(env)
+            return env
+        if name in self._overrides:
+            return self._overrides[name]
+        return _CONFIG_DEFS[name]
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+    def dump(self) -> str:
+        return json.dumps({k: self.get(k) for k in _CONFIG_DEFS})
+
+    def load_overrides(self, dumped: str):
+        data = json.loads(dumped)
+        for k, v in data.items():
+            if k in _CONFIG_DEFS and v != _CONFIG_DEFS[k]:
+                self._overrides[k] = v
+
+
+CONFIG = _Config()

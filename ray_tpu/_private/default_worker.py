@@ -1,0 +1,25 @@
+"""Worker process entrypoint (reference:
+python/ray/_private/workers/default_worker.py).  Connects back to the
+raylet that spawned it (addresses via env) and runs the task loop."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, format="[worker %(asctime)s] %(message)s")
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    worker.connect_worker()
+    try:
+        worker.main_loop()
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,735 @@
+"""GCS — cluster control plane.
+
+Equivalent of the reference's gcs_server (reference:
+src/ray/gcs/gcs_server/gcs_server.h:88 wiring ~15 managers): node
+membership (gcs_node_manager.h), actor directory + fault tolerance
+(gcs_actor_manager.h, gcs_actor_scheduler.h), placement groups with
+two-phase Prepare/Commit (gcs_placement_group_scheduler.h:283), KV store
+(gcs_kv_manager.h), pubsub, health checks (gcs_health_check_manager.h),
+object directory (the reference uses owner-based location tracking;
+here the GCS tracks locations reported by raylets on seal/evict), and
+job management.
+
+One asyncio process.  All state in memory; an optional file-backed
+snapshot provides GCS restart tolerance (reference: redis persistence).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import (
+    ActorInfo,
+    Bundle,
+    NodeInfo,
+    PlacementGroupInfo,
+    ResourceSet,
+    TaskSpec,
+)
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+
+class GcsServer:
+    def __init__(self, address: str, session_info: dict, loop=None):
+        self.address = address
+        self.session_info = session_info  # session_dir, etc.
+        self.loop = loop or asyncio.get_event_loop()
+        self.server = rpc.RpcServer(self, address, self.loop)
+
+        # --- node manager ---
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.node_conns: Dict[NodeID, rpc.ClientConn] = {}
+        self.node_clients: Dict[NodeID, rpc.AsyncRpcClient] = {}
+        self.available: Dict[NodeID, ResourceSet] = {}  # latest reported
+        self.last_heartbeat: Dict[NodeID, float] = {}
+
+        # --- actor manager ---
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (ns, name)
+        self.pending_actors: List[ActorID] = []
+
+        # --- kv ---
+        self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
+
+        # --- object directory ---
+        self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
+
+        # --- placement groups ---
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.named_pgs: Dict[str, PlacementGroupID] = {}
+
+        # --- jobs ---
+        self.jobs: Dict[JobID, dict] = {}
+        self.next_job_int = 1
+        self.driver_conns: Dict[JobID, rpc.ClientConn] = {}
+
+        # --- pubsub: channel -> set of conns ---
+        self.subs: Dict[str, Set[rpc.ClientConn]] = defaultdict(set)
+
+        self.server.on_disconnect = self._on_disconnect
+        self._bg_tasks: List[asyncio.Task] = []
+        self.start_time = time.time()
+
+    async def start(self):
+        await self.server.start()
+        self._bg_tasks.append(self.loop.create_task(self._health_loop()))
+        logger.info("GCS listening on %s", self.address)
+
+    async def stop(self):
+        for t in self._bg_tasks:
+            t.cancel()
+        await self.server.stop()
+        for c in self.node_clients.values():
+            c.close()
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+    def publish(self, channel: str, message: Any):
+        dead = []
+        for conn in self.subs.get(channel, ()):
+            if conn.closed:
+                dead.append(conn)
+            else:
+                conn.push("pubsub", (channel, message))
+        for c in dead:
+            self.subs[channel].discard(c)
+
+    async def rpc_subscribe(self, payload, conn):
+        channel = payload
+        self.subs[channel].add(conn)
+        return True
+
+    async def rpc_unsubscribe(self, payload, conn):
+        self.subs.get(payload, set()).discard(conn)
+        return True
+
+    # ------------------------------------------------------------------
+    # cluster / session info
+    # ------------------------------------------------------------------
+    async def rpc_get_session_info(self, payload, conn):
+        return self.session_info
+
+    async def rpc_get_cluster_info(self, payload, conn):
+        return {
+            "nodes": {n.hex(): self._node_dict(i) for n, i in self.nodes.items()},
+        }
+
+    def _node_dict(self, info: NodeInfo) -> dict:
+        return {
+            "node_id": info.node_id.binary(),
+            "raylet_address": info.raylet_address,
+            "object_store_dir": info.object_store_dir,
+            "resources_total": dict(info.resources_total),
+            "available": dict(self.available.get(info.node_id, info.resources_total)),
+            "state": info.state,
+            "labels": info.labels,
+            "is_head": info.is_head,
+            "hostname": info.hostname,
+            "start_time": info.start_time,
+        }
+
+    # ------------------------------------------------------------------
+    # node manager
+    # ------------------------------------------------------------------
+    async def rpc_register_node(self, payload, conn):
+        info = NodeInfo(
+            node_id=NodeID(payload["node_id"]),
+            raylet_address=payload["raylet_address"],
+            object_store_dir=payload["object_store_dir"],
+            resources_total=ResourceSet.of(payload["resources_total"]),
+            labels=payload.get("labels", {}),
+            is_head=payload.get("is_head", False),
+            hostname=payload.get("hostname", ""),
+        )
+        self.nodes[info.node_id] = info
+        self.available[info.node_id] = info.resources_total.copy()
+        self.node_conns[info.node_id] = conn
+        self.last_heartbeat[info.node_id] = time.monotonic()
+        conn.meta["node_id"] = info.node_id
+        client = rpc.AsyncRpcClient(info.raylet_address)
+        await client.connect()
+        self.node_clients[info.node_id] = client
+        self.publish("nodes", ("ALIVE", self._node_dict(info)))
+        logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
+        # Re-schedule anything that was waiting for resources.
+        self._kick_pending()
+        return {"session_info": self.session_info}
+
+    async def rpc_resource_report(self, payload, conn):
+        """Periodic per-raylet load report (reference: ray_syncer)."""
+        node_id = NodeID(payload["node_id"])
+        self.last_heartbeat[node_id] = time.monotonic()
+        if node_id in self.nodes and self.nodes[node_id].state == "ALIVE":
+            self.available[node_id] = ResourceSet.of(payload["available"])
+            if payload.get("total"):
+                self.nodes[node_id].resources_total = ResourceSet.of(payload["total"])
+            # Broadcast the updated view so raylets can make spillback
+            # decisions locally (reference: ray_syncer resource view sync).
+            self.publish("resources", (node_id.binary(), payload["available"]))
+            if payload.get("has_pending"):
+                self._kick_pending()
+        return True
+
+    async def _health_loop(self):
+        period = CONFIG.health_check_period_ms / 1000
+        threshold = CONFIG.health_check_timeout_ms / 1000
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, info in list(self.nodes.items()):
+                if info.state != "ALIVE":
+                    continue
+                conn = self.node_conns.get(node_id)
+                if (conn is None or conn.closed) and now - self.last_heartbeat.get(node_id, now) > threshold:
+                    await self._mark_node_dead(node_id, "health check: heartbeat timeout")
+
+    async def _on_disconnect(self, conn):
+        node_id = conn.meta.get("node_id")
+        if node_id is not None and node_id in self.nodes:
+            await self._mark_node_dead(node_id, "raylet connection closed")
+        job_id = conn.meta.get("job_id")
+        if job_id is not None:
+            await self._on_driver_exit(job_id)
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or info.state == "DEAD":
+            return
+        info.state = "DEAD"
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        self.available.pop(node_id, None)
+        client = self.node_clients.pop(node_id, None)
+        if client:
+            client.close()
+        # Drop object locations on that node.
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(node_id)
+            if not locs:
+                del self.object_locations[oid]
+        self.publish("nodes", ("DEAD", self._node_dict(info)))
+        # Actors on that node die (maybe restart).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in ("ALIVE", "PENDING_CREATION", "RESTARTING"):
+                await self._on_actor_failure(actor, f"node {node_id.hex()[:8]} died")
+        # PG bundles on that node need rescheduling.
+        for pg in self.placement_groups.values():
+            if pg.state == "CREATED" and any(b.node_id == node_id for b in pg.bundles):
+                pg.state = "RESCHEDULING"
+                self.loop.create_task(self._schedule_pg(pg))
+
+    # ------------------------------------------------------------------
+    # job manager
+    # ------------------------------------------------------------------
+    async def rpc_register_driver(self, payload, conn):
+        job_id = JobID.from_int(self.next_job_int)
+        self.next_job_int += 1
+        self.jobs[job_id] = {
+            "job_id": job_id.binary(),
+            "state": "RUNNING",
+            "start_time": time.time(),
+            "namespace": payload.get("namespace") or f"anon_{job_id.hex()}",
+            "entrypoint": payload.get("entrypoint", ""),
+            "config": payload.get("config", {}),
+        }
+        conn.meta["job_id"] = job_id
+        self.driver_conns[job_id] = conn
+        self.publish("jobs", ("RUNNING", job_id.binary()))
+        return {
+            "job_id": job_id.binary(),
+            "namespace": self.jobs[job_id]["namespace"],
+            "session_info": self.session_info,
+        }
+
+    async def _on_driver_exit(self, job_id: JobID):
+        job = self.jobs.get(job_id)
+        if not job or job["state"] == "FINISHED":
+            return
+        job["state"] = "FINISHED"
+        job["end_time"] = time.time()
+        self.driver_conns.pop(job_id, None)
+        self.publish("jobs", ("FINISHED", job_id.binary()))
+        # Kill this job's non-detached actors.
+        for actor in list(self.actors.values()):
+            if actor.actor_id.job_id() == job_id and not actor.detached and actor.state != "DEAD":
+                await self._kill_actor(actor, "the job driver exited", no_restart=True)
+        # Remove this job's non-detached placement groups.
+        for pg in list(self.placement_groups.values()):
+            if pg.creator_job == job_id and pg.state not in ("REMOVED",):
+                await self._remove_pg(pg)
+        # Tell raylets to reap workers and stored objects of this job.
+        for client in self.node_clients.values():
+            try:
+                await client.push("job_finished", job_id.binary())
+            except Exception:
+                pass
+        # Drop directory entries for the job's objects (job id is embedded
+        # in every object id).
+        for oid in list(self.object_locations):
+            try:
+                if ObjectID(oid).job_id() == job_id:
+                    self.object_locations.pop(oid, None)
+            except Exception:
+                pass
+
+    async def rpc_list_jobs(self, payload, conn):
+        return [dict(j, job_id=j["job_id"]) for j in self.jobs.values()]
+
+    # ------------------------------------------------------------------
+    # kv store (function table, runtime envs, user internal kv)
+    # ------------------------------------------------------------------
+    async def rpc_kv_put(self, payload, conn):
+        ns, key, value, overwrite = payload
+        table = self.kv[ns]
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    async def rpc_kv_get(self, payload, conn):
+        ns, key = payload
+        return self.kv.get(ns, {}).get(key)
+
+    async def rpc_kv_multi_get(self, payload, conn):
+        ns, keys = payload
+        table = self.kv.get(ns, {})
+        return {k: table[k] for k in keys if k in table}
+
+    async def rpc_kv_del(self, payload, conn):
+        ns, key = payload
+        return self.kv.get(ns, {}).pop(key, None) is not None
+
+    async def rpc_kv_keys(self, payload, conn):
+        ns, prefix = payload
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    async def rpc_kv_exists(self, payload, conn):
+        ns, key = payload
+        return key in self.kv.get(ns, {})
+
+    # ------------------------------------------------------------------
+    # object directory
+    # ------------------------------------------------------------------
+    async def rpc_object_location_add(self, payload, conn):
+        oid, node_bytes = payload
+        self.object_locations[oid].add(NodeID(node_bytes))
+        self.publish(f"obj:{oid.hex() if isinstance(oid, ObjectID) else bytes(oid).hex()}", True)
+        return True
+
+    async def rpc_object_location_remove(self, payload, conn):
+        oid, node_bytes = payload
+        locs = self.object_locations.get(oid)
+        if locs:
+            locs.discard(NodeID(node_bytes))
+            if not locs:
+                self.object_locations.pop(oid, None)
+        return True
+
+    async def rpc_object_locations_get(self, payload, conn):
+        oid = payload
+        locs = self.object_locations.get(oid, set())
+        out = []
+        for n in locs:
+            info = self.nodes.get(n)
+            if info and info.state == "ALIVE":
+                out.append({"node_id": n.binary(), "raylet_address": info.raylet_address})
+        return out
+
+    async def rpc_object_free(self, payload, conn):
+        """Owner released all refs: delete everywhere.  Inline objects are
+        not in the directory, so the free is broadcast to every node."""
+        oids = payload
+        for oid in oids:
+            self.object_locations.pop(oid, None)
+        for client in self.node_clients.values():
+            try:
+                await client.push("store_free", oids)
+            except Exception:
+                pass
+        return True
+
+    async def push_free_objects(self, payload, conn):
+        await self.rpc_object_free(payload, conn)
+
+    # ------------------------------------------------------------------
+    # actor manager (reference: gcs_actor_manager.h:308 + scheduler :111)
+    # ------------------------------------------------------------------
+    async def rpc_register_actor(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        info = ActorInfo(
+            actor_id=spec.actor_id,
+            name=spec.actor_name,
+            namespace=spec.namespace or "default",
+            class_name=spec.name,
+            max_restarts=spec.max_restarts,
+            creation_spec=spec,
+            detached=spec.detached,
+        )
+        if info.name:
+            key = (info.namespace, info.name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing and existing.state != "DEAD":
+                    raise ValueError(f"Actor name '{info.name}' already taken in namespace '{info.namespace}'")
+            self.named_actors[key] = info.actor_id
+        self.actors[info.actor_id] = info
+        self.loop.create_task(self._schedule_actor(info))
+        return True
+
+    def _pick_node(self, resources: ResourceSet, strategy=None) -> Optional[NodeID]:
+        """Actor/bundle placement: hybrid pack-then-spread over the GCS
+        resource view (reference: hybrid_scheduling_policy.cc)."""
+        if strategy is not None and strategy.kind == "NODE_AFFINITY":
+            info = self.nodes.get(strategy.node_id)
+            if info and info.state == "ALIVE" and resources.fits_in(self.available.get(strategy.node_id, ResourceSet())):
+                return strategy.node_id
+            if strategy is not None and not strategy.soft:
+                return None
+        candidates = []
+        for node_id, info in self.nodes.items():
+            if info.state != "ALIVE":
+                continue
+            avail = self.available.get(node_id, ResourceSet())
+            if resources.fits_in(avail):
+                total = sum(info.resources_total.values()) or 1.0
+                util = 1.0 - sum(avail.values()) / total
+                candidates.append((util, node_id.binary(), node_id))
+        if not candidates:
+            return None
+        # Pack: prefer the most utilized node that still fits.
+        candidates.sort(reverse=True)
+        return candidates[0][2]
+
+    async def _schedule_actor(self, info: ActorInfo):
+        spec = info.creation_spec
+        strategy = spec.scheduling_strategy
+        resources = spec.resources
+        if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group_id is not None:
+            pg = self.placement_groups.get(strategy.placement_group_id)
+            if pg is None:
+                await self._fail_actor(info, "placement group removed before actor creation")
+                return
+            # Wait for PG to be created.
+            for _ in range(600):
+                if pg.state == "CREATED":
+                    break
+                await asyncio.sleep(0.05)
+            idx = strategy.bundle_index
+            node_id = pg.bundles[idx if idx >= 0 else 0].node_id
+            if node_id is None or self.nodes.get(node_id, None) is None or self.nodes[node_id].state != "ALIVE":
+                await self._fail_actor(info, "placement group bundle node unavailable")
+                return
+        else:
+            node_id = self._pick_node(resources, strategy)
+        if node_id is None:
+            # No node fits now — queue and retry when resources change.
+            if info.actor_id not in self.pending_actors:
+                self.pending_actors.append(info.actor_id)
+            return
+        client = self.node_clients.get(node_id)
+        if client is None:
+            await self._fail_actor(info, "chosen node vanished")
+            return
+        info.node_id = node_id
+        info.raylet_address = self.nodes[node_id].raylet_address
+        info.state = "PENDING_CREATION"
+        try:
+            # Unbounded: actor __init__ may legitimately take a long time;
+            # worker death is reported separately.
+            result = await client.call("create_actor", {"spec": spec}, timeout=None)
+            info.pid = result.get("pid", 0)
+            info.state = "ALIVE"
+            self.publish("actors", self._actor_dict(info))
+            self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
+        except Exception as e:  # creation failed
+            await self._on_actor_failure(info, f"creation failed: {e}")
+
+    def _kick_pending(self):
+        pending, self.pending_actors = self.pending_actors, []
+        for actor_id in pending:
+            info = self.actors.get(actor_id)
+            if info and info.state in ("PENDING_CREATION", "RESTARTING"):
+                self.loop.create_task(self._schedule_actor(info))
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING" and getattr(pg, "_queued", False):
+                pg._queued = False
+                self.loop.create_task(self._schedule_pg(pg))
+
+    def _actor_dict(self, info: ActorInfo) -> dict:
+        return {
+            "actor_id": info.actor_id.binary(),
+            "state": info.state,
+            "node_id": info.node_id.binary() if info.node_id else None,
+            "raylet_address": info.raylet_address,
+            "name": info.name,
+            "namespace": info.namespace,
+            "class_name": info.class_name,
+            "num_restarts": info.num_restarts,
+            "death_cause": info.death_cause,
+            "pid": info.pid,
+        }
+
+    async def _on_actor_failure(self, info: ActorInfo, reason: str):
+        if info.state == "DEAD":
+            return
+        restarts_left = info.max_restarts == -1 or info.num_restarts < info.max_restarts
+        if restarts_left:
+            info.num_restarts += 1
+            info.state = "RESTARTING"
+            self.publish("actors", self._actor_dict(info))
+            self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
+            await self._schedule_actor(info)
+        else:
+            await self._fail_actor(info, reason)
+
+    async def _fail_actor(self, info: ActorInfo, reason: str):
+        info.state = "DEAD"
+        info.death_cause = reason
+        self.publish("actors", self._actor_dict(info))
+        self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
+
+    async def _kill_actor(self, info: ActorInfo, reason: str, no_restart: bool):
+        if info.state == "DEAD":
+            return
+        if info.node_id is not None:
+            client = self.node_clients.get(info.node_id)
+            if client:
+                try:
+                    await client.push("kill_actor", {"actor_id": info.actor_id.binary()})
+                except Exception:
+                    pass
+        if no_restart:
+            await self._fail_actor(info, reason)
+        else:
+            await self._on_actor_failure(info, reason)
+
+    async def rpc_actor_death_report(self, payload, conn):
+        """Raylet reports an actor's worker exited."""
+        actor_id = ActorID(payload["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        if payload.get("intended"):
+            await self._fail_actor(info, payload.get("reason", "ray.kill / __ray_terminate__"))
+        else:
+            await self._on_actor_failure(info, payload.get("reason", "worker died"))
+        return True
+
+    async def rpc_kill_actor(self, payload, conn):
+        actor_id = ActorID(payload["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            raise ValueError(f"no such actor {actor_id}")
+        await self._kill_actor(info, "ray.kill", no_restart=payload.get("no_restart", True))
+        return True
+
+    async def rpc_get_actor_info(self, payload, conn):
+        actor_id = ActorID(payload)
+        info = self.actors.get(actor_id)
+        return self._actor_dict(info) if info else None
+
+    async def rpc_get_named_actor(self, payload, conn):
+        ns, name = payload
+        actor_id = self.named_actors.get((ns, name))
+        if actor_id is None:
+            return None
+        info = self.actors.get(actor_id)
+        if info is None or info.state == "DEAD":
+            return None
+        return {"actor_id": actor_id.binary(), "spec": info.creation_spec, "info": self._actor_dict(info)}
+
+    async def rpc_list_named_actors(self, payload, conn):
+        all_namespaces = payload
+        out = []
+        for (ns, name), aid in self.named_actors.items():
+            info = self.actors.get(aid)
+            if info and info.state != "DEAD":
+                out.append({"namespace": ns, "name": name})
+        return out
+
+    async def rpc_list_actors(self, payload, conn):
+        return [self._actor_dict(i) for i in self.actors.values()]
+
+    # ------------------------------------------------------------------
+    # placement groups (reference: gcs_placement_group_manager.h:228,
+    # two-phase commit in gcs_placement_group_scheduler.h:283)
+    # ------------------------------------------------------------------
+    async def rpc_create_placement_group(self, payload, conn):
+        pg = PlacementGroupInfo(
+            pg_id=PlacementGroupID(payload["pg_id"]),
+            name=payload.get("name"),
+            strategy=payload["strategy"],
+            bundles=[Bundle(resources=ResourceSet.of(b)) for b in payload["bundles"]],
+            creator_job=conn.meta.get("job_id"),
+        )
+        self.placement_groups[pg.pg_id] = pg
+        if pg.name:
+            self.named_pgs[pg.name] = pg.pg_id
+        await self._schedule_pg(pg)
+        return {"pg_id": pg.pg_id.binary(), "state": pg.state}
+
+    def _pg_node_assignment(self, pg: PlacementGroupInfo) -> Optional[List[NodeID]]:
+        """Pick a node per bundle honoring the strategy, against a copy of
+        the availability view (reference: bundle_scheduling_policy.cc)."""
+        avail = {n: rs.copy() for n, rs in self.available.items() if self.nodes[n].state == "ALIVE"}
+        nodes_sorted = sorted(avail, key=lambda n: -sum(avail[n].values()))
+        assignment: List[Optional[NodeID]] = []
+
+        def fits(n, rs):
+            return rs.fits_in(avail[n])
+
+        if pg.strategy in ("PACK", "STRICT_PACK"):
+            for b in pg.bundles:
+                placed = None
+                preferred = assignment[-1] if assignment else None
+                order = ([preferred] if preferred else []) + [n for n in nodes_sorted if n != preferred]
+                for n in order:
+                    if n is not None and fits(n, b.resources):
+                        placed = n
+                        break
+                if placed is None:
+                    return None
+                if pg.strategy == "STRICT_PACK" and assignment and placed != assignment[0]:
+                    return None
+                avail[placed].subtract(b.resources)
+                assignment.append(placed)
+        else:  # SPREAD | STRICT_SPREAD
+            used: Set[NodeID] = set()
+            for b in pg.bundles:
+                placed = None
+                fresh = [n for n in nodes_sorted if n not in used]
+                order = fresh + ([n for n in nodes_sorted if n in used] if pg.strategy == "SPREAD" else [])
+                for n in order:
+                    if fits(n, b.resources):
+                        placed = n
+                        break
+                if placed is None:
+                    return None
+                avail[placed].subtract(b.resources)
+                used.add(placed)
+                assignment.append(placed)
+        return assignment
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo):
+        assignment = self._pg_node_assignment(pg)
+        if assignment is None:
+            pg._queued = True  # retried by _kick_pending
+            return
+        # Phase 1: prepare (reserve) on every node; all-or-nothing.
+        prepared: List[Tuple[NodeID, int]] = []
+        ok = True
+        for idx, node_id in enumerate(assignment):
+            client = self.node_clients.get(node_id)
+            if client is None:
+                ok = False
+                break
+            try:
+                res = await client.call(
+                    "prepare_bundle",
+                    {"pg_id": pg.pg_id.binary(), "bundle_index": idx, "resources": dict(pg.bundles[idx].resources)},
+                )
+                if not res:
+                    ok = False
+                    break
+                prepared.append((node_id, idx))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for node_id, idx in prepared:
+                client = self.node_clients.get(node_id)
+                if client:
+                    try:
+                        await client.call("return_bundle", {"pg_id": pg.pg_id.binary(), "bundle_index": idx})
+                    except Exception:
+                        pass
+            pg._queued = True
+            return
+        # Phase 2: commit.
+        for (node_id, idx) in prepared:
+            client = self.node_clients.get(node_id)
+            await client.call("commit_bundle", {"pg_id": pg.pg_id.binary(), "bundle_index": idx})
+            pg.bundles[idx].node_id = node_id
+        pg.state = "CREATED"
+        self.publish("placement_groups", {"pg_id": pg.pg_id.binary(), "state": "CREATED"})
+        self.publish(f"pg:{pg.pg_id.hex()}", {"state": "CREATED"})
+
+    async def _remove_pg(self, pg: PlacementGroupInfo):
+        pg.state = "REMOVED"
+        for idx, b in enumerate(pg.bundles):
+            if b.node_id is not None:
+                client = self.node_clients.get(b.node_id)
+                if client:
+                    try:
+                        await client.call("return_bundle", {"pg_id": pg.pg_id.binary(), "bundle_index": idx})
+                    except Exception:
+                        pass
+                b.node_id = None
+        if pg.name:
+            self.named_pgs.pop(pg.name, None)
+        self.publish("placement_groups", {"pg_id": pg.pg_id.binary(), "state": "REMOVED"})
+        self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
+
+    async def rpc_remove_placement_group(self, payload, conn):
+        pg = self.placement_groups.get(PlacementGroupID(payload))
+        if pg is None:
+            return False
+        # Kill actors scheduled into this PG.
+        for actor in list(self.actors.values()):
+            strat = actor.creation_spec.scheduling_strategy if actor.creation_spec else None
+            if (
+                strat is not None
+                and strat.kind == "PLACEMENT_GROUP"
+                and strat.placement_group_id == pg.pg_id
+                and actor.state != "DEAD"
+            ):
+                await self._kill_actor(actor, "placement group removed", no_restart=True)
+        await self._remove_pg(pg)
+        return True
+
+    async def rpc_get_placement_group(self, payload, conn):
+        pg_id = PlacementGroupID(payload)
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return None
+        return {
+            "pg_id": pg.pg_id.binary(),
+            "name": pg.name,
+            "strategy": pg.strategy,
+            "state": pg.state,
+            "bundles": [
+                {"resources": dict(b.resources), "node_id": b.node_id.binary() if b.node_id else None}
+                for b in pg.bundles
+            ],
+        }
+
+    async def rpc_list_placement_groups(self, payload, conn):
+        return [await self.rpc_get_placement_group(pg_id.binary(), conn) for pg_id in self.placement_groups]
+
+    # ------------------------------------------------------------------
+    # cluster resources API
+    # ------------------------------------------------------------------
+    async def rpc_cluster_resources(self, payload, conn):
+        total: Dict[str, float] = {}
+        for info in self.nodes.values():
+            if info.state == "ALIVE":
+                for k, v in info.resources_total.items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    async def rpc_available_resources(self, payload, conn):
+        total: Dict[str, float] = {}
+        for node_id, avail in self.available.items():
+            info = self.nodes.get(node_id)
+            if info and info.state == "ALIVE":
+                for k, v in avail.items():
+                    total[k] = total.get(k, 0.0) + v
+        return total

@@ -1,0 +1,71 @@
+"""Head-node process: GCS + head raylet on one asyncio loop.
+
+(reference: src/ray/gcs/gcs_server/gcs_server_main.cc + raylet/main.cc:123
+— two processes there; co-hosted here, same protocols.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.gcs_server import GcsServer
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.raylet import Raylet
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--store-dir", required=True)
+    parser.add_argument("--resources", required=True)
+    parser.add_argument("--config", default="")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s")
+    if args.config:
+        CONFIG.load_overrides(args.config)
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+
+    gcs = GcsServer(args.gcs_address, {"session_dir": args.session_dir}, loop=loop)
+    raylet = Raylet(
+        node_id=NodeID.from_random(),
+        address=args.raylet_address,
+        gcs_address=args.gcs_address,
+        store_dir=args.store_dir,
+        resources=json.loads(args.resources),
+        is_head=True,
+        loop=loop,
+    )
+
+    stop_event = asyncio.Event()
+
+    def _sig(*_):
+        loop.call_soon_threadsafe(stop_event.set)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+    async def run():
+        await gcs.start()
+        await raylet.start()
+        await stop_event.wait()
+        try:
+            await asyncio.wait_for(raylet.stop(), timeout=4)
+            await asyncio.wait_for(gcs.stop(), timeout=2)
+        except Exception:
+            pass
+
+    loop.run_until_complete(run())
+
+
+if __name__ == "__main__":
+    main()

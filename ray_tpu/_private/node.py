@@ -1,0 +1,258 @@
+"""Node bring-up and process supervision.
+
+Equivalent of the reference's Node/services layer (reference:
+python/ray/_private/node.py:37 start_head_processes → start_gcs_server,
+start_raylet; python/ray/_private/services.py).  The head process hosts
+GCS + the head-node raylet in one asyncio loop (one process instead of
+two — cheap on a shared box, same wire protocols); additional nodes are
+raylet-only processes pointed at the GCS, which is how the multi-node
+Cluster test utility works on one machine (reference:
+python/ray/cluster_utils.py:135).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import NodeID
+
+RAY_TPU_TMP = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+CLUSTER_ADDRESS_FILE = os.path.join(RAY_TPU_TMP, "ray_current_cluster")
+
+
+def child_env() -> dict:
+    """Env for spawned processes: make sure ray_tpu is importable even when
+    the driver got it via sys.path manipulation rather than installation."""
+    import ray_tpu
+
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env = dict(os.environ)
+    parts = env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
+    if pkg_parent not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_parent] + parts)
+    return env
+
+
+def default_store_root(session_name: str) -> str:
+    # Prefer tmpfs so object mmaps are memory-speed.
+    for base in ("/dev/shm", RAY_TPU_TMP):
+        try:
+            os.makedirs(base, exist_ok=True)
+            test = os.path.join(base, f".wtest_{os.getpid()}")
+            with open(test, "w") as f:
+                f.write("x")
+            os.unlink(test)
+            return os.path.join(base, "ray_tpu_store", session_name)
+        except OSError:
+            continue
+    return os.path.join(tempfile.gettempdir(), "ray_tpu_store", session_name)
+
+
+def new_session_dir() -> str:
+    name = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
+    path = os.path.join(RAY_TPU_TMP, name)
+    os.makedirs(os.path.join(path, "sockets"), exist_ok=True)
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    return path
+
+
+def detect_resources(num_cpus=None, num_tpus=None, resources=None, memory=None) -> Dict[str, float]:
+    out: Dict[str, float] = dict(resources or {})
+    out["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    if num_tpus is not None:
+        out["TPU"] = float(num_tpus)
+    else:
+        try:
+            from ray_tpu._private.accelerators import tpu as tpu_accel
+
+            n = tpu_accel.TPUAcceleratorManager.get_current_node_num_accelerators()
+            if n:
+                out["TPU"] = float(n)
+                out.update(tpu_accel.TPUAcceleratorManager.get_current_node_additional_resources())
+        except Exception:
+            pass
+    if memory is not None:
+        out["memory"] = float(memory)
+    else:
+        try:
+            total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+            out["memory"] = float(int(total * 0.7))
+        except (ValueError, OSError):
+            pass
+    return out
+
+
+class NodeProcesses:
+    """Driver-side handles to the processes this driver started."""
+
+    def __init__(self, session_dir: str, gcs_address: str, raylet_address: str, procs):
+        self.session_dir = session_dir
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.procs = list(procs)
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        try:
+            if os.path.exists(CLUSTER_ADDRESS_FILE):
+                with open(CLUSTER_ADDRESS_FILE) as f:
+                    if f.read().strip() == self.gcs_address:
+                        os.unlink(CLUSTER_ADDRESS_FILE)
+        except OSError:
+            pass
+
+
+def start_head(
+    num_cpus=None,
+    num_tpus=None,
+    resources=None,
+    memory=None,
+    session_dir: Optional[str] = None,
+    wait: bool = True,
+) -> NodeProcesses:
+    session_dir = session_dir or new_session_dir()
+    session_name = os.path.basename(session_dir)
+    gcs_address = f"unix:{session_dir}/sockets/gcs.sock"
+    raylet_address = f"unix:{session_dir}/sockets/raylet_head.sock"
+    store_dir = os.path.join(default_store_root(session_name), "head")
+    res = detect_resources(num_cpus, num_tpus, resources, memory)
+    log = open(os.path.join(session_dir, "logs", "head.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.head_main",
+            "--session-dir", session_dir,
+            "--gcs-address", gcs_address,
+            "--raylet-address", raylet_address,
+            "--store-dir", store_dir,
+            "--resources", json.dumps(res),
+            "--config", CONFIG.dump(),
+        ],
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+        env=child_env(),
+    )
+    log.close()
+    node = NodeProcesses(session_dir, gcs_address, raylet_address, [proc])
+    if wait:
+        _wait_for_node(gcs_address, proc)
+        os.makedirs(RAY_TPU_TMP, exist_ok=True)
+        with open(CLUSTER_ADDRESS_FILE, "w") as f:
+            f.write(gcs_address)
+    return node
+
+
+def start_worker_node(
+    gcs_address: str,
+    session_dir: str,
+    num_cpus=None,
+    num_tpus=None,
+    resources=None,
+    memory=None,
+    wait: bool = True,
+):
+    node_tag = uuid.uuid4().hex[:8]
+    raylet_address = f"unix:{session_dir}/sockets/raylet_{node_tag}.sock"
+    session_name = os.path.basename(session_dir)
+    store_dir = os.path.join(default_store_root(session_name), node_tag)
+    res = detect_resources(num_cpus, num_tpus, resources, memory)
+    log = open(os.path.join(session_dir, "logs", f"raylet_{node_tag}.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.raylet_main",
+            "--session-dir", session_dir,
+            "--gcs-address", gcs_address,
+            "--raylet-address", raylet_address,
+            "--store-dir", store_dir,
+            "--resources", json.dumps(res),
+            "--config", CONFIG.dump(),
+        ],
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+        env=child_env(),
+    )
+    log.close()
+    if wait:
+        _wait_for_raylet(gcs_address, raylet_address, proc)
+    return proc, raylet_address
+
+
+def _wait_for_node(gcs_address: str, proc, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    last_err = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"head process exited with code {proc.returncode}; see session logs")
+        try:
+            client = rpc.RpcClient(gcs_address)
+            try:
+                info = client.call("get_cluster_info", timeout=5)
+                if info["nodes"]:
+                    return
+            finally:
+                client.close()
+        except rpc.RpcError as e:
+            last_err = e
+        time.sleep(0.05)
+    raise TimeoutError(f"cluster did not come up within {timeout}s: {last_err}")
+
+
+def _wait_for_raylet(gcs_address: str, raylet_address: str, proc, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"raylet process exited with code {proc.returncode}")
+        try:
+            client = rpc.RpcClient(gcs_address)
+            try:
+                info = client.call("get_cluster_info", timeout=5)
+                for n in info["nodes"].values():
+                    if n["raylet_address"] == raylet_address and n["state"] == "ALIVE":
+                        return
+            finally:
+                client.close()
+        except rpc.RpcError:
+            pass
+        time.sleep(0.05)
+    raise TimeoutError("worker node did not register in time")
+
+
+def head_raylet_address(gcs_address: str) -> str:
+    client = rpc.RpcClient(gcs_address)
+    try:
+        info = client.call("get_cluster_info")
+        heads = [n for n in info["nodes"].values() if n["state"] == "ALIVE" and n.get("is_head")]
+        nodes = heads or [n for n in info["nodes"].values() if n["state"] == "ALIVE"]
+        if not nodes:
+            raise RuntimeError("no alive nodes in cluster")
+        return nodes[0]["raylet_address"]
+    finally:
+        client.close()

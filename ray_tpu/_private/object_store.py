@@ -1,0 +1,294 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+Role of the reference's plasma store embedded in the raylet (reference:
+src/ray/object_manager/plasma/store.h:55, object_lifecycle_manager.h:101,
+eviction_policy.h:160).  Design differences, on purpose:
+
+- Objects live as individual files under /dev/shm (tmpfs), mmap'd by
+  clients — the same zero-copy property as plasma's single arena without
+  a custom allocator; the C++ arena store (ray_tpu/_native) can replace
+  this file-per-object backend behind the same client API.
+- Small objects (< max_direct_call_object_size) are stored inline in the
+  store process and returned inside RPC replies (the reference keeps these
+  in the owner's in-process memory store).
+- Clients on the same node create+write the shm file themselves, then
+  `seal` it with the store — a put is one RPC regardless of size.
+
+The *server* half (`ObjectStoreCore`) runs inside the raylet's asyncio
+loop; the *client* half (`StoreClient`) runs in drivers and workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ObjectID
+
+SEALED = 1
+INLINE = 2
+
+
+class ObjectEntry:
+    __slots__ = (
+        "object_id", "size", "state", "path", "inline_data",
+        "pin_count", "last_access", "sealed_event", "is_error",
+    )
+
+    def __init__(self, object_id: ObjectID):
+        self.object_id = object_id
+        self.size = 0
+        self.state = 0
+        self.path: Optional[str] = None
+        self.inline_data: Optional[bytes] = None
+        self.pin_count = 0
+        self.last_access = time.monotonic()
+        self.sealed_event: Optional[asyncio.Event] = None
+        self.is_error = False
+
+
+class ObjectStoreCore:
+    """Server half; lives in the raylet process' asyncio loop."""
+
+    def __init__(self, store_dir: str, capacity_bytes: int, on_seal=None, on_evict=None):
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        self.capacity = capacity_bytes
+        self.used = 0
+        self.objects: Dict[ObjectID, ObjectEntry] = {}
+        # Callbacks into the raylet: directory updates to GCS.
+        self.on_seal = on_seal
+        self.on_evict = on_evict
+        self.num_puts = 0
+        self.num_gets = 0
+        self.num_evictions = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def object_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self.store_dir, object_id.hex())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        e = self.objects.get(object_id)
+        return e is not None and e.state in (SEALED, INLINE)
+
+    def put_inline(self, object_id: ObjectID, data: bytes, is_error: bool = False) -> bool:
+        if self.contains(object_id):
+            return False
+        e = self.objects.get(object_id) or ObjectEntry(object_id)
+        e.inline_data = bytes(data)
+        e.size = len(data)
+        e.state = INLINE
+        e.is_error = is_error
+        self.objects[object_id] = e
+        self.used += e.size
+        self.num_puts += 1
+        self._notify_sealed(e)
+        return True
+
+    def seal_file(self, object_id: ObjectID, size: int) -> bool:
+        """Client already wrote `store_dir/<hex>`; account + announce it."""
+        if self.contains(object_id):
+            return False
+        self._ensure_capacity(size)
+        e = self.objects.get(object_id) or ObjectEntry(object_id)
+        e.path = self.object_path(object_id)
+        e.size = size
+        e.state = SEALED
+        self.objects[object_id] = e
+        self.used += size
+        self.num_puts += 1
+        self._notify_sealed(e)
+        return True
+
+    def create_from_bytes(self, object_id: ObjectID, data: bytes) -> bool:
+        """Store-side write (used by object pulls from remote nodes)."""
+        if self.contains(object_id):
+            return False
+        if len(data) <= CONFIG.max_direct_call_object_size:
+            return self.put_inline(object_id, data)
+        self._ensure_capacity(len(data))
+        path = self.object_path(object_id)
+        with open(path, "wb") as f:
+            f.write(data)
+        return self.seal_file(object_id, len(data))
+
+    def read_bytes(self, object_id: ObjectID) -> Optional[bytes]:
+        e = self.objects.get(object_id)
+        if e is None or not e.state:
+            return None
+        e.last_access = time.monotonic()
+        if e.state == INLINE:
+            return e.inline_data
+        with open(e.path, "rb") as f:
+            return f.read()
+
+    def get_meta(self, object_id: ObjectID):
+        e = self.objects.get(object_id)
+        if e is None or not e.state:
+            return None
+        e.last_access = time.monotonic()
+        self.num_gets += 1
+        if e.state == INLINE:
+            return {"inline": e.inline_data, "size": e.size}
+        return {"path": e.path, "size": e.size}
+
+    def delete(self, object_id: ObjectID):
+        e = self.objects.pop(object_id, None)
+        if e is None:
+            return
+        if e.state:
+            self.used -= e.size
+        if e.path:
+            try:
+                os.unlink(e.path)
+            except OSError:
+                pass
+
+    def pin(self, object_id: ObjectID):
+        e = self.objects.get(object_id)
+        if e is not None:
+            e.pin_count += 1
+
+    def unpin(self, object_id: ObjectID):
+        e = self.objects.get(object_id)
+        if e is not None and e.pin_count > 0:
+            e.pin_count -= 1
+
+    async def wait_sealed(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
+        e = self.objects.get(object_id)
+        if e is not None and e.state:
+            return True
+        if e is None:
+            e = ObjectEntry(object_id)
+            self.objects[object_id] = e
+        if e.sealed_event is None:
+            e.sealed_event = asyncio.Event()
+        try:
+            await asyncio.wait_for(e.sealed_event.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def _notify_sealed(self, e: ObjectEntry):
+        if e.sealed_event is not None:
+            e.sealed_event.set()
+            e.sealed_event = None
+        if self.on_seal:
+            self.on_seal(e.object_id)
+
+    # -- eviction (LRU over unpinned sealed objects; reference:
+    # plasma/eviction_policy.h) ------------------------------------------
+    def _ensure_capacity(self, need: int):
+        if self.used + need <= self.capacity:
+            return
+        candidates = sorted(
+            (e for e in self.objects.values() if e.state and e.pin_count == 0),
+            key=lambda e: e.last_access,
+        )
+        for e in candidates:
+            if self.used + need <= self.capacity:
+                break
+            self.num_evictions += 1
+            if self.on_evict:
+                self.on_evict(e.object_id)
+            self.delete(e.object_id)
+
+    def stats(self) -> dict:
+        return {
+            "num_objects": len(self.objects),
+            "used_bytes": self.used,
+            "capacity_bytes": self.capacity,
+            "num_puts": self.num_puts,
+            "num_gets": self.num_gets,
+            "num_evictions": self.num_evictions,
+        }
+
+
+def _close_mmap_quietly(m):
+    try:
+        m.close()
+    except BufferError:
+        # An extracted sub-buffer still aliases the mapping; leak it rather
+        # than invalidate live views.
+        pass
+
+
+class StoreClient:
+    """Client half; talks to the local raylet's store RPCs and mmaps shm
+    files directly for large objects (zero-copy on the same node)."""
+
+    def __init__(self, raylet_client, store_dir: str):
+        self._raylet = raylet_client  # rpc.RpcClient to the local raylet
+        self.store_dir = store_dir
+        # Mappings that could not be tied to their value's lifetime with a
+        # weakref finalizer; they stay open for the process lifetime (the
+        # mapping, not a copy — same pinning semantics as plasma clients).
+        self._unclosable_mmaps: list = []
+
+    def put_serialized(self, object_id: ObjectID, meta: bytes, buffers: List[memoryview]) -> int:
+        from ray_tpu._private import serialization
+
+        total = serialization.total_size(meta, buffers)
+        if total <= CONFIG.max_direct_call_object_size:
+            blob = bytearray(total)
+            serialization.write_into(memoryview(blob), meta, buffers)
+            self._raylet.call("store_put_inline", (object_id.binary(), bytes(blob)))
+            return total
+        path = os.path.join(self.store_dir, object_id.hex())
+        tmp = path + ".w"
+        with open(tmp, "w+b") as f:
+            f.truncate(total)
+            with mmap.mmap(f.fileno(), total) as m:
+                serialization.write_into(memoryview(m), meta, buffers)
+        os.rename(tmp, path)
+        self._raylet.call("store_seal", (object_id.binary(), total))
+        return total
+
+    def get_serialized(self, object_id: ObjectID, timeout: Optional[float]):
+        """Returns (tag, value) or raises GetTimeoutError/ObjectLostError."""
+        from ray_tpu import exceptions
+        from ray_tpu._private import serialization
+
+        meta = self._raylet.call(
+            "store_get", (object_id.binary(), timeout),
+            timeout=(timeout + 5) if timeout is not None else None,
+        )
+        if meta is None:
+            raise exceptions.GetTimeoutError(f"timed out getting {object_id}")
+        if "inline" in meta:
+            return serialization.deserialize(memoryview(meta["inline"]))
+        f = open(meta["path"], "rb")
+        try:
+            m = mmap.mmap(f.fileno(), meta["size"], prot=mmap.PROT_READ)
+        finally:
+            f.close()
+        tag, value = serialization.deserialize(memoryview(m))
+        # The mmap must outlive any buffers aliasing it.  Close it when the
+        # deserialized value is collected; values that can't carry a weakref
+        # (plain containers) pin the mapping for the process lifetime.
+        import weakref
+
+        try:
+            weakref.finalize(value, _close_mmap_quietly, m)
+        except TypeError:
+            self._unclosable_mmaps.append(m)
+        return tag, value
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._raylet.call("store_contains", object_id.binary())
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int, timeout: Optional[float]) -> Tuple[Set[ObjectID], Set[ObjectID]]:
+        ready = self._raylet.call(
+            "store_wait",
+            ([o.binary() for o in object_ids], num_returns, timeout),
+            timeout=(timeout + 5) if timeout is not None else None,
+        )
+        ready_ids = {ObjectID(b) for b in ready}
+        return ready_ids, {o for o in object_ids if o not in ready_ids}
+
+    def free(self, object_ids: List[ObjectID]):
+        self._raylet.push("store_free", [o.binary() for o in object_ids])

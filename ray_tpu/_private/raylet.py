@@ -1,0 +1,850 @@
+"""Raylet — per-node agent: scheduler, worker pool, object manager.
+
+Equivalent of the reference raylet (reference: src/ray/raylet/
+node_manager.h:119, worker_pool.h:216, local_task_manager.h:58,
+scheduling/cluster_task_manager.h:42) plus the object-manager pull path
+(reference: src/ray/object_manager/pull_manager.h:52).  Differences by
+design: task submitters send the full TaskSpec to a raylet and the raylet
+pushes it to a leased worker over the worker's registration connection
+(the reference grants a lease and the submitter pushes worker-to-worker;
+that optimization can layer on later without API changes).
+
+Scheduling is two-level like the reference: a cluster decision (run here
+vs. spill to another node, using the GCS-synced availability view) and a
+local dispatch loop (match queued tasks to free resources + idle workers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.common import ResourceSet, TaskSpec
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
+from ray_tpu._private.object_store import ObjectStoreCore
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    __slots__ = (
+        "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
+        "running", "spawn_time", "idle_since", "resources_held", "bundle_key",
+    )
+
+    def __init__(self, worker_id: WorkerID, proc, job_id: JobID):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.pid = proc.pid if proc else 0
+        self.conn: Optional[rpc.ClientConn] = None
+        self.job_id = job_id
+        self.state = "STARTING"  # STARTING | IDLE | BUSY | ACTOR | DEAD
+        self.actor_id: Optional[ActorID] = None
+        self.running: Dict[bytes, TaskSpec] = {}  # task_id bytes -> spec
+        self.spawn_time = time.monotonic()
+        self.idle_since = time.monotonic()
+        self.resources_held = ResourceSet()
+        # Set for actors placed inside a placement-group bundle: resources
+        # must be returned to the bundle, not the node pool.
+        self.bundle_key: Optional[Tuple[bytes, int]] = None
+
+
+class Raylet:
+    def __init__(
+        self,
+        node_id: NodeID,
+        address: str,
+        gcs_address: str,
+        store_dir: str,
+        resources: Dict[str, float],
+        labels: Dict[str, str] = None,
+        is_head: bool = False,
+        loop=None,
+    ):
+        self.node_id = node_id
+        self.address = address
+        self.gcs_address = gcs_address
+        self.loop = loop or asyncio.get_event_loop()
+        self.server = rpc.RpcServer(self, address, self.loop)
+        self.server.on_disconnect = self._on_disconnect
+        self.is_head = is_head
+        self.labels = labels or {}
+
+        self.resources_total = ResourceSet.of(resources)
+        self.resources_available = self.resources_total.copy()
+
+        cap = int(CONFIG.object_store_memory_cap)
+        self.store = ObjectStoreCore(
+            store_dir, cap, on_seal=self._on_object_sealed, on_evict=self._on_object_evicted
+        )
+
+        # Worker pool
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.idle_workers: Dict[JobID, deque] = defaultdict(deque)
+        self.actor_workers: Dict[ActorID, WorkerHandle] = {}
+        self.num_starting = 0
+        self.job_configs: Dict[JobID, dict] = {}
+
+        # Task queues
+        self.queue: deque[TaskSpec] = deque()
+        self.infeasible: List[TaskSpec] = []
+        self._dispatch_scheduled = False
+
+        # Cluster view (node_id bytes -> {"raylet_address", "available"})
+        self.cluster_view: Dict[bytes, dict] = {}
+        self.gcs: Optional[rpc.AsyncRpcClient] = None
+        self.peer_clients: Dict[str, rpc.AsyncRpcClient] = {}
+
+        # Placement group bundles: (pg_id bytes, idx) -> reservation state
+        self.bundles: Dict[Tuple[bytes, int], dict] = {}
+
+        # Objects being pulled: oid bytes -> future
+        self.pulls: Dict[bytes, asyncio.Future] = {}
+
+        # Metrics
+        self.num_tasks_dispatched = 0
+        self.num_tasks_spilled = 0
+        self._infeasible_tick = 0
+        self._bg: List[asyncio.Task] = []
+        self._stopping = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self):
+        await self.server.start()
+        self.gcs = rpc.AsyncRpcClient(self.gcs_address)
+        self.gcs.on_push = self._on_gcs_push
+        await self.gcs.connect()
+        await self.gcs.call(
+            "register_node",
+            {
+                "node_id": self.node_id.binary(),
+                "raylet_address": self.address,
+                "object_store_dir": self.store.store_dir,
+                "resources_total": dict(self.resources_total),
+                "labels": self.labels,
+                "is_head": self.is_head,
+                "hostname": os.uname().nodename,
+            },
+        )
+        await self.gcs.call("subscribe", "resources")
+        await self.gcs.call("subscribe", "nodes")
+        self._bg.append(self.loop.create_task(self._report_loop()))
+        self._bg.append(self.loop.create_task(self._idle_reaper_loop()))
+        logger.info("raylet %s listening on %s", self.node_id.hex()[:8], self.address)
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._bg:
+            t.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker_proc(w)
+        await self.server.stop()
+        if self.gcs:
+            self.gcs.close()
+        for c in self.peer_clients.values():
+            c.close()
+        import shutil
+
+        shutil.rmtree(self.store.store_dir, ignore_errors=True)
+        try:  # remove the per-session parent when the last store leaves
+            os.rmdir(os.path.dirname(self.store.store_dir))
+        except OSError:
+            pass
+
+    def _kill_worker_proc(self, w: WorkerHandle):
+        w.state = "DEAD"
+        self.workers.pop(w.worker_id, None)
+        if w.actor_id is not None:
+            self.actor_workers.pop(w.actor_id, None)
+        self._release_resources(w)
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # GCS pushes
+    # ------------------------------------------------------------------
+    def _on_gcs_push(self, method: str, payload):
+        if method == "pubsub":
+            channel, msg = payload
+            if channel == "resources":
+                node_bytes, available = msg
+                if node_bytes != self.node_id.binary() and node_bytes in self.cluster_view:
+                    self.cluster_view[node_bytes]["available"] = available
+            elif channel == "nodes":
+                state, node = payload[1]
+                nb = node["node_id"]
+                if state == "ALIVE" and nb != self.node_id.binary():
+                    self.cluster_view[nb] = {
+                        "raylet_address": node["raylet_address"],
+                        "available": node.get("available", {}),
+                        "total": node.get("resources_total", {}),
+                    }
+                elif state == "DEAD":
+                    self.cluster_view.pop(nb, None)
+        elif method == "job_finished":
+            self._on_job_finished(JobID(payload))
+        elif method == "kill_actor":
+            self._kill_actor_local(ActorID(payload["actor_id"]), intended=True)
+        elif method == "store_free":
+            for oid in payload:
+                self.store.delete(ObjectID(oid))
+
+    # ------------------------------------------------------------------
+    # resource reporting (reference: ray_syncer)
+    # ------------------------------------------------------------------
+    async def _report_loop(self):
+        while not self._stopping:
+            try:
+                await self.gcs.call(
+                    "resource_report",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "available": dict(self.resources_available),
+                        "total": dict(self.resources_total),
+                        "has_pending": bool(self.queue or self.infeasible),
+                    },
+                    timeout=10,
+                )
+            except rpc.RpcError:
+                pass
+            # Periodically retry infeasible tasks (cluster membership or
+            # resources may have changed); doing this here rather than in
+            # _dispatch avoids a hot requeue loop for never-satisfiable
+            # tasks.
+            self._infeasible_tick += 1
+            if self.infeasible and self._infeasible_tick % 10 == 0:
+                infeasible, self.infeasible = self.infeasible, []
+                for spec in infeasible:
+                    self._queue_and_schedule(spec)
+            await asyncio.sleep(0.2)
+
+    async def _idle_reaper_loop(self):
+        while not self._stopping:
+            await asyncio.sleep(5)
+            limit = CONFIG.idle_worker_pool_size
+            kill_after = CONFIG.idle_worker_killing_time_ms / 1000
+            now = time.monotonic()
+            for job_id, dq in self.idle_workers.items():
+                while len(dq) > limit:
+                    w = dq.popleft()
+                    self._kill_worker_proc(w)
+                for w in list(dq):
+                    if now - w.idle_since > kill_after:
+                        dq.remove(w)
+                        self._kill_worker_proc(w)
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: raylet/worker_pool.h:216)
+    # ------------------------------------------------------------------
+    def _spawn_worker(self, job_id: JobID, actor_id: Optional[ActorID] = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        from ray_tpu._private.node import child_env
+
+        env = child_env()
+        env["RAY_TPU_RAYLET_ADDRESS"] = self.address
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_JOB_ID"] = job_id.hex()
+        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_STORE_DIR"] = self.store.store_dir
+        job_config = self.job_configs.get(job_id, {})
+        session_dir = job_config.get("session_dir") or os.path.dirname(self.store.store_dir)
+        log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        out.close()
+        w = WorkerHandle(worker_id, proc, job_id)
+        w.actor_id = actor_id
+        self.workers[worker_id] = w
+        self.num_starting += 1
+        return w
+
+    async def rpc_register_worker(self, payload, conn):
+        worker_id = WorkerID(payload["worker_id"])
+        w = self.workers.get(worker_id)
+        if w is None:
+            # Driver registering as a worker-like client, or unknown.
+            return {"ok": False}
+        self.num_starting = max(0, self.num_starting - 1)
+        w.conn = conn
+        w.state = "IDLE"
+        conn.meta["worker_id"] = worker_id
+        if w.actor_id is None:
+            self.idle_workers[w.job_id].append(w)
+        self._schedule_dispatch()
+        return {"ok": True, "job_config": self.job_configs.get(w.job_id, {})}
+
+    async def rpc_register_client(self, payload, conn):
+        """Drivers register so the raylet can clean up on disconnect."""
+        conn.meta["is_driver"] = True
+        if payload and payload.get("job_id"):
+            job_id = JobID(payload["job_id"])
+            conn.meta["job_id"] = job_id
+            self.job_configs[job_id] = payload.get("job_config", {})
+            # Prestart workers for the job.
+            n = CONFIG.num_prestart_workers or min(2, int(self.resources_total.get("CPU", 1)))
+            for _ in range(n):
+                self._spawn_worker(job_id)
+        return {"node_id": self.node_id.binary(), "store_dir": self.store.store_dir}
+
+    async def push_task_blocked(self, payload, conn):
+        """A worker blocked in ray.get releases its task's CPU so nested
+        tasks can run (reference: CoreWorker NotifyDirectCallTaskBlocked)."""
+        worker_id = conn.meta.get("worker_id")
+        w = self.workers.get(worker_id) if worker_id else None
+        if w is None:
+            return
+        spec = w.running.get(payload["task_id"])
+        if spec is not None and not spec.is_actor_task:
+            self._release_task_resources(spec)
+            w.resources_held.subtract(self._task_resources(spec))
+            self._schedule_dispatch()
+
+    async def push_task_unblocked(self, payload, conn):
+        worker_id = conn.meta.get("worker_id")
+        w = self.workers.get(worker_id) if worker_id else None
+        if w is None:
+            return
+        spec = w.running.get(payload["task_id"])
+        if spec is not None and not spec.is_actor_task:
+            # May transiently oversubscribe, like the reference.
+            bk = self._bundle_key(spec)
+            if bk is not None:
+                b = self.bundles.get(bk)
+                if b is not None:
+                    b["available"].subtract(self._task_resources(spec))
+            else:
+                self.resources_available.subtract(self._task_resources(spec))
+            w.resources_held.add(self._task_resources(spec))
+
+    async def _on_disconnect(self, conn):
+        worker_id = conn.meta.get("worker_id")
+        if worker_id is not None:
+            w = self.workers.get(worker_id)
+            if w is not None and w.state != "DEAD":
+                await self._on_worker_death(w)
+
+    async def _on_worker_death(self, w: WorkerHandle):
+        w.state = "DEAD"
+        self.workers.pop(w.worker_id, None)
+        for dq in self.idle_workers.values():
+            if w in dq:
+                dq.remove(w)
+        self._release_resources(w)
+        # Fail or retry the tasks it was running.
+        for task_bytes, spec in list(w.running.items()):
+            self._handle_failed_execution(spec, "worker process died")
+        w.running.clear()
+        if w.actor_id is not None:
+            self.actor_workers.pop(w.actor_id, None)
+            try:
+                await self.gcs.call(
+                    "actor_death_report",
+                    {"actor_id": w.actor_id.binary(), "intended": False, "reason": "actor worker process died"},
+                )
+            except rpc.RpcError:
+                pass
+        self._schedule_dispatch()
+
+    def _handle_failed_execution(self, spec: TaskSpec, reason: str):
+        from ray_tpu import exceptions
+
+        if spec.attempt_number < spec.max_retries:
+            spec.attempt_number += 1
+            logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt_number, reason)
+            self.loop.call_later(
+                CONFIG.task_retry_delay_ms / 1000, lambda: (self.queue.append(spec), self._schedule_dispatch())
+            )
+            return
+        if spec.is_actor_task:
+            err = exceptions.RayActorError(f"The actor died while running {spec.name}: {reason}")
+        else:
+            err = exceptions.WorkerCrashedError(f"Task {spec.name} failed: {reason}")
+        blob = serialization.serialize_to_bytes(err, tag=serialization.TAG_ERROR)
+        for oid in spec.return_ids():
+            self.store.create_from_bytes(oid, blob)
+
+    def _on_job_finished(self, job_id: JobID):
+        for w in list(self.workers.values()):
+            if w.job_id == job_id:
+                self._kill_worker_proc(w)
+        self.idle_workers.pop(job_id, None)
+        self.job_configs.pop(job_id, None)
+        self.queue = deque(s for s in self.queue if s.job_id != job_id)
+        self.infeasible = [s for s in self.infeasible if s.job_id != job_id]
+        # Per-job object GC: every object id embeds its job id.
+        for oid in list(self.store.objects):
+            try:
+                if oid.job_id() == job_id:
+                    self.store.delete(oid)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # task scheduling (reference: cluster_task_manager.cc:44 QueueAndScheduleTask)
+    # ------------------------------------------------------------------
+    async def rpc_submit_task(self, payload, conn):
+        spec: TaskSpec = payload["spec"]
+        spilled = payload.get("spilled", False)
+        if spec.is_actor_task:
+            return self._submit_actor_task(spec)
+        self._queue_and_schedule(spec, allow_spill=not spilled)
+        return True
+
+    def _queue_and_schedule(self, spec: TaskSpec, allow_spill: bool = True):
+        strategy = spec.scheduling_strategy
+        if allow_spill and strategy.kind in ("DEFAULT", "SPREAD"):
+            target = self._cluster_decision(spec)
+            if target is not None:
+                self.num_tasks_spilled += 1
+                self.loop.create_task(self._forward_task(spec, target))
+                return
+        self.queue.append(spec)
+        self._schedule_dispatch()
+
+    def _cluster_decision(self, spec: TaskSpec) -> Optional[str]:
+        """Return a peer raylet address to spill to, or None to keep local.
+
+        Hybrid policy: keep local while local available resources fit
+        (pack); otherwise pick the least-utilized remote that fits
+        (reference: hybrid_scheduling_policy.cc top-k pack-then-spread)."""
+        res = spec.resources
+        if res.fits_in(self.resources_available):
+            return None
+        best = None
+        best_avail = -1.0
+        for nb, view in self.cluster_view.items():
+            avail = view.get("available", {})
+            if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                score = sum(avail.values())
+                if score > best_avail:
+                    best_avail = score
+                    best = view["raylet_address"]
+        return best
+
+    async def _forward_task(self, spec: TaskSpec, address: str):
+        try:
+            client = await self._peer(address)
+            await client.call("submit_task", {"spec": spec, "spilled": True})
+        except rpc.RpcError:
+            # Peer vanished: schedule locally/queue.
+            self.queue.append(spec)
+            self._schedule_dispatch()
+
+    async def _peer(self, address: str) -> rpc.AsyncRpcClient:
+        client = self.peer_clients.get(address)
+        if client is None or not client._connected:
+            client = rpc.AsyncRpcClient(address)
+            await client.connect()
+            self.peer_clients[address] = client
+        return client
+
+    def _schedule_dispatch(self):
+        if not self._dispatch_scheduled:
+            self._dispatch_scheduled = True
+            self.loop.call_soon(self._dispatch)
+
+    def _task_resources(self, spec: TaskSpec) -> ResourceSet:
+        return spec.resources
+
+    def _bundle_key(self, spec: TaskSpec) -> Optional[Tuple[bytes, int]]:
+        s = spec.scheduling_strategy
+        if s.kind == "PLACEMENT_GROUP" and s.placement_group_id is not None:
+            return (s.placement_group_id.binary(), max(s.bundle_index, 0))
+        return None
+
+    def _try_acquire(self, spec: TaskSpec) -> bool:
+        res = self._task_resources(spec)
+        bk = self._bundle_key(spec)
+        if bk is not None:
+            bundle = self.bundles.get(bk)
+            if bundle is None or not bundle["committed"]:
+                return False
+            if not res.fits_in(bundle["available"]):
+                return False
+            bundle["available"].subtract(res)
+            return True
+        if not res.fits_in(self.resources_available):
+            return False
+        self.resources_available.subtract(res)
+        return True
+
+    def _release_task_resources(self, spec: TaskSpec):
+        res = self._task_resources(spec)
+        bk = self._bundle_key(spec)
+        if bk is not None:
+            bundle = self.bundles.get(bk)
+            if bundle is not None:
+                bundle["available"].add(res)
+            return
+        self.resources_available.add(res)
+
+    def _release_resources(self, w: WorkerHandle):
+        if not w.resources_held:
+            return
+        if w.bundle_key is not None:
+            b = self.bundles.get(w.bundle_key)
+            if b is not None:
+                b["available"].add(w.resources_held)
+        else:
+            self.resources_available.add(w.resources_held)
+        w.resources_held = ResourceSet()
+
+    def _dispatch(self):
+        """Local dispatch loop (reference: local_task_manager.cc:74)."""
+        self._dispatch_scheduled = False
+        if self._stopping:
+            return
+        remaining = deque()
+        while self.queue:
+            spec = self.queue.popleft()
+            if not self._locally_feasible(spec):
+                # Can never run here: spill or park as infeasible.
+                target = self._cluster_decision(spec)
+                if target is not None:
+                    self.loop.create_task(self._forward_task(spec, target))
+                else:
+                    self.infeasible.append(spec)
+                continue
+            if not self._try_acquire(spec):
+                remaining.append(spec)
+                continue
+            w = self._pop_idle_worker(spec.job_id)
+            if w is None:
+                self._release_task_resources(spec)
+                remaining.append(spec)
+                # Make sure a worker is coming.
+                if self.num_starting == 0:
+                    self._spawn_worker(spec.job_id)
+                continue
+            self._push_task_to_worker(w, spec)
+        self.queue = remaining
+
+    def _locally_feasible(self, spec: TaskSpec) -> bool:
+        bk = self._bundle_key(spec)
+        if bk is not None:
+            return bk in self.bundles
+        return self._task_resources(spec).fits_in(self.resources_total)
+
+    def _pop_idle_worker(self, job_id: JobID) -> Optional[WorkerHandle]:
+        dq = self.idle_workers.get(job_id)
+        while dq:
+            w = dq.popleft()
+            if w.state == "IDLE" and w.conn is not None and not w.conn.closed:
+                return w
+        return None
+
+    def _push_task_to_worker(self, w: WorkerHandle, spec: TaskSpec):
+        w.state = "BUSY" if w.actor_id is None else "ACTOR"
+        w.running[spec.task_id.binary()] = spec
+        w.resources_held.add(self._task_resources(spec)) if w.actor_id is None else None
+        self.num_tasks_dispatched += 1
+        w.conn.push("execute_task", {"spec": spec})
+
+    async def rpc_task_done(self, payload, conn):
+        """Worker finished a task (success or user exception — either way
+        the results are already in the store)."""
+        worker_id = conn.meta.get("worker_id")
+        w = self.workers.get(worker_id) if worker_id else None
+        if w is None:
+            return False
+        spec = w.running.pop(payload["task_id"], None)
+        if spec is not None and w.actor_id is None:
+            self._release_task_resources(spec)
+            w.resources_held.subtract(self._task_resources(spec))
+        if w.actor_id is None and w.state != "DEAD":
+            w.state = "IDLE"
+            w.idle_since = time.monotonic()
+            self.idle_workers[w.job_id].append(w)
+        self._schedule_dispatch()
+        return True
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    async def rpc_create_actor(self, payload, conn):
+        """From GCS: spawn a dedicated worker and run the creation task."""
+        spec: TaskSpec = payload["spec"]
+        res = spec.resources
+        bk = self._bundle_key(spec)
+        if bk is not None:
+            bundle = self.bundles.get(bk)
+            if bundle is None or not bundle["committed"] or not res.fits_in(bundle["available"]):
+                raise RuntimeError("placement group bundle cannot host actor")
+            bundle["available"].subtract(res)
+        else:
+            if not res.fits_in(self.resources_available):
+                raise RuntimeError("insufficient resources for actor")
+            self.resources_available.subtract(res)
+        w = self._spawn_worker(spec.job_id, actor_id=spec.actor_id)
+        w.resources_held = res.copy()
+        w.bundle_key = bk
+        self.actor_workers[spec.actor_id] = w
+        # Wait for the worker to register.
+        deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
+        while w.conn is None:
+            if time.monotonic() > deadline or w.proc.poll() is not None:
+                self._kill_worker_proc(w)
+                raise RuntimeError("actor worker failed to start")
+            await asyncio.sleep(0.01)
+        self._push_task_to_worker(w, spec)
+        # Wait for creation task to finish (success = __init__ ran).
+        while spec.task_id.binary() in w.running:
+            if w.state == "DEAD":
+                raise RuntimeError("actor worker died during creation")
+            await asyncio.sleep(0.005)
+        # Creation errors are reported via the return object; check it.
+        ret = spec.return_ids()[0]
+        meta = self.store.get_meta(ret)
+        if meta is not None:
+            data = self.store.read_bytes(ret)
+            if data is not None and data[0] == serialization.TAG_ERROR:
+                raise RuntimeError("actor __init__ raised; see creation task return")
+        return {"pid": w.pid}
+
+    def _submit_actor_task(self, spec: TaskSpec):
+        w = self.actor_workers.get(spec.actor_id)
+        if w is None or w.state == "DEAD" or w.conn is None or w.conn.closed:
+            from ray_tpu import exceptions
+
+            err = exceptions.RayActorError(f"Actor {spec.actor_id.hex()[:8]} is not on this node or died")
+            blob = serialization.serialize_to_bytes(err, tag=serialization.TAG_ERROR)
+            for oid in spec.return_ids():
+                self.store.create_from_bytes(oid, blob)
+            return False
+        w.running[spec.task_id.binary()] = spec
+        w.conn.push("execute_task", {"spec": spec})
+        return True
+
+    def _kill_actor_local(self, actor_id: ActorID, intended: bool):
+        w = self.actor_workers.get(actor_id)
+        if w is None:
+            return
+        # Push a graceful exit; escalate with SIGKILL shortly after.
+        if w.conn is not None and not w.conn.closed:
+            w.conn.push("exit", {"reason": "ray.kill"})
+
+        def _hard_kill():
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    os.kill(w.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+
+        self.loop.call_later(2.0, _hard_kill)
+
+    # ------------------------------------------------------------------
+    # placement group bundles (reference: placement_group_resource_manager.h)
+    # ------------------------------------------------------------------
+    async def rpc_prepare_bundle(self, payload, conn):
+        key = (payload["pg_id"], payload["bundle_index"])
+        res = ResourceSet.of(payload["resources"])
+        if key in self.bundles:
+            return True
+        if not res.fits_in(self.resources_available):
+            return False
+        self.resources_available.subtract(res)
+        self.bundles[key] = {"reserved": res, "available": res.copy(), "committed": False}
+        return True
+
+    async def rpc_commit_bundle(self, payload, conn):
+        key = (payload["pg_id"], payload["bundle_index"])
+        b = self.bundles.get(key)
+        if b is None:
+            return False
+        b["committed"] = True
+        self._schedule_dispatch()
+        return True
+
+    async def rpc_return_bundle(self, payload, conn):
+        key = (payload["pg_id"], payload["bundle_index"])
+        b = self.bundles.pop(key, None)
+        if b is not None:
+            self.resources_available.add(b["reserved"])
+        self._schedule_dispatch()
+        return True
+
+    # ------------------------------------------------------------------
+    # object store RPCs
+    # ------------------------------------------------------------------
+    def _on_object_sealed(self, object_id: ObjectID):
+        if self.gcs is not None and self.gcs._connected:
+            self.loop.create_task(
+                self._safe_gcs_push("object_location_add", (object_id.binary(), self.node_id.binary()))
+            )
+
+    def _on_object_evicted(self, object_id: ObjectID):
+        if self.gcs is not None and self.gcs._connected:
+            self.loop.create_task(
+                self._safe_gcs_push("object_location_remove", (object_id.binary(), self.node_id.binary()))
+            )
+
+    async def _safe_gcs_push(self, method, payload):
+        try:
+            await self.gcs.call(method, payload, timeout=10)
+        except rpc.RpcError:
+            pass
+
+    async def rpc_store_put_inline(self, payload, conn):
+        oid_bytes, data = payload
+        return self.store.put_inline(ObjectID(oid_bytes), data)
+
+    async def rpc_store_seal(self, payload, conn):
+        oid_bytes, size = payload
+        return self.store.seal_file(ObjectID(oid_bytes), size)
+
+    async def rpc_store_contains(self, payload, conn):
+        return self.store.contains(ObjectID(payload))
+
+    async def rpc_store_get(self, payload, conn):
+        """Get meta for one object, pulling from a remote node if needed."""
+        oid_bytes, timeout = payload
+        oid = ObjectID(oid_bytes)
+        meta = self.store.get_meta(oid)
+        if meta is not None:
+            return meta
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        # Kick off a pull and wait for seal.
+        self.loop.create_task(self._ensure_pulled(oid))
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        ok = await self.store.wait_sealed(oid, remaining)
+        return self.store.get_meta(oid) if ok else None
+
+    async def rpc_store_wait(self, payload, conn):
+        oid_bytes_list, num_returns, timeout = payload
+        oids = [ObjectID(b) for b in oid_bytes_list]
+        deadline = time.monotonic() + timeout if timeout is not None else None
+
+        async def wait_one(oid):
+            if not self.store.contains(oid):
+                self.loop.create_task(self._ensure_pulled(oid))
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            await self.store.wait_sealed(oid, remaining)
+            return oid
+
+        pending = {asyncio.ensure_future(wait_one(o)) for o in oids}
+        ready: List[bytes] = []
+        try:
+            while pending and len(ready) < num_returns:
+                remaining = None if deadline is None else max(0.001, deadline - time.monotonic())
+                done, pending = await asyncio.wait(
+                    pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+                )
+                for d in done:
+                    oid = d.result()
+                    if self.store.contains(oid):
+                        ready.append(oid.binary())
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+        finally:
+            for p in pending:
+                p.cancel()
+        return ready
+
+    async def push_store_free(self, payload, conn):
+        for oid in payload:
+            self.store.delete(ObjectID(oid))
+
+    async def rpc_store_free(self, payload, conn):
+        for oid in payload:
+            self.store.delete(ObjectID(oid))
+        return True
+
+    async def rpc_store_pin(self, payload, conn):
+        for oid in payload:
+            self.store.pin(ObjectID(oid))
+        return True
+
+    async def rpc_store_unpin(self, payload, conn):
+        for oid in payload:
+            self.store.unpin(ObjectID(oid))
+        return True
+
+    async def rpc_store_stats(self, payload, conn):
+        return self.store.stats()
+
+    # ------------------------------------------------------------------
+    # object manager: pull from peers (reference: pull_manager.h:52)
+    # ------------------------------------------------------------------
+    async def _ensure_pulled(self, oid: ObjectID):
+        key = oid.binary()
+        if self.store.contains(oid) or key in self.pulls:
+            return
+        fut = self.loop.create_future()
+        self.pulls[key] = fut
+        try:
+            while not self.store.contains(oid):
+                try:
+                    locations = await self.gcs.call("object_locations_get", key, timeout=10)
+                except rpc.RpcError:
+                    locations = []
+                pulled = False
+                for loc in locations:
+                    if loc["node_id"] == self.node_id.binary():
+                        continue
+                    try:
+                        client = await self._peer(loc["raylet_address"])
+                        data = await client.call("om_fetch", key, timeout=60)
+                        if data is not None:
+                            self.store.create_from_bytes(oid, data)
+                            pulled = True
+                            break
+                    except rpc.RpcError:
+                        continue
+                if pulled:
+                    break
+                # Object isn't anywhere yet (e.g. task still running) —
+                # retry until it appears or callers give up.
+                await asyncio.sleep(0.1)
+                if not self.pulls.get(key):
+                    break
+        finally:
+            self.pulls.pop(key, None)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def rpc_om_fetch(self, payload, conn):
+        """Peer raylet requests object bytes (chunking TODO for >4MB)."""
+        return self.store.read_bytes(ObjectID(payload))
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    async def rpc_node_stats(self, payload, conn):
+        return {
+            "node_id": self.node_id.binary(),
+            "resources_total": dict(self.resources_total),
+            "resources_available": dict(self.resources_available),
+            "num_workers": len(self.workers),
+            "queue_len": len(self.queue),
+            "infeasible": len(self.infeasible),
+            "store": self.store.stats(),
+            "num_tasks_dispatched": self.num_tasks_dispatched,
+            "num_tasks_spilled": self.num_tasks_spilled,
+            "running_tasks": [
+                {"task_id": tb, "name": s.name, "worker_pid": w.pid}
+                for w in self.workers.values()
+                for tb, s in w.running.items()
+            ],
+        }

@@ -1,0 +1,444 @@
+"""RPC substrate: length-framed messages over unix/TCP sockets.
+
+Fills the role of the reference's gRPC wrappers (reference: src/ray/rpc/
+grpc_server.h, grpc_client.h, client_call.h) without a grpc dependency:
+asyncio servers with per-connection dispatch, a threaded synchronous client
+for drivers/workers, an async client for service-to-service calls, and
+chaos-injection hooks (reference: src/ray/rpc/rpc_chaos.h:23,
+RAY_testing_rpc_failure) wired in from day one.
+
+Wire format: [u32 length][pickle payload]
+Payload tuples:
+    ("req",  req_id, method, payload)
+    ("rep",  req_id, ok, result)          ok=False → result is an Exception
+    ("push", method, payload)             one-way, either direction
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private.config import CONFIG
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+# Sentinel distinguishing "caller did not pass a timeout" (use the config
+# default) from an explicit None (wait forever).
+_UNSET_TIMEOUT = object()
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class CallTimeout(RpcError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Chaos injection (reference: src/ray/rpc/rpc_chaos.h — drop request or
+# response the first N times a method is seen).
+# --------------------------------------------------------------------------
+class _Chaos:
+    def __init__(self):
+        self._spec: Dict[str, list] = {}
+        self._lock = threading.Lock()
+        self._parsed_for = None
+
+    def _ensure(self):
+        spec = CONFIG.testing_rpc_failure
+        if spec == self._parsed_for:
+            return
+        with self._lock:
+            self._parsed_for = spec
+            self._spec = {}
+            if spec:
+                # "method:kind:count,method2:kind:count"; kind in req|rep
+                for part in spec.split(","):
+                    m, kind, count = part.split(":")
+                    self._spec[m] = [kind, int(count)]
+
+    def should_drop(self, method: str, kind: str) -> bool:
+        self._ensure()
+        ent = self._spec.get(method)
+        if not ent or ent[0] != kind or ent[1] <= 0:
+            return False
+        with self._lock:
+            if ent[1] <= 0:
+                return False
+            ent[1] -= 1
+            return True
+
+
+CHAOS = _Chaos()
+
+
+def _parse_address(address: str):
+    if address.startswith("unix:"):
+        return ("unix", address[5:])
+    if address.startswith("tcp:"):
+        host, port = address[4:].rsplit(":", 1)
+        return ("tcp", (host, int(port)))
+    raise ValueError(f"bad address {address}")
+
+
+# --------------------------------------------------------------------------
+# Async server
+# --------------------------------------------------------------------------
+class ClientConn:
+    """Server-side handle to one connected client; supports pushes."""
+
+    __slots__ = ("writer", "peer", "_lock", "meta", "closed")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.peer = None
+        self.meta: Dict[str, Any] = {}
+        self.closed = False
+
+    def push(self, method: str, payload: Any):
+        if self.closed:
+            return
+        data = pickle.dumps(("push", method, payload), protocol=5)
+        try:
+            self.writer.write(_LEN.pack(len(data)) + data)
+        except Exception:
+            self.closed = True
+
+    async def drain(self):
+        try:
+            await self.writer.drain()
+        except Exception:
+            self.closed = True
+
+
+class RpcServer:
+    """Dispatches ("req", ...) frames to `handler.rpc_<method>(payload, conn)`
+    coroutines; ("push", ...) frames to `handler.push_<method>(payload, conn)`.
+    """
+
+    def __init__(self, handler: Any, address: str, loop: asyncio.AbstractEventLoop):
+        self.handler = handler
+        self.address = address
+        self.loop = loop
+        self._server = None
+        self.conns: set = set()
+        self.on_disconnect: Optional[Callable[[ClientConn], Any]] = None
+
+    async def start(self):
+        kind, target = _parse_address(self.address)
+        if kind == "unix":
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            if os.path.exists(target):
+                os.unlink(target)
+            self._server = await asyncio.start_unix_server(self._on_conn, path=target)
+        else:
+            host, port = target
+            self._server = await asyncio.start_server(self._on_conn, host=host, port=port)
+        return self
+
+    async def stop(self):
+        # Close live connections first — wait_closed() blocks until every
+        # connection handler finishes, which would never happen otherwise.
+        for c in list(self.conns):
+            c.closed = True
+            try:
+                c.writer.close()
+            except Exception:
+                pass
+        if self._server:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except Exception:
+                pass
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = ClientConn(writer)
+        self.conns.add(conn)
+        try:
+            while True:
+                hdr = await reader.readexactly(_LEN.size)
+                (length,) = _LEN.unpack(hdr)
+                data = await reader.readexactly(length)
+                msg = pickle.loads(data)
+                asyncio.ensure_future(self._dispatch(msg, conn))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            conn.closed = True
+            self.conns.discard(conn)
+            try:
+                writer.close()
+            except Exception:
+                pass
+            if self.on_disconnect:
+                res = self.on_disconnect(conn)
+                if asyncio.iscoroutine(res):
+                    await res
+
+    async def _dispatch(self, msg, conn: ClientConn):
+        delay_us = CONFIG.testing_asio_delay_us
+        if delay_us:
+            await asyncio.sleep(delay_us / 1e6)
+        if msg[0] == "req":
+            _, req_id, method, payload = msg
+            if CHAOS.should_drop(method, "req"):
+                return
+            fn = getattr(self.handler, "rpc_" + method, None)
+            try:
+                if fn is None:
+                    raise RpcError(f"no such rpc method: {method}")
+                result = await fn(payload, conn)
+                ok = True
+            except Exception as e:  # noqa: BLE001 — errors cross the wire
+                result, ok = e, False
+            if CHAOS.should_drop(method, "rep"):
+                return
+            if conn.closed:
+                return
+            data = pickle.dumps(("rep", req_id, ok, result), protocol=5)
+            conn.writer.write(_LEN.pack(len(data)) + data)
+            await conn.drain()
+        elif msg[0] == "push":
+            _, method, payload = msg
+            fn = getattr(self.handler, "push_" + method, None)
+            if fn is not None:
+                await fn(payload, conn)
+
+
+# --------------------------------------------------------------------------
+# Async client (service ↔ service, runs inside an asyncio loop)
+# --------------------------------------------------------------------------
+class AsyncRpcClient:
+    def __init__(self, address: str):
+        self.address = address
+        self._reader = None
+        self._writer = None
+        self._req_id = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._read_task = None
+        self.on_push: Optional[Callable[[str, Any], Any]] = None
+        self._connected = False
+        self._wlock = asyncio.Lock()
+
+    async def connect(self, timeout: float = None):
+        timeout = timeout or CONFIG.rpc_connect_timeout_s
+        kind, target = _parse_address(self.address)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                if kind == "unix":
+                    self._reader, self._writer = await asyncio.open_unix_connection(target)
+                else:
+                    self._reader, self._writer = await asyncio.open_connection(*target)
+                break
+            except (ConnectionRefusedError, FileNotFoundError):
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"cannot connect to {self.address}")
+                await asyncio.sleep(0.05)
+        self._connected = True
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self._reader.readexactly(_LEN.size)
+                (length,) = _LEN.unpack(hdr)
+                data = await self._reader.readexactly(length)
+                msg = pickle.loads(data)
+                if msg[0] == "rep":
+                    _, req_id, ok, result = msg
+                    fut = self._pending.pop(req_id, None)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(result)
+                        else:
+                            fut.set_exception(result)
+                elif msg[0] == "push" and self.on_push:
+                    res = self.on_push(msg[1], msg[2])
+                    if asyncio.iscoroutine(res):
+                        asyncio.ensure_future(res)
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        finally:
+            self._connected = False
+            err = ConnectionLost(f"connection to {self.address} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None, timeout: float = _UNSET_TIMEOUT):
+        """timeout semantics: unset → config default; None → wait forever."""
+        if not self._connected:
+            raise ConnectionLost(f"not connected to {self.address}")
+        self._req_id += 1
+        req_id = self._req_id
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        data = pickle.dumps(("req", req_id, method, payload), protocol=5)
+        async with self._wlock:
+            self._writer.write(_LEN.pack(len(data)) + data)
+            await self._writer.drain()
+        if timeout is _UNSET_TIMEOUT:
+            timeout = CONFIG.rpc_call_timeout_s
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise CallTimeout(f"{method} on {self.address} timed out after {timeout}s")
+
+    async def push(self, method: str, payload: Any = None):
+        if not self._connected:
+            raise ConnectionLost(f"not connected to {self.address}")
+        data = pickle.dumps(("push", method, payload), protocol=5)
+        async with self._wlock:
+            self._writer.write(_LEN.pack(len(data)) + data)
+            await self._writer.drain()
+
+    def close(self):
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._connected = False
+
+
+# --------------------------------------------------------------------------
+# Sync client (drivers / worker main threads)
+# --------------------------------------------------------------------------
+class RpcClient:
+    def __init__(self, address: str, on_push: Callable[[str, Any], None] = None):
+        self.address = address
+        self.on_push = on_push
+        self._sock = self._connect()
+        self._req_id = 0
+        self._lock = threading.Lock()
+        self._pending: Dict[int, "threading.Event"] = {}
+        self._results: Dict[int, Any] = {}
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name=f"rpc-read-{address[-16:]}")
+        self._reader.start()
+
+    def _connect(self):
+        kind, target = _parse_address(self.address)
+        deadline = time.monotonic() + CONFIG.rpc_connect_timeout_s
+        while True:
+            try:
+                if kind == "unix":
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(target)
+                else:
+                    s = socket.create_connection(target)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except (ConnectionRefusedError, FileNotFoundError):
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"cannot connect to {self.address}")
+                time.sleep(0.05 + random.random() * 0.05)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self._sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionLost("socket closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_loop(self):
+        try:
+            while not self._closed:
+                hdr = self._recv_exact(_LEN.size)
+                (length,) = _LEN.unpack(hdr)
+                data = self._recv_exact(length)
+                msg = pickle.loads(data)
+                if msg[0] == "rep":
+                    _, req_id, ok, result = msg
+                    with self._lock:
+                        ev = self._pending.pop(req_id, None)
+                        if ev is not None:
+                            self._results[req_id] = (ok, result)
+                            ev.set()
+                elif msg[0] == "push" and self.on_push:
+                    try:
+                        self.on_push(msg[1], msg[2])
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+        except (ConnectionLost, OSError):
+            pass
+        finally:
+            self._closed = True
+            with self._lock:
+                for req_id, ev in self._pending.items():
+                    self._results[req_id] = (False, ConnectionLost(f"connection to {self.address} lost"))
+                    ev.set()
+                self._pending.clear()
+
+    def call(self, method: str, payload: Any = None, timeout: float = _UNSET_TIMEOUT):
+        """timeout semantics: unset → config default; None → wait forever."""
+        if self._closed:
+            raise ConnectionLost(f"not connected to {self.address}")
+        with self._lock:
+            self._req_id += 1
+            req_id = self._req_id
+            ev = threading.Event()
+            self._pending[req_id] = ev
+        data = pickle.dumps(("req", req_id, method, payload), protocol=5)
+        with self._lock:
+            self._sock.sendall(_LEN.pack(len(data)) + data)
+        if timeout is _UNSET_TIMEOUT:
+            timeout = CONFIG.rpc_call_timeout_s
+        if not ev.wait(timeout):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise CallTimeout(f"{method} on {self.address} timed out after {timeout}s")
+        ok, result = self._results.pop(req_id)
+        if not ok:
+            raise result
+        return result
+
+    def push(self, method: str, payload: Any = None):
+        if self._closed:
+            raise ConnectionLost(f"not connected to {self.address}")
+        data = pickle.dumps(("push", method, payload), protocol=5)
+        with self._lock:
+            self._sock.sendall(_LEN.pack(len(data)) + data)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except Exception:
+            pass
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+    @property
+    def closed(self):
+        return self._closed

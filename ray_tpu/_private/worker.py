@@ -1,0 +1,729 @@
+"""The per-process runtime for drivers and workers.
+
+This is the equivalent of the reference's CoreWorker + python worker
+(reference: src/ray/core_worker/core_worker.h:166,
+python/ray/_private/worker.py:427 Worker singleton): object put/get/wait,
+task and actor-task submission, the task-execution loop on workers,
+client-side reference counting, and actor-handle routing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.common import ResourceSet, SchedulingStrategy, TaskSpec
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import StoreClient
+
+logger = logging.getLogger(__name__)
+
+FUNCTION_KV_NS = "fn"
+
+
+class ReferenceCounter:
+    """Owner-side local reference counts; frees cluster-wide at zero.
+
+    Objects whose refs have *escaped* this process (passed as task args or
+    pickled into other objects) are not freed eagerly — they are reclaimed
+    by per-job GC when the job ends (the job id is embedded in the object
+    id), a simplification of the reference's borrowing protocol
+    (reference: src/ray/core_worker/reference_count.h:64)."""
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self._counts: Dict[ObjectID, int] = {}
+        self._escaped: set = set()
+        self._lock = threading.Lock()
+        self._to_free: List[bytes] = []
+
+    def add_owned(self, object_id: ObjectID):
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def mark_escaped(self, object_id: ObjectID):
+        with self._lock:
+            self._escaped.add(object_id)
+
+    def remove_owned(self, object_id: ObjectID):
+        with self._lock:
+            c = self._counts.get(object_id)
+            if c is None:
+                return
+            if c <= 1:
+                del self._counts[object_id]
+                if object_id in self._escaped:
+                    self._escaped.discard(object_id)
+                    return  # reclaimed by per-job GC, not eagerly
+                self._to_free.append(object_id.binary())
+                if len(self._to_free) >= 100:
+                    self._flush_locked()
+            else:
+                self._counts[object_id] = c - 1
+
+    def _flush_locked(self):
+        batch, self._to_free = self._to_free, []
+        try:
+            if self._worker.gcs_client and not self._worker.gcs_client.closed:
+                self._worker.gcs_client.push("free_objects", batch)
+        except Exception:
+            pass
+
+    def flush(self):
+        with self._lock:
+            if self._to_free:
+                self._flush_locked()
+
+    def owned_count(self) -> int:
+        with self._lock:
+            return len(self._counts)
+
+
+class ActorStateCache:
+    """Tracks actor liveness from GCS pubsub; flushes queued submissions."""
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self._info: Dict[ActorID, dict] = {}
+        self._pending: Dict[ActorID, List[TaskSpec]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def on_update(self, info: dict):
+        actor_id = ActorID(info["actor_id"])
+        with self._lock:
+            self._info[actor_id] = info
+            pending = None
+            if info["state"] == "ALIVE":
+                pending = self._pending.pop(actor_id, None)
+            elif info["state"] == "DEAD":
+                pending = self._pending.pop(actor_id, None)
+        if pending:
+            if info["state"] == "ALIVE":
+                for spec in pending:
+                    self._worker._send_actor_task(spec, info)
+            else:
+                for spec in pending:
+                    self._worker._store_error_returns(
+                        spec, exceptions.ActorDiedError(f"Actor died: {info.get('death_cause')}")
+                    )
+
+    def get(self, actor_id: ActorID) -> Optional[dict]:
+        with self._lock:
+            return self._info.get(actor_id)
+
+    def set_initial(self, actor_id: ActorID, info: dict):
+        """Seed from an RPC lookup — never overwrite pubsub-fed state,
+        which is always at least as fresh."""
+        with self._lock:
+            self._info.setdefault(actor_id, info)
+
+    def submit_or_queue(self, actor_id: ActorID, spec: TaskSpec) -> Optional[dict]:
+        """Atomically: if the actor is in a terminal-ish state return its
+        info (caller sends or errors); otherwise queue the spec for the
+        flush in on_update.  Closes the read-then-queue race with pubsub."""
+        with self._lock:
+            info = self._info.get(actor_id)
+            if info is not None and info["state"] in ("ALIVE", "DEAD"):
+                return info
+            self._pending[actor_id].append(spec)
+            return None
+
+
+class Worker:
+    """One per process.  mode is "driver" or "worker"."""
+
+    def __init__(self):
+        self.mode: Optional[str] = None
+        self.connected = False
+        self.job_id: Optional[JobID] = None
+        self.worker_id = WorkerID.from_random()
+        self.node_id: Optional[NodeID] = None
+        self.namespace: str = "default"
+        self.session_info: dict = {}
+        self.gcs_client: Optional[rpc.RpcClient] = None
+        self.raylet_client: Optional[rpc.RpcClient] = None
+        self.store: Optional[StoreClient] = None
+        self.reference_counter = ReferenceCounter(self)
+        self.actor_cache = ActorStateCache(self)
+        self._raylet_clients: Dict[str, rpc.RpcClient] = {}
+        self._task_counter = 0
+        self._actor_seq: Dict[ActorID, int] = defaultdict(int)
+        self._lock = threading.RLock()
+        self._pushed_functions: set = set()
+        # Worker-mode execution state
+        self.current_task_id: Optional[TaskID] = None
+        self.current_spec: Optional[TaskSpec] = None
+        self._function_cache: Dict[bytes, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._exec_queue: "queue.Queue" = queue.Queue()
+        self._async_loop = None
+        self._async_loop_thread = None
+        self._exec_pool = None
+        self._shutdown_event = threading.Event()
+        self._intended_exit = False
+        self.runtime_context_info: dict = {}
+
+    # ------------------------------------------------------------------
+    # connection
+    # ------------------------------------------------------------------
+    def connect_driver(self, gcs_address: str, raylet_address: str, namespace: Optional[str], job_config: dict):
+        self.mode = "driver"
+        self.gcs_client = rpc.RpcClient(gcs_address, on_push=self._on_gcs_push)
+        reply = self.gcs_client.call(
+            "register_driver",
+            {"namespace": namespace, "entrypoint": " ".join(os.sys.argv), "config": job_config},
+        )
+        self.job_id = JobID(reply["job_id"])
+        self.namespace = reply["namespace"]
+        self.session_info = reply["session_info"]
+        self.gcs_client.call("subscribe", "actors")
+        self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push)
+        r = self.raylet_client.call(
+            "register_client",
+            {"job_id": self.job_id.binary(), "job_config": dict(job_config, session_dir=self.session_info.get("session_dir"))},
+        )
+        self.node_id = NodeID(r["node_id"])
+        self.store = StoreClient(self.raylet_client, r["store_dir"])
+        self.connected = True
+
+    def connect_worker(self):
+        """Called from default_worker.py using env vars set by the raylet."""
+        self.mode = "worker"
+        raylet_address = os.environ["RAY_TPU_RAYLET_ADDRESS"]
+        self.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+        self.job_id = JobID.from_hex(os.environ["RAY_TPU_JOB_ID"])
+        self.node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+        self.gcs_client = rpc.RpcClient(os.environ["RAY_TPU_GCS_ADDRESS"], on_push=self._on_gcs_push)
+        self.gcs_client.call("subscribe", "actors")
+        self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push)
+        reply = self.raylet_client.call("register_worker", {"worker_id": self.worker_id.binary()})
+        if not reply.get("ok"):
+            raise RuntimeError("raylet rejected worker registration")
+        job_config = reply.get("job_config", {})
+        self.namespace = job_config.get("namespace", "default")
+        self.session_info = {"session_dir": job_config.get("session_dir")}
+        self.store = StoreClient(self.raylet_client, os.environ["RAY_TPU_STORE_DIR"])
+        self.connected = True
+
+    def disconnect(self):
+        if not self.connected:
+            return
+        self.reference_counter.flush()
+        self.connected = False
+        for c in [self.gcs_client, self.raylet_client, *self._raylet_clients.values()]:
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        self._raylet_clients.clear()
+        self.gcs_client = None
+        self.raylet_client = None
+        self.store = None
+
+    # ------------------------------------------------------------------
+    # pushes
+    # ------------------------------------------------------------------
+    def _on_gcs_push(self, method: str, payload):
+        if method == "pubsub":
+            channel, msg = payload
+            if channel == "actors":
+                self.actor_cache.on_update(msg)
+
+    def _on_raylet_push(self, method: str, payload):
+        if method == "execute_task":
+            self._exec_queue.put(payload["spec"])
+        elif method == "exit":
+            self._intended_exit = True
+            self._shutdown_event.set()
+            self._exec_queue.put(None)
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        self._check_connected()
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling ray.put on an ObjectRef is not allowed.")
+        object_id = ObjectID.for_put(self.job_id)
+        meta, buffers = serialization.serialize(value)
+        self.store.put_serialized(object_id, meta, buffers)
+        return ObjectRef(object_id, owned=True)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        self._check_connected()
+        self._notify_blocked(True)
+        try:
+            out = []
+            deadline = time.monotonic() + timeout if timeout is not None else None
+            for ref in refs:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                tag, value = self.store.get_serialized(ref.id, remaining)
+                if tag == serialization.TAG_ERROR:
+                    if isinstance(value, exceptions.RayTaskError):
+                        raise value.as_instanceof_cause()
+                    raise value
+                out.append(value)
+            return out
+        finally:
+            self._notify_blocked(False)
+
+    async def get_async(self, ref: ObjectRef):
+        """Used by `await ref` inside async actors."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return (await loop.run_in_executor(None, lambda: self.get([ref])))[0]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int, timeout: Optional[float], fetch_local: bool = True):
+        self._check_connected()
+        if len(set(refs)) != len(refs):
+            raise ValueError("ray.wait requires a list of unique object refs.")
+        self._notify_blocked(True)
+        try:
+            ready_ids, _ = self.store.wait(
+                [r.id for r in refs], num_returns, timeout if timeout is not None else None
+            )
+        finally:
+            self._notify_blocked(False)
+        ready = [r for r in refs if r.id in ready_ids][:num_returns]
+        ready_set = set(ready)
+        not_ready = [r for r in refs if r not in ready_set]
+        return ready, not_ready
+
+    def _notify_blocked(self, blocked: bool):
+        """Release/reacquire this task's resources during blocking calls
+        (reference: CoreWorker NotifyDirectCallTaskBlocked)."""
+        if self.mode == "worker" and self.current_spec is not None and not self.current_spec.is_actor_task:
+            try:
+                self.raylet_client.push(
+                    "task_blocked" if blocked else "task_unblocked",
+                    {"task_id": self.current_spec.task_id.binary()},
+                )
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # function table
+    # ------------------------------------------------------------------
+    def _push_function(self, blob: bytes) -> bytes:
+        key = self.job_id.binary() + hashlib.sha1(blob).digest()
+        if key not in self._pushed_functions:
+            self.gcs_client.call("kv_put", (FUNCTION_KV_NS, key, blob, True))
+            self._pushed_functions.add(key)
+        return key
+
+    def _fetch_function(self, key: bytes):
+        fn = self._function_cache.get(key)
+        if fn is None:
+            blob = self.gcs_client.call("kv_get", (FUNCTION_KV_NS, key))
+            if blob is None:
+                raise exceptions.RaySystemError(f"function {key.hex()} missing from GCS")
+            fn = serialization.loads_function(blob)
+            self._function_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # task submission
+    # ------------------------------------------------------------------
+    def _serialize_args(self, args: Tuple, kwargs: Dict) -> List[Tuple[str, Any]]:
+        packed = []
+        for a in list(args) + ([kwargs] if kwargs else []):
+            if isinstance(a, ObjectRef):
+                # The ref escapes this process: exempt it from eager free so
+                # the in-flight task can't lose its argument.
+                self.reference_counter.mark_escaped(a.id)
+                packed.append(("ref", a.id.binary()))
+            else:
+                blob = serialization.serialize_to_bytes(a)
+                if len(blob) > CONFIG.max_direct_call_object_size:
+                    ref = self.put(a)
+                    self.reference_counter.mark_escaped(ref.id)
+                    packed.append(("ref", ref.id.binary()))
+                else:
+                    packed.append(("v", blob))
+        packed.append(("haskw", bool(kwargs)))
+        return packed
+
+    def _next_task_id(self) -> TaskID:
+        base_actor = self.actor_id or ActorID.nil_of(self.job_id)
+        return TaskID.of(base_actor)
+
+    def submit_task(self, fn_blob: bytes, name: str, args, kwargs, options: dict) -> List[ObjectRef]:
+        self._check_connected()
+        key = self._push_function(fn_blob)
+        num_returns = options.get("num_returns", 1)
+        resources = _resolve_resources(options, default_cpu=1.0)
+        spec = TaskSpec(
+            task_id=self._next_task_id(),
+            job_id=self.job_id,
+            name=name,
+            function_key=key,
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=options.get("max_retries", CONFIG.task_max_retries),
+            retry_exceptions=options.get("retry_exceptions", False),
+            scheduling_strategy=_resolve_strategy(options),
+            owner_worker_id=self.worker_id,
+            runtime_env=options.get("runtime_env"),
+        )
+        self.raylet_client.call("submit_task", {"spec": spec})
+        return [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, cls_blob: bytes, class_name: str, args, kwargs, options: dict) -> ActorID:
+        self._check_connected()
+        key = self._push_function(cls_blob)
+        actor_id = ActorID.of(self.job_id)
+        resources = _resolve_resources(options, default_cpu=0.0)
+        spec = TaskSpec(
+            task_id=TaskID.of(actor_id),
+            job_id=self.job_id,
+            name=class_name,
+            function_key=key,
+            args=self._serialize_args(args, kwargs),
+            num_returns=1,
+            resources=resources,
+            is_actor_creation=True,
+            actor_id=actor_id,
+            max_restarts=options.get("max_restarts", 0),
+            max_task_retries=options.get("max_task_retries", 0),
+            max_concurrency=options.get("max_concurrency", 1),
+            actor_name=options.get("name"),
+            namespace=options.get("namespace") or self.namespace,
+            detached=options.get("lifetime") == "detached",
+            scheduling_strategy=_resolve_strategy(options),
+            owner_worker_id=self.worker_id,
+            runtime_env=options.get("runtime_env"),
+        )
+        self.gcs_client.call("register_actor", {"spec": spec})
+        return actor_id
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict) -> List[ObjectRef]:
+        self._check_connected()
+        num_returns = options.get("num_returns", 1)
+        with self._lock:
+            self._actor_seq[actor_id] += 1
+            seq = self._actor_seq[actor_id]
+        spec = TaskSpec(
+            task_id=TaskID.of(actor_id),
+            job_id=self.job_id,
+            name=method_name,
+            function_key=b"",
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns,
+            resources=ResourceSet(),
+            is_actor_task=True,
+            actor_id=actor_id,
+            sequence_number=seq,
+            method_name=method_name,
+            owner_worker_id=self.worker_id,
+        )
+        refs = [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
+        if self.actor_cache.get(actor_id) is None:
+            info = self.gcs_client.call("get_actor_info", actor_id.binary())
+            if info is not None:
+                self.actor_cache.set_initial(actor_id, info)
+        info = self.actor_cache.submit_or_queue(actor_id, spec)
+        if info is None:
+            pass  # queued; flushed by the next pubsub state change
+        elif info["state"] == "DEAD":
+            self._store_error_returns(
+                spec, exceptions.ActorDiedError(f"Actor is dead: {info.get('death_cause')}")
+            )
+        else:
+            self._send_actor_task(spec, info)
+        return refs
+
+    def _send_actor_task(self, spec: TaskSpec, info: dict):
+        address = info["raylet_address"]
+        try:
+            client = self._get_raylet_client(address)
+            client.call("submit_task", {"spec": spec})
+        except rpc.RpcError:
+            self._store_error_returns(
+                spec, exceptions.ActorUnavailableError("Could not reach the actor's node")
+            )
+
+    def _get_raylet_client(self, address: str) -> rpc.RpcClient:
+        with self._lock:
+            c = self._raylet_clients.get(address)
+            if c is None or c.closed:
+                if address == self.raylet_client.address:
+                    return self.raylet_client
+                c = rpc.RpcClient(address)
+                self._raylet_clients[address] = c
+            return c
+
+    def _store_error_returns(self, spec: TaskSpec, err: Exception):
+        blob_meta, bufs = serialization.serialize(err, tag=serialization.TAG_ERROR)
+        for oid in spec.return_ids():
+            self.store.put_serialized(oid, blob_meta, bufs)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.gcs_client.call("kill_actor", {"actor_id": actor_id.binary(), "no_restart": no_restart})
+
+    def get_named_actor(self, name: str, namespace: Optional[str]):
+        ns = namespace or self.namespace
+        reply = self.gcs_client.call("get_named_actor", (ns, name))
+        if reply is None:
+            raise ValueError(f"Failed to look up actor '{name}' in namespace '{ns}'")
+        return reply
+
+    # ------------------------------------------------------------------
+    # worker-mode execution loop
+    # ------------------------------------------------------------------
+    def main_loop(self):
+        """Blocks forever executing tasks pushed by the raylet."""
+        while not self._shutdown_event.is_set():
+            try:
+                spec = self._exec_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if spec is None:
+                break
+            if spec.is_actor_task and self._exec_pool is not None:
+                self._exec_pool.submit(self._execute_task_guarded, spec)
+            elif spec.is_actor_task and self._async_loop is not None:
+                import asyncio
+
+                asyncio.run_coroutine_threadsafe(self._execute_task_async(spec), self._async_loop)
+            else:
+                self._execute_task_guarded(spec)
+        self.disconnect()
+
+    def _execute_task_guarded(self, spec: TaskSpec):
+        try:
+            self._execute_task(spec)
+        except BaseException:  # pragma: no cover — never crash the loop
+            traceback.print_exc()
+
+    def _resolve_args(self, spec: TaskSpec):
+        packed = spec.args
+        has_kwargs = False
+        values = []
+        for kind, payload in packed:
+            if kind == "haskw":
+                has_kwargs = payload
+                continue
+            if kind == "v":
+                _, value = serialization.deserialize(memoryview(payload))
+            elif kind == "ref":
+                tag, value = self.store.get_serialized(ObjectID(payload), None)
+                if tag == serialization.TAG_ERROR:
+                    raise value if not isinstance(value, exceptions.RayTaskError) else value.as_instanceof_cause()
+            values.append(value)
+        if has_kwargs:
+            kwargs = values.pop()
+        else:
+            kwargs = {}
+        return values, kwargs
+
+    def _execute_task(self, spec: TaskSpec):
+        self.current_spec = spec
+        self.current_task_id = spec.task_id
+        try:
+            if spec.is_actor_creation:
+                self._execute_actor_creation(spec)
+            elif spec.is_actor_task:
+                self._execute_actor_method(spec)
+            else:
+                self._execute_normal_task(spec)
+        finally:
+            self.current_spec = None
+            self.current_task_id = None
+            try:
+                self.raylet_client.call("task_done", {"task_id": spec.task_id.binary()})
+            except rpc.RpcError:
+                pass
+
+    def _store_returns(self, spec: TaskSpec, result: Any):
+        n = spec.num_returns
+        if n == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != n:
+                raise ValueError(f"Task {spec.name} returned {len(results)} values, expected {n}")
+        for oid, value in zip(spec.return_ids(), results):
+            meta, bufs = serialization.serialize(value)
+            self.store.put_serialized(oid, meta, bufs)
+
+    def _execute_normal_task(self, spec: TaskSpec):
+        try:
+            fn = self._fetch_function(spec.function_key)
+            args, kwargs = self._resolve_args(spec)
+            result = fn(*args, **kwargs)
+            self._store_returns(spec, result)
+        except Exception as e:  # noqa: BLE001
+            self._store_error_returns(spec, exceptions.RayTaskError.from_exception(e, spec.name))
+
+    def _execute_actor_creation(self, spec: TaskSpec):
+        try:
+            cls = self._fetch_function(spec.function_key)
+            args, kwargs = self._resolve_args(spec)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = spec.actor_id
+            # Set up concurrency: thread pool or asyncio loop.
+            has_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(type(self.actor_instance), inspect.isfunction)
+            )
+            if has_async:
+                import asyncio
+
+                self._async_loop = asyncio.new_event_loop()
+                self._async_sem = None
+                mc = spec.max_concurrency if spec.max_concurrency > 1 else 1000
+                self._async_concurrency = mc
+
+                def run_loop():
+                    asyncio.set_event_loop(self._async_loop)
+                    self._async_loop.run_forever()
+
+                self._async_loop_thread = threading.Thread(target=run_loop, daemon=True, name="actor-async-loop")
+                self._async_loop_thread.start()
+            elif spec.max_concurrency > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._exec_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency, thread_name_prefix="actor-exec")
+            self._store_returns(spec, None)
+        except Exception as e:  # noqa: BLE001
+            self._store_error_returns(spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.__init__"))
+
+    def _run_actor_method(self, spec: TaskSpec):
+        method = getattr(self.actor_instance, spec.method_name)
+        args, kwargs = self._resolve_args(spec)
+        return method(*args, **kwargs)
+
+    def _execute_actor_method(self, spec: TaskSpec):
+        try:
+            if spec.method_name == "__ray_terminate__":
+                self._store_returns(spec, None)
+                self._intended_exit = True
+                self._shutdown_event.set()
+                self._exec_queue.put(None)
+                return
+            result = self._run_actor_method(spec)
+            self._store_returns(spec, result)
+        except Exception as e:  # noqa: BLE001
+            self._store_error_returns(
+                spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}")
+            )
+
+    async def _execute_task_async(self, spec: TaskSpec):
+        """Async-actor path: methods run as coroutines on the actor loop
+        (reference: core_worker/transport/fiber.h — fibers → asyncio)."""
+        self.current_spec = spec
+        try:
+            if spec.method_name == "__ray_terminate__":
+                self._store_returns(spec, None)
+                self._shutdown_event.set()
+                self._exec_queue.put(None)
+                return
+            method = getattr(self.actor_instance, spec.method_name)
+            args, kwargs = self._resolve_args(spec)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self._store_returns(spec, result)
+        except Exception as e:  # noqa: BLE001
+            self._store_error_returns(
+                spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}")
+            )
+        finally:
+            self.current_spec = None
+            try:
+                self.raylet_client.call("task_done", {"task_id": spec.task_id.binary()})
+            except rpc.RpcError:
+                pass
+
+    def _check_connected(self):
+        if not self.connected:
+            raise exceptions.RaySystemError(
+                "ray_tpu has not been initialized. Call ray_tpu.init() first."
+            )
+
+
+def _resolve_resources(options: dict, default_cpu: float) -> ResourceSet:
+    res = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    res["CPU"] = default_cpu if num_cpus is None else num_cpus
+    if options.get("num_tpus") is not None:
+        res["TPU"] = options["num_tpus"]
+    if options.get("num_gpus") is not None:
+        res["GPU"] = options["num_gpus"]
+    if options.get("memory") is not None:
+        res["memory"] = options["memory"]
+    return ResourceSet.of(res)
+
+
+def _resolve_strategy(options: dict) -> SchedulingStrategy:
+    strategy = options.get("scheduling_strategy")
+    if strategy is None:
+        pg = options.get("placement_group")
+        if pg is not None:
+            from ray_tpu.util.placement_group import PlacementGroup
+
+            assert isinstance(pg, PlacementGroup)
+            return SchedulingStrategy(
+                kind="PLACEMENT_GROUP",
+                placement_group_id=pg.id,
+                bundle_index=options.get("placement_group_bundle_index", -1),
+            )
+        return SchedulingStrategy()
+    if isinstance(strategy, str):
+        if strategy == "SPREAD":
+            return SchedulingStrategy(kind="SPREAD")
+        if strategy == "DEFAULT":
+            return SchedulingStrategy()
+        raise ValueError(f"unknown scheduling strategy {strategy}")
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=strategy.placement_group.id,
+            bundle_index=strategy.placement_group_bundle_index,
+            capture_child_tasks=strategy.placement_group_capture_child_tasks,
+        )
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(
+            kind="NODE_AFFINITY", node_id=NodeID(bytes.fromhex(strategy.node_id)), soft=strategy.soft
+        )
+    raise ValueError(f"unknown scheduling strategy {strategy!r}")
+
+
+_global_worker: Optional[Worker] = None
+_worker_lock = threading.Lock()
+
+
+def get_global_worker() -> Worker:
+    global _global_worker
+    with _worker_lock:
+        if _global_worker is None:
+            _global_worker = Worker()
+        return _global_worker
+
+
+def global_worker_maybe() -> Optional[Worker]:
+    return _global_worker

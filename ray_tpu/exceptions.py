@@ -1,0 +1,111 @@
+"""Public exception types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayError(Exception):
+    """Base for all ray_tpu errors."""
+
+
+class RayTaskError(RayError):
+    """A task raised; re-raised at `ray.get` on the caller.
+
+    Wraps the original exception with the remote traceback (reference:
+    python/ray/exceptions.py RayTaskError.as_instanceof_cause)."""
+
+    def __init__(self, function_name: str = "", traceback_str: str = "", cause: BaseException = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"{function_name} failed:\n{traceback_str}")
+
+    @classmethod
+    def from_exception(cls, e: BaseException, function_name: str) -> "RayTaskError":
+        return cls(function_name, traceback.format_exc(), e)
+
+    def as_instanceof_cause(self):
+        """Return an exception that is also an instance of the cause's class
+        so `except UserError` works across the task boundary."""
+        cause = self.cause
+        if cause is None or isinstance(cause, RayError):
+            return self
+        cls = type(cause)
+        try:
+            derived = type(
+                "RayTaskError(" + cls.__name__ + ")",
+                (RayTaskError, cls),
+                {"__init__": lambda s: None},
+            )()
+            derived.function_name = self.function_name
+            derived.traceback_str = self.traceback_str
+            derived.cause = cause
+            derived.args = (f"{self.function_name} failed:\n{self.traceback_str}",)
+            return derived
+        except TypeError:
+            return self
+
+
+class RayActorError(RayError):
+    """The actor died before or during this method call."""
+
+    def __init__(self, message: str = "The actor died unexpectedly.", actor_id=None):
+        self.actor_id = actor_id
+        super().__init__(message)
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    pass
+
+
+class WorkerCrashedError(RayError):
+    """The worker process executing the task died (e.g. SIGKILL/OOM)."""
+
+
+class ObjectLostError(RayError):
+    def __init__(self, object_id=None, message=None):
+        self.object_id = object_id
+        super().__init__(message or f"Object {object_id} was lost (evicted or node died).")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled.")
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class NodeDiedError(RayError):
+    pass
+
+
+class RaySystemError(RayError):
+    pass
+
+
+class OutOfMemoryError(RayError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayError):
+    pass

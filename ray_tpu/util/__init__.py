@@ -1,0 +1,42 @@
+"""ray_tpu.util — core extensions (reference: python/ray/util/)."""
+
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+    "ActorPool",
+]
+
+
+def __getattr__(name):
+    if name == "ActorPool":
+        from ray_tpu.util.actor_pool import ActorPool
+
+        return ActorPool
+    if name == "collective":
+        import importlib
+
+        return importlib.import_module("ray_tpu.util.collective")
+    if name == "state":
+        import importlib
+
+        return importlib.import_module("ray_tpu.util.state")
+    if name == "metrics":
+        import importlib
+
+        return importlib.import_module("ray_tpu.util.metrics")
+    raise AttributeError(name)

@@ -1,0 +1,211 @@
+"""Core API tests (model: reference python/ray/tests/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get_small(ray_cluster):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_cluster):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # Zero-copy: the result must be backed by the shared-memory mapping.
+    assert not out.flags["OWNDATA"]
+
+
+def test_simple_task(ray_cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_with_ref_arg(ray_cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    r1 = f.remote(10)
+    r2 = f.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_task_large_arg_roundtrip(ray_cluster):
+    arr = np.ones((512, 512), dtype=np.float32)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == float(arr.sum())
+
+
+def test_multiple_returns(ray_cluster):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get(a) == 1
+    assert ray_tpu.get(b) == 2
+
+
+def test_task_error_propagation(ray_cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_wait(ray_cluster):
+    import time
+
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_nested_tasks(ray_cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_options_override(ray_cluster):
+    @ray_tpu.remote
+    def f():
+        return ray_tpu.get_runtime_context().get_assigned_resources()
+
+    res = ray_tpu.get(f.options(num_cpus=2).remote())
+    assert res.get("CPU") == 2
+
+
+def test_cluster_resources(ray_cluster):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
+    assert len(ray_tpu.nodes()) == 1
+
+
+class TestActors:
+    def test_actor_basic(self, ray_cluster):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start=0):
+                self.n = start
+
+            def incr(self, by=1):
+                self.n += by
+                return self.n
+
+            def value(self):
+                return self.n
+
+        c = Counter.remote(10)
+        assert ray_tpu.get(c.incr.remote()) == 11
+        assert ray_tpu.get(c.incr.remote(5)) == 16
+        assert ray_tpu.get(c.value.remote()) == 16
+
+    def test_actor_ordering(self, ray_cluster):
+        @ray_tpu.remote
+        class Seq:
+            def __init__(self):
+                self.log = []
+
+            def add(self, x):
+                self.log.append(x)
+                return len(self.log)
+
+            def get_log(self):
+                return self.log
+
+        s = Seq.remote()
+        for i in range(20):
+            s.add.remote(i)
+        assert ray_tpu.get(s.get_log.remote()) == list(range(20))
+
+    def test_named_actor(self, ray_cluster):
+        @ray_tpu.remote
+        class Store:
+            def __init__(self):
+                self.d = {}
+
+            def set(self, k, v):
+                self.d[k] = v
+
+            def get(self, k):
+                return self.d.get(k)
+
+        Store.options(name="kvstore").remote()
+        h = ray_tpu.get_actor("kvstore")
+        ray_tpu.get(h.set.remote("x", 42))
+        assert ray_tpu.get(h.get.remote("x")) == 42
+        ray_tpu.kill(h)
+
+    def test_actor_error(self, ray_cluster):
+        @ray_tpu.remote
+        class Bad:
+            def fail(self):
+                raise RuntimeError("actor error")
+
+        b = Bad.remote()
+        with pytest.raises(RuntimeError, match="actor error"):
+            ray_tpu.get(b.fail.remote())
+
+    def test_actor_kill(self, ray_cluster):
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        ray_tpu.kill(a)
+        import time
+
+        time.sleep(1.0)
+        with pytest.raises(ray_tpu.exceptions.RayActorError):
+            ray_tpu.get(a.ping.remote(), timeout=10)
+
+    def test_actor_handle_pass(self, ray_cluster):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        @ray_tpu.remote
+        def bump(counter):
+            return ray_tpu.get(counter.incr.remote())
+
+        c = Counter.remote()
+        assert ray_tpu.get(bump.remote(c)) == 1
+        assert ray_tpu.get(c.incr.remote()) == 2
