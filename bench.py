@@ -1,0 +1,86 @@
+"""Headline benchmark: GPT-2 training throughput, tokens/sec/chip.
+
+This is the north-star metric from BASELINE.json ("Ray Train GPT-2
+tokens/sec/chip").  The reference publishes no TPU numbers
+(BASELINE.md: published = {}), so vs_baseline normalizes against the
+reference's NCCL/GPU-era equivalent: ~51k tokens/sec/chip for GPT-2-small
+with torch DDP on an A100-class device (6*N*tok/s at ~40% MFU of 312
+TFLOPs bf16).  A v5e chip (197 TFLOPs bf16) at the same MFU would be
+~0.63 of that; vs_baseline > 0.63 therefore means better MFU than the
+reference stack.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+GPU_BASELINE_TOKENS_PER_SEC = 51000.0
+
+
+def main():
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        # Env names a backend whose plugin isn't registered (e.g. a
+        # stripped PYTHONPATH): let jax pick whatever is available.
+        jax.config.update("jax_platforms", "")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import create_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_dev = len(jax.devices())
+    if on_tpu:
+        cfg = gpt2.GPT2Config(max_seq_len=1024)  # GPT-2 small, 124M, bf16
+        B, T, steps = 16, 1024, 10
+    else:  # CI fallback: tiny model so the line still prints quickly
+        cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+        B, T, steps = 4, 64, 3
+
+    mesh = create_mesh({"dp": n_dev}, jax.devices())
+    opt = gpt2.make_adamw(lr=3e-4)
+    params, opt_state, specs = gpt2.make_sharded_train_state(cfg, mesh, opt)
+    step = gpt2.make_sharded_train_step(cfg, mesh, opt)
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (B, T + 1), dtype=np.int32)
+    tokens, targets = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+
+    # Warmup / compile.  Sync via device_get: block_until_ready is not a
+    # reliable barrier on tunneled backends.
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(jax.device_get(loss))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    # The final loss depends on the whole step chain, so fetching it
+    # synchronizes every timed step.
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * T * steps / dt
+    per_chip = tokens_per_sec / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_small_train_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(per_chip / GPU_BASELINE_TOKENS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

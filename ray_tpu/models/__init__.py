@@ -1,0 +1,15 @@
+"""ray_tpu.models — JAX/Flax model families for Train/RLlib/Serve.
+
+Flagship: GPT-2 (ray_tpu.models.gpt2) — the north-star pretraining target.
+Also: MLP (MNIST), ResNet (CIFAR), and RLlib policy/value nets.
+"""
+
+__all__ = ["gpt2", "mlp", "resnet"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.models.{name}")
+    raise AttributeError(name)

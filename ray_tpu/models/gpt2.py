@@ -1,0 +1,235 @@
+"""GPT-2 in Flax, TPU-first.
+
+The north-star workload ("Ray Train GPT-2 tokens/sec/chip",
+BASELINE.json).  Design notes:
+
+- bf16 compute / f32 params+optimizer (MXU-native precision).
+- Param names line up with ray_tpu.parallel.sharding.gpt_sharding_rules
+  (qkv / attn_out / mlp_up / mlp_down / wte / wpe / lm_head) so TP/FSDP
+  layouts come from one rule table.
+- `remat` wraps each block with jax.checkpoint to trade FLOPs for HBM.
+- Attention goes through ray_tpu.ops.attention which picks a fused
+  implementation (Pallas splash/ring kernel on TPU, reference einsum
+  elsewhere); sequence parallelism shards the seq dim over the "sp"
+  mesh axis.
+- Static shapes everywhere; the block stack uses a Python loop (unrolled
+  by trace) — swap to nn.scan for very deep configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # 50257 padded to a multiple of 128 for the MXU
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_bias: bool = True
+    # Sequence parallelism: when mesh has a >1 `sp_axis`, attention runs
+    # as ring attention over it (ops.ring_attention).  Mesh is static
+    # metadata for tracing (hashable, compared by identity of devices).
+    mesh: Any = None
+    sp_axis: Optional[str] = None
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        return GPT2Config(vocab_size=512, n_layer=2, n_head=4, d_model=128, max_seq_len=128, **kw)
+
+    @staticmethod
+    def small(**kw) -> "GPT2Config":
+        return GPT2Config(**kw)  # 124M
+
+    @staticmethod
+    def medium(**kw) -> "GPT2Config":
+        return GPT2Config(n_layer=24, n_head=16, d_model=1024, **kw)  # 350M
+
+    @staticmethod
+    def large(**kw) -> "GPT2Config":
+        return GPT2Config(n_layer=36, n_head=20, d_model=1280, **kw)  # 774M
+
+
+class Attention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        d_head = cfg.d_model // cfg.n_head
+        qkv = nn.Dense(3 * cfg.d_model, use_bias=cfg.use_bias, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, T = x.shape[0], x.shape[1]
+
+        def heads(t):
+            return t.reshape(B, T, cfg.n_head, d_head)
+
+        from ray_tpu.ops.attention import causal_attention
+
+        out = causal_attention(
+            heads(q), heads(k), heads(v), mesh=cfg.mesh, sp_axis=cfg.sp_axis
+        )
+        out = out.reshape(B, T, cfg.d_model)
+        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="attn_out")(out)
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(4 * cfg.d_model, use_bias=cfg.use_bias, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="mlp_up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="mlp_down")(h)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_1")(x)
+        )
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_2")(x)
+        )
+        return x
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, T = tokens.shape
+        pos = jnp.arange(T)[None, :]
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        x = wte(tokens)
+        x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="wpe")(pos)
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits
+
+
+def init_params(cfg: GPT2Config, rng=None, batch: int = 2):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    tokens = jnp.zeros((batch, min(cfg.max_seq_len, 128)), dtype=jnp.int32)
+    return GPT2(cfg).init(rng, tokens)["params"]
+
+
+def loss_fn(params, tokens, targets, cfg: GPT2Config):
+    """Next-token cross entropy; targets = tokens shifted by caller."""
+    logits = GPT2(cfg).apply({"params": params}, tokens)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def make_train_step(cfg: GPT2Config, optimizer):
+    """Returns train_step(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss).  Pure; callers jit it with shardings."""
+
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def make_adamw(lr: float = 3e-4, weight_decay: float = 0.1):
+    import optax
+
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+
+
+def make_sharded_train_state(cfg: GPT2Config, mesh, optimizer, rng=None, batch: int = 2):
+    """Initialize params + opt state directly ON the mesh with the
+    Megatron-style layout from parallel.sharding (no host-side giant
+    arrays; init is jitted with output shardings)."""
+    from jax.sharding import NamedSharding
+
+    from ray_tpu.parallel.sharding import gpt_sharding_rules, infer_param_spec, tree_shardings
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    tokens = jnp.zeros((batch, min(cfg.max_seq_len, 128)), dtype=jnp.int32)
+
+    def init_fn(rng):
+        return GPT2(cfg).init(rng, tokens)["params"]
+
+    abstract = jax.eval_shape(init_fn, rng)
+    specs = infer_param_spec(abstract, gpt_sharding_rules(), mesh)
+    shardings = tree_shardings(mesh, specs)
+    params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    opt_state = jax.jit(optimizer.init)(params)  # follows param shardings
+    return params, opt_state, specs
+
+
+def make_sharded_train_step(cfg: GPT2Config, mesh, optimizer):
+    """jit-compiled SPMD train step: dp/fsdp over batch, tp over hidden,
+    sp over sequence (ring attention), donated state.  Param/opt layouts
+    come from the committed shardings set by make_sharded_train_state."""
+    from jax.sharding import NamedSharding
+
+    from ray_tpu.parallel.sharding import batch_spec
+
+    step = make_train_step(cfg, optimizer)
+    data_sharding = NamedSharding(mesh, batch_spec(mesh))
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+
+    def run(params, opt_state, tokens, targets):
+        # Batch placement is explicit (dp over batch, sp over sequence);
+        # params/opt_state carry their committed shardings from init.
+        tokens = jax.device_put(tokens, data_sharding)
+        targets = jax.device_put(targets, data_sharding)
+        return jitted(params, opt_state, tokens, targets)
+
+    run.data_sharding = data_sharding
+    return run
+
+
+def num_params(params) -> int:
+    return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))
+
+
+def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
+    """Approximate training FLOPs/token: 6*N + attention term."""
+    n = (
+        cfg.n_layer * (12 * cfg.d_model**2)
+        + cfg.vocab_size * cfg.d_model * 2
+        + cfg.max_seq_len * cfg.d_model
+    )
+    attn = cfg.n_layer * 12 * seq_len * cfg.d_model  # fwd+bwd attention matmuls
+    return 6.0 * n + attn

@@ -1,0 +1,60 @@
+"""MLP classifier (MNIST-class) — the minimum end-to-end Train model
+(BASELINE.json configs[0]: "DataParallelTrainer MNIST MLP (CPU, 2 workers)").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Tuple[int, ...] = (256, 256)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+class MLPNet(nn.Module):
+    cfg: MLPConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x.reshape(x.shape[0], -1).astype(cfg.dtype)
+        for i, h in enumerate(cfg.hidden):
+            x = nn.relu(nn.Dense(h, dtype=cfg.dtype, name=f"dense_{i}")(x))
+        return nn.Dense(cfg.num_classes, dtype=cfg.dtype, name="head")(x)
+
+
+def init_params(cfg: MLPConfig, rng=None):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    x = jnp.zeros((1, cfg.in_dim), cfg.dtype)
+    return MLPNet(cfg).init(rng, x)["params"]
+
+
+def loss_fn(params, x, y, cfg: MLPConfig):
+    logits = MLPNet(cfg).apply({"params": params}, x)
+    logp = jax.nn.log_softmax(logits)
+    onehot = jax.nn.one_hot(y, cfg.num_classes)
+    return -(onehot * logp).sum(axis=-1).mean()
+
+
+def accuracy(params, x, y, cfg: MLPConfig):
+    logits = MLPNet(cfg).apply({"params": params}, x)
+    return (logits.argmax(-1) == y).mean()
+
+
+def make_train_step(cfg: MLPConfig, optimizer):
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return step

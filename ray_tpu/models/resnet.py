@@ -1,0 +1,132 @@
+"""ResNet for CIFAR (BASELINE.json configs[1]: "JaxTrainer ResNet-50 /
+CIFAR-10 (single v5e-8)").  Standard pre-activation-free ResNet with
+BatchNorm; NHWC layout (TPU-native) and bf16 compute / f32 params.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)  # resnet18
+    num_filters: int = 64
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bottleneck: bool = False
+
+    @staticmethod
+    def resnet18(**kw):
+        return ResNetConfig(stage_sizes=(2, 2, 2, 2), bottleneck=False, **kw)
+
+    @staticmethod
+    def resnet50(**kw):
+        return ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=True, **kw)
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    cfg: ResNetConfig
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides, padding="SAME")(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), self.strides, name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    cfg: ResNetConfig
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        residual = x
+        y = nn.relu(norm()(conv(self.filters, (1, 1))(x)))
+        y = nn.relu(norm()(conv(self.filters, (3, 3), self.strides, padding="SAME")(y)))
+        y = norm(scale_init=nn.initializers.zeros_init())(conv(4 * self.filters, (1, 1))(y))
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1), self.strides, name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.num_filters, (3, 3), use_bias=False, padding="SAME",
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="stem")(x)
+        x = nn.relu(
+            nn.BatchNorm(use_running_average=not train, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="stem_bn")(x)
+        )
+        block = BottleneckBlock if cfg.bottleneck else ResNetBlock
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(cfg.num_filters * 2**i, cfg, strides, name=f"stage{i}_block{j}")(
+                    x, train
+                )
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="head")(x)
+
+
+def init_variables(cfg: ResNetConfig, rng=None, image_shape=(1, 32, 32, 3)):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    x = jnp.zeros(image_shape, jnp.float32)
+    return ResNet(cfg).init(rng, x, train=True)
+
+
+def loss_fn(params, batch_stats, x, y, cfg: ResNetConfig):
+    logits, new_state = ResNet(cfg).apply(
+        {"params": params, "batch_stats": batch_stats}, x, train=True,
+        mutable=["batch_stats"],
+    )
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(y, cfg.num_classes)
+    return -(onehot * logp).sum(-1).mean(), new_state["batch_stats"]
+
+
+def make_train_step(cfg: ResNetConfig, optimizer):
+    def step(params, batch_stats, opt_state, x, y):
+        (loss, batch_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, x, y, cfg
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, batch_stats, opt_state, loss
+
+    return step

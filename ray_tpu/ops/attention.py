@@ -1,0 +1,66 @@
+"""Causal attention dispatch.
+
+One entry point for all models: picks the best implementation for the
+placement —
+
+- sequence sharded over an "sp" mesh axis → ring attention
+  (ops.ring_attention, shard_map + ppermute over the ICI ring);
+- single-device / GSPMD-sharded → Pallas flash kernel on TPU when shapes
+  allow (ops.pallas_attention), else the XLA einsum reference (which XLA
+  fuses well on its own).
+
+All paths: f32 accumulation, bf16 in/out, static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_causal_attention(q, k, v):
+    """[B, T, H, D] einsum attention with causal mask; f32 softmax."""
+    B, T, H, D = q.shape
+    scale = 1.0 / (D**0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    qi = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    mask = (ki <= qi)[None, None, :, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q, k, v, *, mesh=None, sp_axis: Optional[str] = None):
+    """Main entry: [B, T, H, D] → [B, T, H, D], causal.
+
+    When `mesh` has a >1 `sp_axis`, T is assumed sharded over it and ring
+    attention runs over that axis (other mesh axes stay under GSPMD).
+    """
+    if mesh is not None and sp_axis and mesh.shape.get(sp_axis, 1) > 1:
+        from ray_tpu.ops.ring_attention import ring_causal_attention
+
+        return ring_causal_attention(q, k, v, mesh=mesh, axis=sp_axis)
+    if _use_pallas(q):
+        from ray_tpu.ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    return reference_causal_attention(q, k, v)
+
+
+def _use_pallas(q) -> bool:
+    import os
+
+    if os.environ.get("RAY_TPU_DISABLE_PALLAS"):
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    B, T, H, D = q.shape
+    # Tuned for the MXU: D a multiple of 64 (64/128 head dims), T a
+    # multiple of the 256-wide q/k blocks.
+    return T >= 256 and T % 256 == 0 and D % 64 == 0 and D <= 256

@@ -1,0 +1,69 @@
+"""Ring attention: exact causal attention with the sequence dim sharded
+over a mesh axis.
+
+Sequence/context parallelism is absent from the reference (SURVEY.md §5
+verified no ring-attention/Ulysses anywhere); on TPU it is a first-class
+capability: K/V blocks rotate around the ICI ring via `ppermute` while
+each device keeps a flash-style online-softmax accumulator, so memory per
+device is O(T/n) and the compute/communication overlap rides the torus.
+
+Only the `axis` mesh axis is manual (shard_map `axis_names={axis}`);
+dp/tp/fsdp stay under GSPMD, so this composes with tensor parallelism.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_causal_attention(q, k, v, *, mesh: Mesh, axis: str = "sp"):
+    """[B, T, H, D] with T sharded over `axis` → same sharding out."""
+    n = mesh.shape[axis]
+    spec = P(None, axis, None, None)
+
+    def local_fn(ql, kl, vl):
+        B, Tl, H, D = ql.shape
+        me = jax.lax.axis_index(axis)
+        scale = 1.0 / (D**0.5)
+        o = jnp.zeros((B, Tl, H, D), jnp.float32)
+        m = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, Tl), jnp.float32)
+
+        def step(i, carry):
+            k_blk, v_blk, o, m, l = carry
+            src = (me - i) % n
+            qpos = me * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+            kpos = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+            mask = kpos <= qpos
+            scores = jnp.einsum("bqhd,bkhd->bhqk", ql, k_blk).astype(jnp.float32) * scale
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            p = jnp.where(mask[None, None], jnp.exp(scores - m_new[..., None]), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + p.sum(axis=-1)
+            o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhqk,bkhd->bqhd", p.astype(k_blk.dtype), v_blk
+            ).astype(jnp.float32)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_next = jax.lax.ppermute(k_blk, axis, perm)
+            v_next = jax.lax.ppermute(v_blk, axis, perm)
+            return (k_next, v_next, o_new, m_new, l_new)
+
+        k_blk, v_blk, o, m, l = jax.lax.fori_loop(0, n, step, (kl, vl, o, m, l))
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(ql.dtype)
+
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False,
+    )
+    return fn(q, k, v)

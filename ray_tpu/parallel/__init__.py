@@ -1,0 +1,35 @@
+"""ray_tpu.parallel — mesh + sharding utilities for SPMD training.
+
+This is the TPU-native replacement for the reference's torch DDP/FSDP
+wrappers and NCCL process groups (reference:
+python/ray/train/torch/train_loop_utils.py:162 prepare_model,
+python/ray/train/torch/config.py:153): instead of wrapping a model in a
+communication library, we place arrays on a `jax.sharding.Mesh` and let
+XLA insert ICI collectives.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshConfig,
+    auto_mesh_shape,
+    create_mesh,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    batch_spec,
+    infer_param_spec,
+    shard_tree,
+    tree_shardings,
+)
+
+__all__ = [
+    "MeshConfig",
+    "auto_mesh_shape",
+    "create_mesh",
+    "local_mesh",
+    "ShardingRules",
+    "batch_spec",
+    "infer_param_spec",
+    "shard_tree",
+    "tree_shardings",
+]

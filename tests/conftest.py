@@ -2,10 +2,20 @@ import os
 
 # Library tests (train/models/parallel) run JAX on a virtual 8-device CPU
 # mesh; core tests never import jax.  Must be set before any jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: the environment may pin JAX_PLATFORMS to a real TPU
+# backend via sitecustomize (which imports jax before this file runs).
+# Env assignments cover spawned worker processes; config.update covers
+# this process, where jax is already imported.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 import pytest  # noqa: E402
 

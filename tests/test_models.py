@@ -1,0 +1,85 @@
+"""GPT-2 model + sharded train step on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+def _batch(cfg, B=4, T=64, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, cfg.vocab_size, size=(B, T + 1), dtype=np.int32)
+    return jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:])
+
+
+def test_gpt2_forward_shapes():
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg)
+    tokens, _ = _batch(cfg, B=2, T=32)
+    logits = gpt2.GPT2(cfg).apply({"params": params}, tokens)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits, dtype=np.float32)).all()
+
+
+def test_gpt2_sharded_train_step_dp_tp_sp():
+    """Full dp×tp×sp train step: params tp/fsdp-sharded, batch dp-sharded,
+    sequence sp-sharded through ring attention; loss decreases."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import create_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = create_mesh({"dp": 2, "tp": 2, "sp": 2})
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32, mesh=mesh, sp_axis="sp")
+    opt = gpt2.make_adamw(lr=1e-2)
+    params, opt_state, specs = gpt2.make_sharded_train_state(cfg, mesh, opt)
+    step = gpt2.make_sharded_train_step(cfg, mesh, opt)
+    tokens, targets = _batch(cfg, B=4, T=64)
+    losses = []
+    for i in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_gpt2_tp_matches_single_device():
+    """The sharded forward must compute the same function as unsharded."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import create_mesh
+    from ray_tpu.parallel.sharding import gpt_sharding_rules, infer_param_spec, shard_tree
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init_params(cfg)
+    tokens, _ = _batch(cfg, B=2, T=32)
+    ref = gpt2.GPT2(cfg).apply({"params": params}, tokens)
+
+    mesh = create_mesh({"dp": 2, "tp": 2})
+    specs = infer_param_spec(params, gpt_sharding_rules(), mesh)
+    sharded = shard_tree(params, mesh, specs)
+    out = jax.jit(lambda p, t: gpt2.GPT2(cfg).apply({"params": p}, t))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_param_sharding_rules_hit_tp_axes():
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import create_mesh
+    from ray_tpu.parallel.sharding import gpt_sharding_rules, infer_param_spec
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    abstract = jax.eval_shape(lambda: gpt2.init_params(cfg))
+    specs = infer_param_spec(abstract, gpt_sharding_rules(), mesh)
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]}
+    qkv = [s for p, s in flat.items() if "qkv/kernel" in p]
+    assert qkv and all("tp" in str(s) for s in qkv), flat
+    down = [s for p, s in flat.items() if "mlp_down/kernel" in p]
+    assert down and all(str(s).startswith("PartitionSpec('tp'") for s in down)
