@@ -1,0 +1,12 @@
+"""Pluggable accelerator managers (reference:
+python/ray/_private/accelerators/accelerator.py:5)."""
+
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+__all__ = ["TPUAcceleratorManager", "get_accelerator_manager"]
+
+
+def get_accelerator_manager(resource_name: str):
+    if resource_name == "TPU":
+        return TPUAcceleratorManager
+    return None

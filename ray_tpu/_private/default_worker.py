@@ -10,6 +10,17 @@ import sys
 
 def main():
     logging.basicConfig(level=logging.INFO, format="[worker %(asctime)s] %(message)s")
+    import os
+    import sys as _sys
+
+    # A sitecustomize may have imported jax and pinned a platform before
+    # this runs; the job's JAX_PLATFORMS env must win in workers.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms and "jax" in _sys.modules:
+        try:
+            _sys.modules["jax"].config.update("jax_platforms", platforms)
+        except Exception:
+            pass
     from ray_tpu._private.worker import get_global_worker
 
     worker = get_global_worker()

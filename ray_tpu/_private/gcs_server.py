@@ -280,6 +280,10 @@ class GcsServer:
             except Exception:
                 pass
 
+    async def rpc_get_job_config(self, payload, conn):
+        job = self.jobs.get(JobID(payload))
+        return job["config"] if job else {}
+
     async def rpc_list_jobs(self, payload, conn):
         return [dict(j, job_id=j["job_id"]) for j in self.jobs.values()]
 

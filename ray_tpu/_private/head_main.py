@@ -26,6 +26,7 @@ def main():
     parser.add_argument("--store-dir", required=True)
     parser.add_argument("--resources", required=True)
     parser.add_argument("--config", default="")
+    parser.add_argument("--owner-pid", type=int, default=0)
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s")
@@ -43,6 +44,7 @@ def main():
         store_dir=args.store_dir,
         resources=json.loads(args.resources),
         is_head=True,
+        session_dir=args.session_dir,
         loop=loop,
     )
 
@@ -54,9 +56,17 @@ def main():
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
 
+
     async def run():
         await gcs.start()
         await raylet.start()
+        from ray_tpu._private.node import owner_watchdog
+
+        watchdog_task = (
+            asyncio.ensure_future(owner_watchdog(args.owner_pid, stop_event))
+            if args.owner_pid
+            else None
+        )
         await stop_event.wait()
         try:
             await asyncio.wait_for(raylet.stop(), timeout=4)

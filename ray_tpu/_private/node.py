@@ -151,6 +151,7 @@ def start_head(
             "--store-dir", store_dir,
             "--resources", json.dumps(res),
             "--config", CONFIG.dump(),
+            "--owner-pid", str(os.getpid()),
         ],
         stdout=log,
         stderr=subprocess.STDOUT,
@@ -193,6 +194,7 @@ def start_worker_node(
             "--store-dir", store_dir,
             "--resources", json.dumps(res),
             "--config", CONFIG.dump(),
+            "--owner-pid", str(os.getpid()),
         ],
         stdout=log,
         stderr=subprocess.STDOUT,
@@ -256,3 +258,18 @@ def head_raylet_address(gcs_address: str) -> str:
         return nodes[0]["raylet_address"]
     finally:
         client.close()
+
+
+async def owner_watchdog(owner_pid: int, stop_event):
+    """Tear the cluster down if its owner process dies without a clean
+    shutdown (SIGKILL skips atexit).  Shared by head_main/raylet_main;
+    callers must hold a strong reference to the task."""
+    import asyncio
+
+    while True:
+        await asyncio.sleep(2)
+        try:
+            os.kill(owner_pid, 0)
+        except OSError:
+            stop_event.set()
+            return

@@ -68,6 +68,7 @@ class Raylet:
         resources: Dict[str, float],
         labels: Dict[str, str] = None,
         is_head: bool = False,
+        session_dir: str = None,
         loop=None,
     ):
         self.node_id = node_id
@@ -78,6 +79,10 @@ class Raylet:
         self.server.on_disconnect = self._on_disconnect
         self.is_head = is_head
         self.labels = labels or {}
+        self.session_dir = session_dir or os.path.dirname(store_dir)
+        # Invoked (from the event loop) when the GCS connection is lost —
+        # service mains wire this to process shutdown.
+        self.on_fatal = None
 
         self.resources_total = ResourceSet.of(resources)
         self.resources_available = self.resources_total.copy()
@@ -124,6 +129,7 @@ class Raylet:
         await self.server.start()
         self.gcs = rpc.AsyncRpcClient(self.gcs_address)
         self.gcs.on_push = self._on_gcs_push
+        self.gcs.on_close = lambda: self.on_fatal() if self.on_fatal else None
         await self.gcs.connect()
         await self.gcs.call(
             "register_node",
@@ -261,9 +267,7 @@ class Raylet:
         env["RAY_TPU_JOB_ID"] = job_id.hex()
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
         env["RAY_TPU_STORE_DIR"] = self.store.store_dir
-        job_config = self.job_configs.get(job_id, {})
-        session_dir = job_config.get("session_dir") or os.path.dirname(self.store.store_dir)
-        log_dir = os.path.join(session_dir, "logs")
+        log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
         proc = subprocess.Popen(
@@ -286,6 +290,15 @@ class Raylet:
         if w is None:
             # Driver registering as a worker-like client, or unknown.
             return {"ok": False}
+        if w.job_id not in self.job_configs:
+            # Worker of a job whose driver registered at another raylet:
+            # the job config (incl. driver_sys_path) lives in the GCS.
+            try:
+                self.job_configs[w.job_id] = await self.gcs.call(
+                    "get_job_config", w.job_id.binary(), timeout=10
+                )
+            except rpc.RpcError:
+                pass
         self.num_starting = max(0, self.num_starting - 1)
         w.conn = conn
         w.state = "IDLE"

@@ -24,6 +24,7 @@ def main():
     parser.add_argument("--store-dir", required=True)
     parser.add_argument("--resources", required=True)
     parser.add_argument("--config", default="")
+    parser.add_argument("--owner-pid", type=int, default=0)
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s")
@@ -39,6 +40,7 @@ def main():
         gcs_address=args.gcs_address,
         store_dir=args.store_dir,
         resources=json.loads(args.resources),
+        session_dir=args.session_dir,
         loop=loop,
     )
 
@@ -50,8 +52,17 @@ def main():
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
 
+
     async def run():
+        raylet.on_fatal = stop_event.set
         await raylet.start()
+        from ray_tpu._private.node import owner_watchdog
+
+        watchdog_task = (
+            asyncio.ensure_future(owner_watchdog(args.owner_pid, stop_event))
+            if args.owner_pid
+            else None
+        )
         await stop_event.wait()
         try:
             await asyncio.wait_for(raylet.stop(), timeout=4)

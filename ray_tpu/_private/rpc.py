@@ -232,6 +232,7 @@ class AsyncRpcClient:
         self._pending: Dict[int, asyncio.Future] = {}
         self._read_task = None
         self.on_push: Optional[Callable[[str, Any], Any]] = None
+        self.on_close: Optional[Callable[[], Any]] = None
         self._connected = False
         self._wlock = asyncio.Lock()
 
@@ -282,6 +283,13 @@ class AsyncRpcClient:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
+            if self.on_close is not None:
+                try:
+                    res = self.on_close()
+                    if asyncio.iscoroutine(res):
+                        asyncio.ensure_future(res)
+                except Exception:
+                    pass
 
     async def call(self, method: str, payload: Any = None, timeout: float = _UNSET_TIMEOUT):
         """timeout semantics: unset → config default; None → wait forever."""
@@ -328,9 +336,11 @@ class AsyncRpcClient:
 # Sync client (drivers / worker main threads)
 # --------------------------------------------------------------------------
 class RpcClient:
-    def __init__(self, address: str, on_push: Callable[[str, Any], None] = None):
+    def __init__(self, address: str, on_push: Callable[[str, Any], None] = None,
+                 on_close: Callable[[], None] = None):
         self.address = address
         self.on_push = on_push
+        self.on_close = on_close
         self._sock = self._connect()
         self._req_id = 0
         self._lock = threading.Lock()
@@ -397,6 +407,11 @@ class RpcClient:
                     self._results[req_id] = (False, ConnectionLost(f"connection to {self.address} lost"))
                     ev.set()
                 self._pending.clear()
+            if self.on_close is not None:
+                try:
+                    self.on_close()
+                except Exception:
+                    pass
 
     def call(self, method: str, payload: Any = None, timeout: float = _UNSET_TIMEOUT):
         """timeout semantics: unset → config default; None → wait forever."""

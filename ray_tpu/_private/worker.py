@@ -181,6 +181,9 @@ class Worker:
     # ------------------------------------------------------------------
     def connect_driver(self, gcs_address: str, raylet_address: str, namespace: Optional[str], job_config: dict):
         self.mode = "driver"
+        import sys as _sys
+
+        job_config = dict(job_config, driver_sys_path=[p for p in _sys.path if p])
         self.gcs_client = rpc.RpcClient(gcs_address, on_push=self._on_gcs_push)
         reply = self.gcs_client.call(
             "register_driver",
@@ -191,9 +194,13 @@ class Worker:
         self.session_info = reply["session_info"]
         self.gcs_client.call("subscribe", "actors")
         self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push)
+        # Workers mirror the driver's import paths (driver_sys_path, set
+        # above) so functions pickled by reference resolve there too; the
+        # same config is stored in the GCS job table for other raylets.
+        job_config = dict(job_config, session_dir=self.session_info.get("session_dir"))
         r = self.raylet_client.call(
             "register_client",
-            {"job_id": self.job_id.binary(), "job_config": dict(job_config, session_dir=self.session_info.get("session_dir"))},
+            {"job_id": self.job_id.binary(), "job_config": job_config},
         )
         self.node_id = NodeID(r["node_id"])
         self.store = StoreClient(self.raylet_client, r["store_dir"])
@@ -208,11 +215,20 @@ class Worker:
         self.node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
         self.gcs_client = rpc.RpcClient(os.environ["RAY_TPU_GCS_ADDRESS"], on_push=self._on_gcs_push)
         self.gcs_client.call("subscribe", "actors")
-        self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push)
+        # The raylet owns this worker's lifetime: if it dies, exit
+        # (reference: workers suicide when their raylet disappears).
+        self.raylet_client = rpc.RpcClient(
+            raylet_address, on_push=self._on_raylet_push, on_close=self._on_raylet_lost
+        )
         reply = self.raylet_client.call("register_worker", {"worker_id": self.worker_id.binary()})
         if not reply.get("ok"):
             raise RuntimeError("raylet rejected worker registration")
         job_config = reply.get("job_config", {})
+        import sys as _sys
+
+        for p in reversed(job_config.get("driver_sys_path") or []):
+            if p not in _sys.path:
+                _sys.path.insert(0, p)
         self.namespace = job_config.get("namespace", "default")
         self.session_info = {"session_dir": job_config.get("session_dir")}
         self.store = StoreClient(self.raylet_client, os.environ["RAY_TPU_STORE_DIR"])
@@ -250,6 +266,12 @@ class Worker:
             self._intended_exit = True
             self._shutdown_event.set()
             self._exec_queue.put(None)
+
+    def _on_raylet_lost(self):
+        if self.mode == "worker" and not self._intended_exit:
+            # Hard exit: the main thread may be blocked inside a task
+            # (e.g. a long queue.get), so a cooperative flag isn't enough.
+            os._exit(1)
 
     # ------------------------------------------------------------------
     # objects
@@ -608,8 +630,11 @@ class Worker:
             self._store_error_returns(spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.__init__"))
 
     def _run_actor_method(self, spec: TaskSpec):
-        method = getattr(self.actor_instance, spec.method_name)
         args, kwargs = self._resolve_args(spec)
+        if spec.method_name == "__ray_call__":
+            fn, *rest = args
+            return fn(self.actor_instance, *rest, **kwargs)
+        method = getattr(self.actor_instance, spec.method_name)
         return method(*args, **kwargs)
 
     def _execute_actor_method(self, spec: TaskSpec):
@@ -634,12 +659,11 @@ class Worker:
         try:
             if spec.method_name == "__ray_terminate__":
                 self._store_returns(spec, None)
+                self._intended_exit = True
                 self._shutdown_event.set()
                 self._exec_queue.put(None)
                 return
-            method = getattr(self.actor_instance, spec.method_name)
-            args, kwargs = self._resolve_args(spec)
-            result = method(*args, **kwargs)
+            result = self._run_actor_method(spec)
             if inspect.iscoroutine(result):
                 result = await result
             self._store_returns(spec, result)

@@ -71,6 +71,10 @@ class ActorHandle:
 
     def __getattr__(self, name: str) -> ActorMethod:
         meta = self.__dict__.get("_method_meta") or {}
+        if name == "__ray_call__":
+            # Run an arbitrary function against the actor instance:
+            # handle.__ray_call__.remote(lambda self, x: ..., x)
+            return ActorMethod(self, "__ray_call__", 1)
         if name.startswith("_"):
             raise AttributeError(name)
         if name not in meta:

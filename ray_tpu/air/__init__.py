@@ -1,0 +1,12 @@
+"""ray_tpu.air — shared configs and result types (reference:
+python/ray/air/config.py)."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+
+__all__ = ["ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig", "Result"]

@@ -1,0 +1,79 @@
+"""Run/scaling configs (reference: python/ray/air/config.py:102
+ScalingConfig, as_placement_group_factory :267; RunConfig/FailureConfig/
+CheckpointConfig).  TPU-first addition: `use_tpu` + `topology` drive
+slice-aware placement (one worker per TPU host, all chips visible)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # parity with the reference API; ignored on TPU
+    resources_per_worker: Optional[Dict[str, float]] = None
+    trainer_resources: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU topology, e.g. "v5litepod-16": one worker per host in the slice.
+    topology: Optional[str] = None
+
+    def _worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            try:
+                from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+                chips = TPUAcceleratorManager.get_current_node_num_accelerators() or 4
+            except Exception:
+                chips = 4
+            return {"TPU": float(chips)}
+        return {"CPU": 1.0}
+
+    def as_placement_group_factory(self):
+        from ray_tpu.util.placement_group import placement_group
+
+        bundles = [self._worker_resources() for _ in range(self.num_workers)]
+        # TPU workers spread one-per-host so each owns its host's chips
+        # (libtpu allows one process per chip set); CPU workers pack.
+        strategy = "SPREAD" if self.use_tpu else self.placement_strategy
+
+        def factory():
+            return placement_group(bundles, strategy=strategy)
+
+        return factory
+
+    @property
+    def num_chips_per_worker(self) -> float:
+        return self._worker_resources().get("TPU", 0.0)
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+    log_to_file: bool = False
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")

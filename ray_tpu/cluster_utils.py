@@ -1,0 +1,91 @@
+"""Multi-node cluster on one machine, for tests (reference:
+python/ray/cluster_utils.py:135 Cluster — "the single most important
+testing pattern to replicate", SURVEY.md §4): extra raylet processes join
+the same GCS, each with its own object store and worker pool."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import node as node_mod
+from ray_tpu._private import rpc
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+        self.head = None
+        self.workers: List = []  # (proc, raylet_address)
+        self.gcs_address = None
+        self.session_dir = None
+        if initialize_head:
+            self.add_head(**(head_node_args or {}))
+
+    def add_head(self, **kwargs):
+        assert self.head is None, "head already started"
+        self.head = node_mod.start_head(**kwargs)
+        self.gcs_address = self.head.gcs_address
+        self.session_dir = self.head.session_dir
+        return self.head
+
+    @property
+    def address(self) -> str:
+        return self.gcs_address
+
+    def add_node(self, num_cpus=None, num_tpus=None, resources=None, memory=None, wait: bool = True):
+        assert self.gcs_address, "no head node"
+        proc, raylet_address = node_mod.start_worker_node(
+            self.gcs_address,
+            self.session_dir,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            memory=memory,
+            wait=wait,
+        )
+        handle = _NodeHandle(proc, raylet_address)
+        self.workers.append(handle)
+        return handle
+
+    def remove_node(self, handle: "_NodeHandle", allow_graceful: bool = False):
+        """Kill a node's raylet — the cluster-level chaos hook."""
+        if handle.proc.poll() is None:
+            if allow_graceful:
+                handle.proc.terminate()
+            else:
+                handle.proc.kill()
+            try:
+                handle.proc.wait(timeout=10)
+            except Exception:
+                pass
+        if handle in self.workers:
+            self.workers.remove(handle)
+
+    def wait_for_nodes(self, timeout: float = 30.0) -> int:
+        """Wait until every started node is ALIVE in the GCS."""
+        expected = 1 + len(self.workers)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            client = rpc.RpcClient(self.gcs_address)
+            try:
+                info = client.call("get_cluster_info")
+                alive = sum(1 for n in info["nodes"].values() if n["state"] == "ALIVE")
+                if alive >= expected:
+                    return alive
+            finally:
+                client.close()
+            time.sleep(0.05)
+        raise TimeoutError(f"only {alive} of {expected} nodes alive after {timeout}s")
+
+    def shutdown(self):
+        for handle in list(self.workers):
+            self.remove_node(handle, allow_graceful=True)
+        if self.head is not None:
+            self.head.terminate()
+            self.head = None
+
+
+class _NodeHandle:
+    def __init__(self, proc, raylet_address: str):
+        self.proc = proc
+        self.raylet_address = raylet_address

@@ -1,0 +1,59 @@
+"""ray_tpu.train — distributed training orchestration (reference:
+python/ray/train; call stack SURVEY.md §3.4).  JAX/TPU-native: the
+default backend bootstraps jax.distributed instead of NCCL process
+groups."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.base_trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    TrainingFailedError,
+)
+from ray_tpu.train.context import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+
+__all__ = [
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "TrainingFailedError",
+    "Backend",
+    "BackendConfig",
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+    "JaxTrainer",
+    "JaxConfig",
+]
+
+
+def __getattr__(name):
+    if name in ("JaxTrainer", "JaxConfig"):
+        from ray_tpu.train import jax as _jax
+
+        return getattr(_jax, name)
+    if name == "jax":
+        import importlib
+
+        return importlib.import_module("ray_tpu.train.jax")
+    raise AttributeError(name)

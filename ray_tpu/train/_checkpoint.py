@@ -1,0 +1,114 @@
+"""Checkpoint = a directory of files (reference:
+python/ray/train/_checkpoint.py:56 — directory + filesystem).  JAX-native
+helpers serialize pytrees with orbax when available, msgpack-free numpy
+fallback otherwise."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Materialize into `path` (copy if needed) and return it."""
+        if path is None or os.path.abspath(path) == self.path:
+            return self.path
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    def as_directory(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self.path
+
+        return ctx()
+
+    # -- pytree convenience (JAX-native) ----------------------------------
+    @classmethod
+    def from_pytree(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        save_pytree(tree, path)
+        return cls(path)
+
+    def to_pytree(self) -> Any:
+        return load_pytree(self.path)
+
+    def update_metadata(self, metadata: Dict[str, Any]):
+        meta_path = os.path.join(self.path, ".metadata.json")
+        data = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                data = json.load(f)
+        data.update(metadata)
+        with open(meta_path, "w") as f:
+            json.dump(data, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        meta_path = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(tree: Any, path: str):
+    """Orbax when present (sharded-array aware), pickle+numpy fallback.
+
+    In a multi-process jax runtime orbax coordinates across processes;
+    here checkpoints are saved per-rank host-side, so multi-process
+    saves use the pickle path to avoid cross-process barriers."""
+    try:
+        import jax
+
+        multiprocess = jax.process_count() > 1
+    except Exception:
+        multiprocess = False
+    if not multiprocess:
+        try:
+            import orbax.checkpoint as ocp
+
+            ckpt = ocp.StandardCheckpointer()
+            ckpt.save(os.path.join(path, "pytree"), tree, force=True)
+            ckpt.wait_until_finished()
+            return
+        except Exception:
+            pass
+    import jax  # host-fetch any device arrays
+
+    host_tree = jax.tree_util.tree_map(lambda x: jax.device_get(x) if hasattr(x, "device") else x, tree)
+    with open(os.path.join(path, "pytree.pkl"), "wb") as f:
+        pickle.dump(host_tree, f, protocol=5)
+
+
+def load_pytree(path: str) -> Any:
+    pkl = os.path.join(path, "pytree.pkl")
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    return ckpt.restore(os.path.join(path, "pytree"))

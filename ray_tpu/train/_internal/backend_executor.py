@@ -1,0 +1,122 @@
+"""BackendExecutor: owns the worker group and the training lifecycle
+(reference: python/ray/train/_internal/backend_executor.py:68 — start
+:135, start_training :451, get_next_results :578)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(Exception):
+    def __init__(self, rank: int, tb: str):
+        self.rank = rank
+        self.traceback_str = tb
+        super().__init__(f"training worker rank {rank} failed:\n{tb}")
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        experiment_name: str,
+    ):
+        self.backend_config = backend_config
+        self.backend = backend_config.backend_cls()()
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.experiment_name = experiment_name
+        self.worker_group: Optional[WorkerGroup] = None
+        self._ranks_meta: List[dict] = []
+        self.storage_dir = os.path.join(run_config.resolved_storage_path(), experiment_name)
+        os.makedirs(self.storage_dir, exist_ok=True)
+
+    def start(self):
+        pg = None
+        if self.scaling.num_workers > 1 or self.scaling.use_tpu:
+            pg = self.scaling.as_placement_group_factory()()
+            if not pg.wait(timeout_seconds=120):
+                raise TimeoutError(
+                    "placement group for training workers not ready after 120s "
+                    f"(bundles={pg.bundle_specs})"
+                )
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers, self.scaling._worker_resources(), placement_group=pg
+        )
+        self._ranks_meta = self.worker_group.metadata()
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def _rank_info(self) -> List[dict]:
+        """world/local/node ranks per worker, grouped by node (reference:
+        backend_executor _create_rank_mapping)."""
+        by_node: Dict[str, List[int]] = defaultdict(list)
+        for rank, meta in enumerate(self._ranks_meta):
+            by_node[meta["node_id"]].append(rank)
+        node_ranks = {node: i for i, node in enumerate(sorted(by_node))}
+        out = []
+        for rank, meta in enumerate(self._ranks_meta):
+            node = meta["node_id"]
+            out.append(
+                {
+                    "world_rank": rank,
+                    "local_rank": by_node[node].index(rank),
+                    "node_rank": node_ranks[node],
+                    "local_world_size": len(by_node[node]),
+                }
+            )
+        return out
+
+    def start_training(self, train_fn: Callable[[], None], resume_checkpoint=None,
+                       dataset_shards: Optional[List[Dict[str, Any]]] = None):
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        infos = self._rank_info()
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            info = infos[rank]
+            session_kwargs = dict(
+                world_rank=info["world_rank"],
+                local_rank=info["local_rank"],
+                node_rank=info["node_rank"],
+                world_size=self.scaling.num_workers,
+                local_world_size=info["local_world_size"],
+                experiment_name=self.experiment_name,
+                storage_dir=self.storage_dir,
+                resume_checkpoint=resume_checkpoint,
+                dataset_shards=(dataset_shards[rank] if dataset_shards else None),
+            )
+            refs.append(w.start_session.remote(train_fn, session_kwargs))
+        ray_tpu.get(refs)
+
+    def get_next_results(self, timeout: Optional[float] = None) -> Optional[List[dict]]:
+        """One report round from every worker; None when all finished.
+        Raises TrainingWorkerError if any worker's loop raised."""
+        results = ray_tpu.get(
+            [w.next_report.remote(timeout) for w in self.worker_group.workers]
+        )
+        for rank, r in enumerate(results):
+            if r["kind"] == "error":
+                raise TrainingWorkerError(rank, r["traceback"])
+        if all(r["kind"] == "finished" for r in results):
+            return None
+        return results
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group, self.backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,93 @@
+"""_TrainSession: runs the user's train loop on a thread inside the
+worker actor and shuttles reports back (reference:
+python/ray/train/_internal/session.py:111)."""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.context import _set_session
+
+FINISHED = "__finished__"
+ERRORED = "__errored__"
+
+
+class _TrainSession:
+    def __init__(
+        self,
+        train_fn,
+        world_rank: int,
+        local_rank: int,
+        node_rank: int,
+        world_size: int,
+        local_world_size: int,
+        experiment_name: str,
+        storage_dir: str,
+        resume_checkpoint: Optional[Checkpoint] = None,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+    ):
+        self.train_fn = train_fn
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.world_size = world_size
+        self.local_world_size = local_world_size
+        self.experiment_name = experiment_name
+        self.storage_dir = storage_dir
+        self.resume_checkpoint = resume_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        # maxsize=1 gives natural lockstep with the driver's polling.
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._thread: Optional[threading.Thread] = None
+        self._report_idx = 0
+        self.error: Optional[BaseException] = None
+
+    def start(self):
+        def runner():
+            _set_session(self)
+            try:
+                self.train_fn()
+                self._queue.put((FINISHED, None, None))
+            except BaseException as e:  # noqa: BLE001
+                self.error = e
+                self._queue.put((ERRORED, {"traceback": traceback.format_exc()}, e))
+
+        self._thread = threading.Thread(target=runner, daemon=True, name="train-loop")
+        self._thread.start()
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        persisted = None
+        if checkpoint is not None:
+            # Persist into the run's storage dir; rank-tagged (reference:
+            # StorageContext.persist_current_checkpoint, storage.py:514).
+            dest = os.path.join(
+                self.storage_dir,
+                f"checkpoint_{self._report_idx:06d}_rank{self.world_rank}",
+            )
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            persisted = Checkpoint(dest)
+        self._report_idx += 1
+        self._queue.put(("report", dict(metrics), persisted))
+
+    def next_report(self, timeout: Optional[float] = None):
+        """Blocking fetch of the next report; driver calls via actor rpc."""
+        try:
+            kind, metrics, ckpt = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return {"kind": "pending"}
+        if kind == FINISHED:
+            return {"kind": "finished"}
+        if kind == ERRORED:
+            return {"kind": "error", "traceback": metrics["traceback"]}
+        return {"kind": "report", "metrics": metrics, "checkpoint": ckpt}
+
+    def finished(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()

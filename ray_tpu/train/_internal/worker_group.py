@@ -1,0 +1,98 @@
+"""WorkerGroup: N train-worker actors placed by a placement group
+(reference: python/ray/train/_internal/worker_group.py)."""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._internal.session import _TrainSession
+
+
+@ray_tpu.remote
+class RayTrainWorker:
+    """One rank of the training job (reference: worker_group.py RayTrainWorker)."""
+
+    def __init__(self):
+        self._session: Optional[_TrainSession] = None
+
+    # generic executor used by backends (torch's equivalent of
+    # WorkerGroup.execute on the actor)
+    def execute_fn(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def node_ip_and_port(self):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        hostname = socket.gethostname()
+        try:
+            ip = socket.gethostbyname(hostname)
+        except OSError:
+            ip = "127.0.0.1"
+        return ip, port
+
+    def metadata(self):
+        ctx = ray_tpu.get_runtime_context()
+        return {"node_id": ctx.get_node_id(), "pid": os.getpid()}
+
+    def start_session(self, train_fn, session_kwargs: Dict[str, Any]):
+        self._session = _TrainSession(train_fn, **session_kwargs)
+        self._session.start()
+        return True
+
+    def next_report(self, timeout: Optional[float] = None):
+        return self._session.next_report(timeout)
+
+    def shutdown_session(self):
+        self._session = None
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 placement_group=None):
+        self.num_workers = num_workers
+        self._pg = placement_group
+        opts: Dict[str, Any] = {}
+        self.workers = []
+        for i in range(num_workers):
+            cls = RayTrainWorker.options(
+                num_cpus=resources_per_worker.get("CPU", 0),
+                num_tpus=resources_per_worker.get("TPU"),
+                resources={k: v for k, v in resources_per_worker.items() if k not in ("CPU", "TPU", "GPU")},
+                placement_group=placement_group,
+                placement_group_bundle_index=i if placement_group else -1,
+            )
+            self.workers.append(cls.remote())
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run fn on every worker, return results ordered by rank."""
+        return ray_tpu.get([w.execute_fn.remote(fn, *args, **kwargs) for w in self.workers])
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].execute_fn.remote(fn, *args, **kwargs))
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute_fn.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def metadata(self) -> List[dict]:
+        return ray_tpu.get([w.metadata.remote() for w in self.workers])
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass

@@ -1,0 +1,163 @@
+"""BaseTrainer + DataParallelTrainer (reference:
+python/ray/train/base_trainer.py:111, data_parallel_trainer.py:25;
+call stack SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train._internal.backend_executor import (
+    BackendExecutor,
+    TrainingWorkerError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingFailedError(RuntimeError):
+    """Training failed after exhausting FailureConfig.max_failures
+    (reference: train/base_trainer.py:56)."""
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    @classmethod
+    def restore(cls, path: str, **kwargs):
+        raise NotImplementedError("restore lands with experiment state persistence")
+
+
+class DataParallelTrainer(BaseTrainer):
+    """SPMD trainer: N workers each run `train_loop_per_worker`; the
+    backend (JaxConfig by default) wires them into one jax.distributed
+    runtime so in-jit collectives span the whole group."""
+
+    _default_backend_config: BackendConfig = None  # set by subclasses
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            datasets=datasets,
+        )
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        if backend_config is None:
+            backend_config = type(self)._default_backend_config or BackendConfig()
+        self.backend_config = backend_config
+
+    # ------------------------------------------------------------------
+    def _wrapped_train_fn(self):
+        fn = self.train_loop_per_worker
+        config = dict(self.train_loop_config)
+        sig = inspect.signature(fn)
+        if len(sig.parameters) >= 1:
+            return lambda: fn(config)
+        return fn
+
+    def _dataset_shards_per_rank(self) -> Optional[List[Dict[str, Any]]]:
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                its = ds.streaming_split(n)
+                for i in range(n):
+                    shards[i][name] = its[i]
+            elif hasattr(ds, "split"):
+                for i, piece in enumerate(ds.split(n)):
+                    shards[i][name] = piece
+            else:
+                for i in range(n):
+                    shards[i][name] = ds
+        return shards
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
+        failure_config = self.run_config.failure_config or FailureConfig()
+        max_failures = failure_config.max_failures
+        attempts = 0
+        latest_checkpoint: Optional[Checkpoint] = self.resume_from_checkpoint
+        last_error: Optional[BaseException] = None
+
+        while True:
+            executor = BackendExecutor(
+                self.backend_config, self.scaling_config, self.run_config, name
+            )
+            try:
+                executor.start()
+                executor.start_training(
+                    self._wrapped_train_fn(),
+                    resume_checkpoint=latest_checkpoint,
+                    dataset_shards=self._dataset_shards_per_rank(),
+                )
+                metrics_history: List[Dict[str, Any]] = []
+                best_checkpoints = []
+                while True:
+                    round_results = executor.get_next_results()
+                    if round_results is None:
+                        break
+                    reports = [r for r in round_results if r["kind"] == "report"]
+                    if not reports:
+                        continue
+                    metrics = reports[0]["metrics"]  # rank 0 convention
+                    metrics_history.append(metrics)
+                    for r in reports:
+                        if r.get("checkpoint") is not None:
+                            latest_checkpoint = r["checkpoint"]
+                    if reports and reports[0].get("checkpoint"):
+                        best_checkpoints.append((reports[0]["checkpoint"], metrics))
+                executor.shutdown()
+                return Result(
+                    metrics=metrics_history[-1] if metrics_history else None,
+                    checkpoint=latest_checkpoint,
+                    path=executor.storage_dir,
+                    best_checkpoints=best_checkpoints,
+                )
+            except (TrainingWorkerError, ray_tpu.exceptions.RayActorError) as e:
+                last_error = e
+                executor.shutdown()
+                attempts += 1
+                if attempts > max_failures:
+                    raise TrainingFailedError(
+                        f"training failed after {attempts} attempt(s); last error:\n{e}"
+                    ) from e
+                logger.warning("training attempt %d failed, restarting group: %s", attempts, e)
+            except BaseException:
+                executor.shutdown()
+                raise

@@ -1,0 +1,8 @@
+"""JAX/TPU backend for Train (the north-star replacement for the
+reference's NCCL path, reference: python/ray/train/torch/config.py:36
+TorchConfig + :153 _TorchBackend.on_start)."""
+
+from ray_tpu.train.jax.config import JaxConfig, _JaxBackend
+from ray_tpu.train.jax.jax_trainer import JaxTrainer
+
+__all__ = ["JaxConfig", "JaxTrainer"]

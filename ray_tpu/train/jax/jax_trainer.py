@@ -1,0 +1,43 @@
+"""JaxTrainer — the north-star trainer (BASELINE.json: "a new JaxTrainer
+... shards JAX/Flax train_loop_per_worker across a v5e pod").
+
+DataParallelTrainer with the JaxConfig backend: each worker is one jax
+process on one TPU host; inside train_loop_per_worker the user builds a
+global mesh (ray_tpu.parallel.create_mesh over jax.devices()) and jits a
+sharded train step — collectives ride ICI inside the program, dp/tp/sp
+layouts come from ray_tpu.parallel.sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.base_trainer import DataParallelTrainer
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.jax.config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _default_backend_config = JaxConfig()
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        jax_config: Optional[JaxConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )

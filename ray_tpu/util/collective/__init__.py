@@ -1,0 +1,43 @@
+"""Out-of-band collectives between actors/tasks (reference:
+python/ray/util/collective/collective.py — NCCL/Gloo groups rendezvoused
+through the internal KV).  TPU-era backends:
+
+- "cpu": socket-based collectives over DCN (the Gloo-class path) —
+  rendezvous via the GCS KV, direct TCP between members.
+- "xla": device-side collectives. On TPU the fast path is *in-program*
+  (jax.lax.psum inside jit over a Mesh — see ray_tpu.parallel); this
+  backend provides the out-of-band equivalents via host transfer +
+  cpu group, plus the jax.distributed bootstrap used by Train.
+"""
+
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "allreduce",
+    "allgather",
+    "reducescatter",
+    "broadcast",
+    "reduce",
+    "barrier",
+    "send",
+    "recv",
+    "get_rank",
+    "get_collective_group_size",
+]

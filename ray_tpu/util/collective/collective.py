@@ -1,0 +1,166 @@
+"""Collective API + group manager (reference:
+python/ray/util/collective/collective.py:40 GroupManager, :120
+init_collective_group, :258 allreduce ...).
+
+Backends: "cpu" (TCP, ray_tpu.util.collective.cpu_group) and "xla"
+(device arrays: host-staged through the cpu group; the in-program ICI
+path is jax.lax.psum under jit — see ray_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.util.collective.cpu_group import CPUCollectiveGroup
+
+
+class _XLAGroup(CPUCollectiveGroup):
+    """Device-array aware wrapper: stages jax.Arrays through host numpy.
+
+    Out-of-band TPU collectives have no side channel comparable to NCCL —
+    ICI is driven by XLA programs.  In-program `psum`/`ppermute` under
+    jit is the fast path; this class exists for API parity and for
+    host-side coordination traffic.
+    """
+
+    def _to_host(self, tensor):
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is not None and isinstance(tensor, jax.Array):
+            return np.asarray(tensor), True
+        return np.asarray(tensor), False
+
+    def _from_host(self, arr, was_device):
+        if was_device:
+            import jax.numpy as jnp
+
+            return jnp.asarray(arr)
+        return arr
+
+    def allreduce(self, tensor, op: str = "sum"):
+        arr, dev = self._to_host(tensor)
+        return self._from_host(super().allreduce(arr, op), dev)
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        arr, dev = self._to_host(tensor)
+        return self._from_host(super().broadcast(arr, src_rank), dev)
+
+    def allgather(self, tensor):
+        arr, dev = self._to_host(tensor)
+        return [self._from_host(a, dev) for a in super().allgather(arr)]
+
+
+_BACKENDS = {"cpu": CPUCollectiveGroup, "gloo": CPUCollectiveGroup, "xla": _XLAGroup}
+
+
+class GroupManager:
+    def __init__(self):
+        self._groups: Dict[str, CPUCollectiveGroup] = {}
+        self._lock = threading.Lock()
+
+    def create(self, world_size: int, rank: int, backend: str, group_name: str):
+        from ray_tpu._private.worker import get_global_worker
+
+        if backend not in _BACKENDS:
+            raise ValueError(f"unknown collective backend '{backend}' (have {list(_BACKENDS)})")
+        worker = get_global_worker()
+
+        def kv(method, payload):
+            return worker.gcs_client.call(method, payload)
+
+        with self._lock:
+            if group_name in self._groups:
+                raise ValueError(f"collective group '{group_name}' already initialized")
+            group = _BACKENDS[backend](world_size, rank, group_name, kv)
+            self._groups[group_name] = group
+            return group
+
+    def get(self, group_name: str) -> CPUCollectiveGroup:
+        g = self._groups.get(group_name)
+        if g is None:
+            raise ValueError(
+                f"collective group '{group_name}' is not initialized in this process; "
+                "call init_collective_group() first"
+            )
+        return g
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            g = self._groups.pop(group_name, None)
+        if g is not None:
+            g.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "cpu",
+                          group_name: str = "default"):
+    """Called by every member (inside its actor/task)."""
+    _manager.create(world_size, rank, backend, group_name)
+    return True
+
+
+def create_collective_group(actors: List[Any], world_size: int, ranks: List[int],
+                            backend: str = "cpu", group_name: str = "default"):
+    """Declarative setup from the driver: tells each actor to join."""
+    import ray_tpu
+
+    refs = [
+        actor.__ray_call__.remote(_join_group, world_size, rank, backend, group_name)
+        for actor, rank in zip(actors, ranks)
+    ]
+    ray_tpu.get(refs)
+    return True
+
+
+def _join_group(self, world_size, rank, backend, group_name):
+    return init_collective_group(world_size, rank, backend, group_name)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return _manager.get(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    return _manager.get(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get(group_name).broadcast(tensor, src_rank)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: str = "sum"):
+    return _manager.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    _manager.get(group_name).send(tensor, dst_rank)
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = "default"):
+    return _manager.get(group_name).recv(shape, dtype, src_rank)

@@ -1,0 +1,228 @@
+"""CPU collective group: TCP mesh between members, GCS-KV rendezvous.
+
+The Gloo-class backend (reference:
+python/ray/util/collective/collective_group/gloo_collective_group.py) —
+each member runs a listener; addresses rendezvous through the GCS KV;
+peers connect lazily.  Reductions use a ring for large arrays
+(reduce-scatter + allgather) and a star through rank 0 for small ones.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_LEN = struct.Struct("<Q")
+KV_NS = "collective"
+RING_THRESHOLD = 1 << 20  # 1MB: below this a star is faster than a ring
+
+REDUCE_OPS = {
+    "sum": np.add,
+    "product": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def _send_msg(sock: socket.socket, obj: Any):
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("collective peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+class CPUCollectiveGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str, kv):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._kv = kv  # callable kv interface: put(key, val), get(key)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(world_size)
+        self._addr = self._listener.getsockname()
+        self._peers: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accepted: Dict[int, socket.socket] = {}
+        self._accept_cond = threading.Condition()
+        self._closed = False
+        self._accept_thread.start()
+        self._rendezvous()
+
+    # -- rendezvous through GCS KV ----------------------------------------
+    def _key(self, rank: int) -> bytes:
+        return f"{self.group_name}/{rank}".encode()
+
+    def _rendezvous(self, timeout: float = 60.0):
+        self._kv_put(self._key(self.rank), pickle.dumps(self._addr))
+        deadline = time.monotonic() + timeout
+        self._peer_addrs = {}
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            while True:
+                blob = self._kv_get(self._key(r))
+                if blob is not None:
+                    self._peer_addrs[r] = pickle.loads(blob)
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"rank {r} never joined group {self.group_name}")
+                time.sleep(0.02)
+
+    def _kv_put(self, key: bytes, val: bytes):
+        self._kv("kv_put", (KV_NS, key, val, True))
+
+    def _kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._kv("kv_get", (KV_NS, key))
+
+    # -- connections -------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer_rank = _recv_msg(conn)
+            with self._accept_cond:
+                self._accepted[peer_rank] = conn
+                self._accept_cond.notify_all()
+
+    def _peer(self, rank: int) -> socket.socket:
+        """Connection to a peer.  Lower rank dials; higher rank accepts —
+        one deterministic connection per pair."""
+        if rank in self._peers:
+            return self._peers[rank]
+        if self.rank < rank:
+            s = socket.create_connection(self._peer_addrs[rank], timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_msg(s, self.rank)
+        else:
+            with self._accept_cond:
+                while rank not in self._accepted:
+                    if not self._accept_cond.wait(timeout=30):
+                        raise TimeoutError(f"rank {rank} never connected")
+                s = self._accepted.pop(rank)
+        self._peers[rank] = s
+        self._peer_locks[rank] = threading.Lock()
+        return s
+
+    # -- point to point ----------------------------------------------------
+    def send(self, tensor, dst_rank: int):
+        s = self._peer(dst_rank)
+        with self._peer_locks[dst_rank]:
+            _send_msg(s, np.asarray(tensor))
+
+    def recv(self, shape, dtype, src_rank: int):
+        s = self._peer(src_rank)
+        return _recv_msg(s)
+
+    # -- collectives -------------------------------------------------------
+    def broadcast(self, tensor, src_rank: int = 0):
+        arr = np.asarray(tensor)
+        if self.rank == src_rank:
+            for r in range(self.world_size):
+                if r != self.rank:
+                    self.send(arr, r)
+            return arr
+        return self.recv(None, None, src_rank)
+
+    def reduce(self, tensor, dst_rank: int = 0, op: str = "sum"):
+        arr = np.asarray(tensor)
+        if self.rank == dst_rank:
+            acc = arr.copy()
+            for r in range(self.world_size):
+                if r != self.rank:
+                    acc = REDUCE_OPS[op](acc, self.recv(None, None, r))
+            return acc
+        self.send(arr, dst_rank)
+        return arr
+
+    def allreduce(self, tensor, op: str = "sum"):
+        arr = np.asarray(tensor)
+        if self.world_size == 1:
+            return arr
+        if arr.nbytes < RING_THRESHOLD:
+            out = self.reduce(arr, 0, op)
+            return self.broadcast(out, 0)
+        return self._ring_allreduce(arr, op)
+
+    def _ring_allreduce(self, arr: np.ndarray, op: str):
+        """Bandwidth-optimal ring: reduce-scatter then allgather."""
+        n = self.world_size
+        flat = arr.reshape(-1).copy()
+        chunks = np.array_split(flat, n)
+        right = (self.rank + 1) % n
+        left = (self.rank - 1) % n
+        # reduce-scatter
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            self.send(chunks[send_idx], right)
+            incoming = self.recv(None, None, left)
+            chunks[recv_idx] = REDUCE_OPS[op](chunks[recv_idx], incoming)
+        # allgather
+        for step in range(n - 1):
+            send_idx = (self.rank - step + 1) % n
+            recv_idx = (self.rank - step) % n
+            self.send(chunks[send_idx], right)
+            chunks[recv_idx] = self.recv(None, None, left)
+        return np.concatenate(chunks).reshape(arr.shape)
+
+    def allgather(self, tensor):
+        arr = np.asarray(tensor)
+        out: List[np.ndarray] = [None] * self.world_size  # type: ignore
+        out[self.rank] = arr
+        # Simple doubling-free exchange: everyone sends to everyone.
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            if self.rank < r:
+                self.send(arr, r)
+                out[r] = self.recv(None, None, r)
+            else:
+                out[r] = self.recv(None, None, r)
+                self.send(arr, r)
+        return out
+
+    def reducescatter(self, tensor, op: str = "sum"):
+        arr = np.asarray(tensor)
+        reduced = self.allreduce(arr, op)
+        return np.array_split(reduced.reshape(-1), self.world_size)[self.rank]
+
+    def barrier(self):
+        self.allreduce(np.zeros(1, np.float32))
+
+    def destroy(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for s in self._peers.values():
+            try:
+                s.close()
+            except OSError:
+                pass

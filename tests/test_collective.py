@@ -1,0 +1,85 @@
+"""Collective group tests (reference:
+python/ray/util/collective/tests/) — CPU backend between real actors."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def join(self, group="default"):
+        collective.init_collective_group(self.world, self.rank, backend="cpu", group_name=group)
+        return True
+
+    def do_allreduce(self, group="default"):
+        arr = np.full(4, float(self.rank + 1), np.float32)
+        return collective.allreduce(arr, group_name=group)
+
+    def do_big_allreduce(self, group="default"):
+        arr = np.full(500_000, float(self.rank + 1), np.float32)  # ring path
+        out = collective.allreduce(arr, group_name=group)
+        return float(out[0]), float(out[-1])
+
+    def do_broadcast(self, group="default"):
+        arr = np.arange(3, dtype=np.float32) if self.rank == 0 else np.zeros(3, np.float32)
+        return collective.broadcast(arr, src_rank=0, group_name=group)
+
+    def do_allgather(self, group="default"):
+        return collective.allgather(np.full(2, float(self.rank), np.float32), group_name=group)
+
+    def do_barrier(self, group="default"):
+        collective.barrier(group_name=group)
+        return True
+
+
+@pytest.fixture(scope="module")
+def members(ray_cluster):
+    world = 3
+    actors = [Member.remote(r, world) for r in range(world)]
+    ray_tpu.get([a.join.remote("g1") for a in actors])
+    yield actors
+
+
+def test_allreduce(members):
+    outs = ray_tpu.get([a.do_allreduce.remote("g1") for a in members])
+    for out in outs:
+        np.testing.assert_array_equal(out, np.full(4, 6.0, np.float32))  # 1+2+3
+
+
+def test_ring_allreduce_large(members):
+    outs = ray_tpu.get([a.do_big_allreduce.remote("g1") for a in members])
+    for first, last in outs:
+        assert first == 6.0 and last == 6.0
+
+
+def test_broadcast(members):
+    outs = ray_tpu.get([a.do_broadcast.remote("g1") for a in members])
+    for out in outs:
+        np.testing.assert_array_equal(out, np.arange(3, dtype=np.float32))
+
+
+def test_allgather(members):
+    outs = ray_tpu.get([a.do_allgather.remote("g1") for a in members])
+    for out in outs:
+        assert len(out) == 3
+        for r, piece in enumerate(out):
+            np.testing.assert_array_equal(piece, np.full(2, float(r), np.float32))
+
+
+def test_barrier(members):
+    assert all(ray_tpu.get([a.do_barrier.remote("g1") for a in members]))
+
+
+def test_declarative_create(ray_cluster):
+    actors = [Member.remote(r, 2) for r in range(2)]
+    collective.create_collective_group(actors, 2, [0, 1], backend="cpu", group_name="g2")
+    outs = ray_tpu.get([a.do_allreduce.remote("g2") for a in actors])
+    for out in outs:
+        np.testing.assert_array_equal(out, np.full(4, 3.0, np.float32))

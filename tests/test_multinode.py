@@ -1,0 +1,94 @@
+"""Multi-node behavior on one machine via cluster_utils.Cluster
+(reference test pattern: python/ray/tests/conftest.py ray_start_cluster)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"special": 1})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_two_nodes_visible(cluster):
+    nodes = ray_tpu.nodes()
+    assert sum(1 for n in nodes if n["Alive"]) == 2
+    assert ray_tpu.cluster_resources()["CPU"] == 4.0
+
+
+def test_task_spillback_to_remote_node(cluster):
+    @ray_tpu.remote(resources={"special": 0.1})
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # "special" exists only on the worker node → must spill over.
+    node_id = ray_tpu.get(where.remote())
+    head_id = ray_tpu.get_runtime_context().get_node_id()
+    assert node_id != head_id
+
+
+def test_cross_node_object_transfer(cluster):
+    @ray_tpu.remote(resources={"special": 0.1})
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # 4MB, via shm store
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    # Consume with no affinity: may pull across raylets.
+    total = ray_tpu.get(consume.remote(ref))
+    assert total == float(np.arange(500_000, dtype=np.float64).sum())
+    # Driver-side get also pulls to the head node store.
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (500_000,)
+
+
+def test_spread_scheduling(cluster):
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def where():
+        time.sleep(0.3)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [where.remote() for _ in range(4)]
+    nodes = set(ray_tpu.get(refs))
+    assert len(nodes) >= 2, f"SPREAD used only {nodes}"
+
+
+def test_actor_on_remote_node_and_node_death(cluster):
+    node = cluster.add_node(num_cpus=1, resources={"doomed": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"doomed": 1}, max_restarts=0)
+    class Pinned:
+        def ping(self):
+            return "pong"
+
+    a = Pinned.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+    cluster.remove_node(node)
+    # GCS health check marks the node dead; pending calls must fail.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=5)
+        except ray_tpu.exceptions.RayActorError:
+            break
+        except ray_tpu.exceptions.GetTimeoutError:
+            pass
+        time.sleep(0.5)
+    else:
+        pytest.fail("actor on dead node never reported as dead")
