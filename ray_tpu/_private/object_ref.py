@@ -54,11 +54,12 @@ class ObjectRef:
         if self._owned:
             try:
                 from ray_tpu._private.worker import global_worker_maybe
-            except ImportError:
-                return  # interpreter shutdown
-            w = global_worker_maybe()
-            if w is not None:
-                w.reference_counter.remove_owned(self._id)
+
+                w = global_worker_maybe()
+                if w is not None:
+                    w.reference_counter.remove_owned(self._id)
+            except Exception:
+                return  # interpreter shutdown: import machinery torn down
 
     # Allow `await ref` inside async actors.
     def __await__(self):

@@ -1,0 +1,141 @@
+"""ray_tpu.data — lazy, streaming, distributed datasets over arrow blocks.
+
+Reference surface: python/ray/data/__init__.py (read_* constructors,
+from_* converters, Dataset). Execution is TPU-era: blocks stream between
+ray_tpu tasks as object-store refs, and ``Dataset.iter_jax_batches``
+stages batches into HBM (double-buffered ``jax.device_put`` with an
+optional ``NamedSharding``) so a pjit train step never waits on host IO.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ray_tpu.data._internal import logical as L
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    BlocksDatasource,
+    CSVDatasource,
+    Datasink,
+    Datasource,
+    FileBasedDatasource,
+    ImageDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TFRecordsDatasource,
+)
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Dataset",
+    "MaterializedDataset",
+    "DataIterator",
+    "DataContext",
+    "Datasource",
+    "Datasink",
+    "ReadTask",
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "range",
+    "range_tensor",
+    "from_items",
+    "from_pandas",
+    "from_numpy",
+    "from_arrow",
+    "from_blocks",
+    "read_datasource",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_images",
+    "read_binary_files",
+    "read_tfrecords",
+]
+
+_builtin_range = range
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1, **_) -> Dataset:
+    return Dataset(L.Read(datasource=datasource, parallelism=parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001 — API parity
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        RangeDatasource(n, tensor_shape=tuple(shape), column="data"),
+        parallelism=parallelism,
+    )
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(ItemsDatasource(list(items)), parallelism=parallelism)
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    import pyarrow as pa
+
+    return read_datasource(
+        BlocksDatasource([pa.Table.from_pandas(df, preserve_index=False) for df in dfs])
+    )
+
+
+def from_numpy(arrays) -> Dataset:
+    import numpy as np
+
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    from ray_tpu.data.block import build_block
+
+    return read_datasource(BlocksDatasource([build_block({"data": a}) for a in arrays]))
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return read_datasource(BlocksDatasource(tables))
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    return read_datasource(BlocksDatasource(blocks))
+
+
+def read_parquet(paths, *, parallelism: int = -1, columns: Optional[List[str]] = None) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, columns=columns), parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
+
+
+def read_images(paths, *, parallelism: int = -1, size=None, mode=None) -> Dataset:
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(TFRecordsDatasource(paths), parallelism=parallelism)

@@ -1,0 +1,701 @@
+"""Streaming execution of dataset plans over the ray_tpu task runtime.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py
+(run loop :219, _scheduling_loop_step :269), operator selection
+streaming_executor_state.py:533, physical operators under
+execution/operators/ (task_pool_map_operator.py,
+actor_pool_map_operator.py), all-to-all shuffles under
+planner/exchange/.
+
+Design: physical operators form a DAG. Map-style operators submit one
+ray_tpu task per input block (bounded in-flight — backpressure), results
+stream downstream as (block_ref, metadata) bundles without ever pulling
+block payloads to the driver. All-to-all operators (shuffle, sort,
+repartition, zip, groupby) are barriers that run a two-stage
+split/merge task graph.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, split_block
+from ray_tpu.data.context import DataContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RefBundle:
+    block_ref: Any  # ObjectRef[Block]
+    metadata: BlockMetadata
+
+    def num_rows(self) -> Optional[int]:
+        return self.metadata.num_rows
+
+
+# ---------------------------------------------------------------------------
+# Remote task bodies. BlockTransform = Callable[[Block], Block]; chains of
+# fused transforms run inside one task (reference: operator fusion).
+
+
+def _with_meta(block: Block) -> Tuple[Block, BlockMetadata]:
+    acc = BlockAccessor.for_block(block)
+    return acc.to_arrow(), acc.get_metadata()
+
+
+def _run_read_task(read_task, transforms: List[Callable]) -> Tuple[Block, BlockMetadata]:
+    blocks = list(read_task())
+    block = BlockAccessor.concat([BlockAccessor.for_block(b).to_arrow() for b in blocks])
+    for t in transforms:
+        block = t(block)
+    return _with_meta(block)
+
+
+def _run_transforms(transforms: List[Callable], block: Block) -> Tuple[Block, BlockMetadata]:
+    for t in transforms:
+        block = t(block)
+    return _with_meta(block)
+
+
+def _slice_task(block: Block, n: int) -> Tuple[Block, BlockMetadata]:
+    return _with_meta(BlockAccessor.for_block(block).slice(0, n))
+
+
+def _split_task(block: Block, n: int, seed: Optional[int]) -> list:
+    """Split one block into n parts (optionally shuffled first)."""
+    if seed is not None:
+        acc = BlockAccessor.for_block(block)
+        rng = np.random.default_rng(seed)
+        block = acc.take(rng.permutation(acc.num_rows()).tolist())
+    parts = split_block(block, n)
+    return parts if n > 1 else [parts[0]]
+
+
+def _split_at_task(block: Block, offsets: List[int]) -> list:
+    """Split one block at explicit row offsets → len(offsets)+1 pieces."""
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    bounds = [0] + list(offsets) + [n]
+    return [acc.slice(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]
+
+
+def _range_partition_task(block: Block, key: str, boundaries: list, descending: bool) -> list:
+    """Partition rows of a sorted-key domain into len(boundaries)+1 ranges."""
+    acc = BlockAccessor.for_block(block)
+    sorted_block = acc.sort(key, descending)
+    col = BlockAccessor.for_block(sorted_block).to_numpy([key])[key]
+    if descending:
+        idx = [int(np.searchsorted(-col, -np.asarray(b))) for b in boundaries]
+    else:
+        idx = [int(np.searchsorted(col, b)) for b in boundaries]
+    sacc = BlockAccessor.for_block(sorted_block)
+    n = sacc.num_rows()
+    bounds = [0] + idx + [n]
+    return [sacc.slice(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]
+
+
+def _hash_partition_task(block: Block, key: str, n: int) -> list:
+    import zlib
+
+    acc = BlockAccessor.for_block(block)
+    col = acc.to_numpy([key])[key]
+    # Deterministic across processes — Python's str hash() is salted per
+    # interpreter, which would scatter one group over several partitions.
+    hashes = np.array(
+        [zlib.crc32(repr(x).encode()) % n for x in col.tolist()], dtype=np.int64
+    )
+    return [acc.take(np.nonzero(hashes == i)[0].tolist()) for i in range(n)]
+
+
+def _merge_task(*parts, sort_key=None, descending=False, seed=None) -> Tuple[Block, BlockMetadata]:
+    block = BlockAccessor.concat([BlockAccessor.for_block(p).to_arrow() for p in parts])
+    if sort_key is not None:
+        block = BlockAccessor.for_block(block).sort(sort_key, descending)
+    if seed is not None:
+        acc = BlockAccessor.for_block(block)
+        rng = np.random.default_rng(seed)
+        block = acc.take(rng.permutation(acc.num_rows()).tolist())
+    return _with_meta(block)
+
+
+def _groupby_merge_task(key, aggs, *parts) -> Tuple[Block, BlockMetadata]:
+    import pyarrow as pa
+
+    block = BlockAccessor.concat([BlockAccessor.for_block(p).to_arrow() for p in parts])
+    if block.num_rows == 0:
+        return _with_meta(block)
+    pa_aggs = []
+    renames = {}
+    for spec in aggs:
+        name, col, alias = spec
+        target = col if col is not None else key
+        pa_aggs.append((target, name))
+        renames[f"{target}_{name}"] = alias
+    out = block.group_by(key).aggregate(pa_aggs)
+    out = out.rename_columns([renames.get(c, c) for c in out.column_names])
+    out = BlockAccessor.for_block(out).sort(key)
+    return _with_meta(out)
+
+
+def _zip_task(left: Block, *right_parts) -> Tuple[Block, BlockMetadata]:
+    right = BlockAccessor.concat(
+        [BlockAccessor.for_block(p).to_arrow() for p in right_parts]
+    )
+    lacc = BlockAccessor.for_block(left)
+    if lacc.num_rows() != right.num_rows:
+        raise ValueError(
+            f"zip: row count mismatch {lacc.num_rows()} vs {right.num_rows}"
+        )
+    out = lacc.to_arrow()
+    for name in right.column_names:
+        col = right.column(name)
+        new_name = name
+        while new_name in out.column_names:
+            new_name += "_1"
+        out = out.append_column(new_name, col)
+    return _with_meta(out)
+
+
+def _sample_task(block: Block, key: str, n: int) -> np.ndarray:
+    acc = BlockAccessor.for_block(block)
+    sample = BlockAccessor.for_block(acc.sample(n, seed=0))
+    return sample.to_numpy([key])[key]
+
+
+def _write_task(datasink, task_idx: int, block: Block) -> Tuple[Block, BlockMetadata]:
+    import pyarrow as pa
+
+    result = datasink.write([block], {"task_idx": task_idx})
+    nrows = BlockAccessor.for_block(block).num_rows()
+    out = pa.table({"num_rows": [nrows], "write_result": [repr(result)]})
+    return _with_meta(out)
+
+
+# ---------------------------------------------------------------------------
+# Physical operators
+
+
+class PhysicalOperator:
+    def __init__(self, name: str, input_ops: List["PhysicalOperator"]):
+        self.name = name
+        self.input_ops = input_ops
+        self._output_queue: List[RefBundle] = []
+        self._inputs_done = [False] * len(input_ops)
+        self._started = False
+
+    def start(self, ctx: DataContext) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        pass
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        raise NotImplementedError
+
+    def input_done(self, input_index: int) -> None:
+        self._inputs_done[input_index] = True
+
+    def all_inputs_done(self) -> bool:
+        return all(self._inputs_done)
+
+    def has_next(self) -> bool:
+        return bool(self._output_queue)
+
+    def get_next(self) -> RefBundle:
+        return self._output_queue.pop(0)
+
+    def num_active_tasks(self) -> int:
+        return 0
+
+    def waitable_refs(self) -> List[Any]:
+        return []
+
+    def process_ready(self, ready_refs: set) -> None:
+        pass
+
+    def dispatch(self, ctx: DataContext) -> None:
+        pass
+
+    def completed(self) -> bool:
+        return (
+            self.all_inputs_done()
+            and self.num_active_tasks() == 0
+            and self.internal_queue_size() == 0
+            and not self._output_queue
+        )
+
+    def internal_queue_size(self) -> int:
+        return 0
+
+
+class InputDataBuffer(PhysicalOperator):
+    """Source op: emits pre-existing bundles."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("Input", [])
+        self._output_queue = list(bundles)
+        self._inputs_done = []
+
+    def all_inputs_done(self) -> bool:
+        return True
+
+
+class TaskPoolMapOperator(PhysicalOperator):
+    """One ray_tpu task per input bundle, bounded in-flight.
+
+    task_factory(bundle, task_idx) -> (block_ref, meta_ref)
+    """
+
+    def __init__(
+        self,
+        name: str,
+        input_op: PhysicalOperator,
+        task_factory: Callable[[RefBundle, int], Tuple[Any, Any]],
+    ):
+        super().__init__(name, [input_op])
+        self._task_factory = task_factory
+        self._pending_inputs: List[RefBundle] = []
+        # meta_ref -> (block_ref, task_idx)
+        self._active: Dict[Any, Tuple[Any, int]] = {}
+        self._task_idx = 0
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        self._pending_inputs.append(bundle)
+
+    def dispatch(self, ctx: DataContext) -> None:
+        while (
+            self._pending_inputs
+            and len(self._active) < ctx.max_in_flight_tasks_per_op
+            and len(self._output_queue) < ctx.op_output_queue_max_blocks
+        ):
+            bundle = self._pending_inputs.pop(0)
+            block_ref, meta_ref = self._task_factory(bundle, self._task_idx)
+            self._active[meta_ref] = (block_ref, self._task_idx)
+            self._task_idx += 1
+
+    def num_active_tasks(self) -> int:
+        return len(self._active)
+
+    def waitable_refs(self) -> List[Any]:
+        return list(self._active.keys())
+
+    def process_ready(self, ready_refs: set) -> None:
+        done = [r for r in self._active if r in ready_refs]
+        for meta_ref in done:
+            block_ref, _ = self._active.pop(meta_ref)
+            meta = ray_tpu.get(meta_ref)
+            self._output_queue.append(RefBundle(block_ref, meta))
+
+    def internal_queue_size(self) -> int:
+        return len(self._pending_inputs)
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Map over a pool of long-lived actors — for transforms with expensive
+    per-process setup (fn_constructor classes, model inference on TPU).
+    Reference: execution/operators/actor_pool_map_operator.py."""
+
+    def __init__(
+        self,
+        name: str,
+        input_op: PhysicalOperator,
+        actor_factory: Callable[[], Any],
+        submit: Callable[[Any, RefBundle], Tuple[Any, Any]],
+        pool_size: int,
+    ):
+        super().__init__(name, [input_op])
+        self._actor_factory = actor_factory
+        self._submit = submit
+        self._pool_size = pool_size
+        self._actors: List[Any] = []
+        self._idle: List[Any] = []
+        self._pending_inputs: List[RefBundle] = []
+        self._active: Dict[Any, Tuple[Any, Any]] = {}  # meta_ref -> (block_ref, actor)
+
+    def start(self, ctx: DataContext) -> None:
+        super().start(ctx)
+        self._actors = [self._actor_factory() for _ in range(self._pool_size)]
+        self._idle = list(self._actors)
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        self._pending_inputs.append(bundle)
+
+    def dispatch(self, ctx: DataContext) -> None:
+        while (
+            self._pending_inputs
+            and self._idle
+            and len(self._output_queue) < ctx.op_output_queue_max_blocks
+        ):
+            bundle = self._pending_inputs.pop(0)
+            actor = self._idle.pop(0)
+            block_ref, meta_ref = self._submit(actor, bundle)
+            self._active[meta_ref] = (block_ref, actor)
+
+    def num_active_tasks(self) -> int:
+        return len(self._active)
+
+    def waitable_refs(self) -> List[Any]:
+        return list(self._active.keys())
+
+    def process_ready(self, ready_refs: set) -> None:
+        done = [r for r in self._active if r in ready_refs]
+        for meta_ref in done:
+            block_ref, actor = self._active.pop(meta_ref)
+            self._idle.append(actor)
+            meta = ray_tpu.get(meta_ref)
+            self._output_queue.append(RefBundle(block_ref, meta))
+
+    def internal_queue_size(self) -> int:
+        return len(self._pending_inputs)
+
+
+class LimitOperator(PhysicalOperator):
+    def __init__(self, input_op: PhysicalOperator, limit: int, slice_fn):
+        super().__init__(f"Limit[{limit}]", [input_op])
+        self._remaining = limit
+        self._slice_fn = slice_fn
+        self._active: Dict[Any, Any] = {}
+        self._done = False
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        if self._done or self._remaining <= 0:
+            return
+        n = bundle.num_rows()
+        if n is None:
+            n = ray_tpu.get(bundle.block_ref).num_rows
+        if n <= self._remaining:
+            self._remaining -= n
+            self._output_queue.append(bundle)
+            if self._remaining == 0:
+                self._done = True
+        else:
+            block_ref, meta_ref = self._slice_fn(bundle.block_ref, self._remaining)
+            self._active[meta_ref] = block_ref
+            self._remaining = 0
+
+    def num_active_tasks(self) -> int:
+        return len(self._active)
+
+    def waitable_refs(self) -> List[Any]:
+        return list(self._active.keys())
+
+    def process_ready(self, ready_refs: set) -> None:
+        for meta_ref in [r for r in self._active if r in ready_refs]:
+            block_ref = self._active.pop(meta_ref)
+            self._output_queue.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+            self._done = True
+
+    def completed(self) -> bool:
+        return (self._done and not self._active and not self._output_queue) or super().completed()
+
+
+class UnionOperator(PhysicalOperator):
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        self._output_queue.append(bundle)
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier op: buffers every input bundle, then runs bulk_fn once.
+
+    bulk_fn(list_of_bundles_per_input) -> list[RefBundle]. Runs task
+    graphs synchronously (ray_tpu.get inside) — acceptable because
+    all-to-all is a global barrier anyway.
+    """
+
+    def __init__(self, name: str, input_ops: List[PhysicalOperator], bulk_fn):
+        super().__init__(name, input_ops)
+        self._buffers: List[List[RefBundle]] = [[] for _ in input_ops]
+        self._bulk_fn = bulk_fn
+        self._ran = False
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        self._buffers[input_index].append(bundle)
+
+    def dispatch(self, ctx: DataContext) -> None:
+        if not self._ran and self.all_inputs_done():
+            self._ran = True
+            self._output_queue.extend(self._bulk_fn(self._buffers))
+
+    def completed(self) -> bool:
+        return self._ran and not self._output_queue
+
+
+# ---------------------------------------------------------------------------
+# Streaming loop
+
+
+class Topology:
+    def __init__(self, sink: PhysicalOperator):
+        self.sink = sink
+        self.ops: List[PhysicalOperator] = []
+        seen = set()
+
+        def visit(op):
+            if id(op) in seen:
+                return
+            seen.add(id(op))
+            for i in op.input_ops:
+                visit(i)
+            self.ops.append(op)
+
+        visit(sink)
+
+
+def execute_streaming(
+    sink: PhysicalOperator, ctx: Optional[DataContext] = None
+) -> Iterator[RefBundle]:
+    """Run the scheduling loop, yielding sink output bundles as they become
+    available (reference: StreamingExecutor._scheduling_loop_step)."""
+    ctx = ctx or DataContext.get_current()
+    topo = Topology(sink)
+    for op in topo.ops:
+        op.start(ctx)
+
+    # Map each op to its consumers for output routing.
+    consumers: Dict[int, List[Tuple[PhysicalOperator, int]]] = {id(o): [] for o in topo.ops}
+    for op in topo.ops:
+        for idx, inp in enumerate(op.input_ops):
+            consumers[id(inp)].append((op, idx))
+
+    done_notified: set = set()
+    try:
+        while True:
+            progressed = False
+            # 1) Route available outputs downstream (or yield from sink).
+            for op in topo.ops:
+                outs = consumers[id(op)]
+                if not outs:
+                    while op.has_next():
+                        progressed = True
+                        yield op.get_next()
+                    continue
+                while op.has_next():
+                    # Backpressure: stop routing if every consumer is full.
+                    if all(
+                        c.internal_queue_size() >= ctx.op_output_queue_max_blocks
+                        for c, _ in outs
+                        if isinstance(c, (TaskPoolMapOperator, ActorPoolMapOperator))
+                    ) and any(
+                        isinstance(c, (TaskPoolMapOperator, ActorPoolMapOperator))
+                        for c, _ in outs
+                    ):
+                        break
+                    bundle = op.get_next()
+                    progressed = True
+                    for consumer, idx in outs:
+                        consumer.add_input(bundle, idx)
+                # Propagate completion.
+                if op.completed() and id(op) not in done_notified:
+                    done_notified.add(id(op))
+                    progressed = True
+                    for consumer, idx in outs:
+                        consumer.input_done(idx)
+
+            # 2) Dispatch new work.
+            for op in topo.ops:
+                before = op.num_active_tasks()
+                op.dispatch(ctx)
+                if op.num_active_tasks() != before or op.has_next():
+                    progressed = True
+
+            if sink.completed() and id(sink) in done_notified or (
+                sink.completed() and not consumers[id(sink)]
+            ):
+                while sink.has_next():
+                    yield sink.get_next()
+                break
+
+            # 3) Wait for any in-flight task.
+            waitables = [r for op in topo.ops for r in op.waitable_refs()]
+            if waitables:
+                ready, _ = ray_tpu.wait(
+                    waitables, num_returns=1, timeout=0.25, fetch_local=False
+                )
+                if ready:
+                    ready_set = set(ready)
+                    # Batch: collect everything already finished.
+                    more, _ = ray_tpu.wait(
+                        [w for w in waitables if w not in ready_set],
+                        num_returns=len(waitables) - len(ready_set),
+                        timeout=0,
+                        fetch_local=False,
+                    ) if len(waitables) > len(ready_set) else ([], [])
+                    ready_set |= set(more)
+                    for op in topo.ops:
+                        op.process_ready(ready_set)
+                    progressed = True
+            elif not progressed:
+                if sink.completed():
+                    while sink.has_next():
+                        yield sink.get_next()
+                    break
+                time.sleep(0.01)
+    finally:
+        for op in topo.ops:
+            op.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bulk (barrier) task graphs used by AllToAllOperator
+
+
+def _submit(fn, *args, num_returns=1, name=None):
+    import ray_tpu as rt
+
+    rf = rt.remote(fn)
+    if num_returns != 1 or name:
+        rf = rf.options(num_returns=num_returns, name=name or fn.__name__)
+    return rf.remote(*args)
+
+
+def bulk_repartition(bundles: List[RefBundle], n: int, shuffle_seed=None) -> List[RefBundle]:
+    """Two-stage split/merge (reference: planner/exchange/
+    push_based_shuffle_task_scheduler.py, simplified)."""
+    refs = [b.block_ref for b in bundles]
+    if not refs:
+        return []
+    k = len(refs)
+    split_refs = []
+    for i, r in enumerate(refs):
+        seed = None if shuffle_seed is None else shuffle_seed + i
+        out = _submit(_split_task, r, n, seed, num_returns=n, name="split")
+        split_refs.append(out if isinstance(out, list) else [out])
+    out_bundles = []
+    merge_refs = []
+    for j in range(n):
+        parts = [split_refs[i][j] for i in range(k)]
+        seed = None if shuffle_seed is None else shuffle_seed * 7919 + j
+        merge = ray_tpu.remote(_merge_task).options(num_returns=2, name="merge")
+        block_ref, meta_ref = merge.remote(*parts, seed=seed)
+        merge_refs.append((block_ref, meta_ref))
+    for block_ref, meta_ref in merge_refs:
+        out_bundles.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+    return out_bundles
+
+
+def bulk_sort(bundles: List[RefBundle], key: str, descending: bool) -> List[RefBundle]:
+    refs = [b.block_ref for b in bundles]
+    if not refs:
+        return []
+    n = len(refs)
+    if n == 1:
+        block_ref, meta_ref = (
+            ray_tpu.remote(_merge_task)
+            .options(num_returns=2, name="sort")
+            .remote(refs[0], sort_key=key, descending=descending)
+        )
+        return [RefBundle(block_ref, ray_tpu.get(meta_ref))]
+    # 1) Sample each block to estimate range boundaries.
+    samples = ray_tpu.get([_submit(_sample_task, r, key, 20, name="sample") for r in refs])
+    allv = np.sort(np.concatenate([s for s in samples if len(s)]))
+    if descending:
+        allv = allv[::-1]
+    qs = [allv[int(len(allv) * (i + 1) / n)] for i in range(n - 1)] if len(allv) else []
+    # 2) Range-partition every block.
+    split_refs = [
+        _submit(_range_partition_task, r, key, qs, descending, num_returns=n, name="partition")
+        for r in refs
+    ]
+    split_refs = [s if isinstance(s, list) else [s] for s in split_refs]
+    # 3) Merge + sort each range.
+    out = []
+    pend = []
+    for j in range(n):
+        parts = [split_refs[i][j] for i in range(n)]
+        merge = ray_tpu.remote(_merge_task).options(num_returns=2, name="sort_merge")
+        pend.append(merge.remote(*parts, sort_key=key, descending=descending))
+    for block_ref, meta_ref in pend:
+        out.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+    return out
+
+
+def bulk_groupby(bundles: List[RefBundle], key: str, aggs: list) -> List[RefBundle]:
+    refs = [b.block_ref for b in bundles]
+    if not refs:
+        return []
+    n = min(len(refs), 8)
+    split_refs = [
+        _submit(_hash_partition_task, r, key, n, num_returns=n, name="hash_partition")
+        for r in refs
+    ]
+    split_refs = [s if isinstance(s, list) else [s] for s in split_refs]
+    out = []
+    pend = []
+    for j in range(n):
+        parts = [split_refs[i][j] for i in range(len(refs))]
+        merge = ray_tpu.remote(_groupby_merge_task).options(num_returns=2, name="groupby_merge")
+        pend.append(merge.remote(key, aggs, *parts))
+    for block_ref, meta_ref in pend:
+        meta = ray_tpu.get(meta_ref)
+        if meta.num_rows:
+            out.append(RefBundle(block_ref, meta))
+    return out
+
+
+def bulk_zip(left: List[RefBundle], right: List[RefBundle]) -> List[RefBundle]:
+    """Align right-side rows to left block boundaries, then zip pairwise."""
+
+    def rows(bundles):
+        out = []
+        for b in bundles:
+            n = b.num_rows()
+            if n is None:
+                n = ray_tpu.get(b.block_ref).num_rows
+            out.append(n)
+        return out
+
+    lrows, rrows = rows(left), rows(right)
+    if sum(lrows) != sum(rrows):
+        raise ValueError(f"zip: datasets have different row counts: {sum(lrows)} vs {sum(rrows)}")
+    # Global left boundaries.
+    lbounds = np.cumsum(lrows)[:-1].tolist()
+    # Split each right block at the left boundaries that fall inside it.
+    rstart = 0
+    right_pieces: List[List[Any]] = []  # per right block, list of piece refs
+    piece_spans: List[Tuple[int, int]] = []  # global (start,end) per piece
+    for j, rb in enumerate(right):
+        rend = rstart + rrows[j]
+        cuts = [b - rstart for b in lbounds if rstart < b < rend]
+        if cuts:
+            refs = _submit(_split_at_task, rb.block_ref, cuts, num_returns=len(cuts) + 1, name="zip_split")
+            refs = refs if isinstance(refs, list) else [refs]
+        else:
+            refs = [rb.block_ref]
+        bounds = [rstart] + [rstart + c for c in cuts] + [rend]
+        for i, ref in enumerate(refs):
+            right_pieces.append([ref])
+            piece_spans.append((bounds[i], bounds[i + 1]))
+        rstart = rend
+    flat_pieces = [p[0] for p in right_pieces]
+    # Assign pieces to left blocks by span.
+    out = []
+    pend = []
+    lstart = 0
+    for i, lb in enumerate(left):
+        lend = lstart + lrows[i]
+        mine = [
+            flat_pieces[k]
+            for k, (s, e) in enumerate(piece_spans)
+            if s >= lstart and e <= lend and s < e
+        ]
+        zip_fn = ray_tpu.remote(_zip_task).options(num_returns=2, name="zip")
+        pend.append(zip_fn.remote(lb.block_ref, *mine))
+        lstart = lend
+    for block_ref, meta_ref in pend:
+        out.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+    return out

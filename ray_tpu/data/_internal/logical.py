@@ -1,0 +1,149 @@
+"""Logical plan: a DAG of declarative operators built lazily by Dataset
+transformations (reference: python/ray/data/_internal/logical/operators/*).
+
+The planner (planner.py) lowers this to physical operators, fusing
+adjacent map-style operators into single task functions the way the
+reference's OperatorFusionRule does
+(python/ray/data/_internal/logical/rules/operator_fusion.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from ray_tpu.data.datasource import Datasink, Datasource
+
+
+@dataclass
+class LogicalOperator:
+    inputs: List["LogicalOperator"] = field(default_factory=list)
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class Read(LogicalOperator):
+    datasource: Optional[Datasource] = None
+    parallelism: int = -1
+    estimated_num_rows: Optional[int] = None
+
+
+@dataclass
+class InputData(LogicalOperator):
+    """Pre-existing (ref, metadata) bundles, e.g. a MaterializedDataset."""
+
+    bundles: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class AbstractMap(LogicalOperator):
+    fn: Optional[Callable] = None
+    fn_name: str = "map"
+    # "tasks" or "actors" (reference: compute=ActorPoolStrategy)
+    compute: str = "tasks"
+    min_actors: int = 1
+    max_actors: int = 4
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    zero_copy_batch: bool = False
+    fn_constructor: Optional[Callable] = None
+    num_cpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    memory: Optional[int] = None
+
+
+@dataclass
+class MapBatches(AbstractMap):
+    fn_name: str = "map_batches"
+
+
+@dataclass
+class MapRows(AbstractMap):
+    fn_name: str = "map"
+
+
+@dataclass
+class FlatMapRows(AbstractMap):
+    fn_name: str = "flat_map"
+
+
+@dataclass
+class FilterRows(AbstractMap):
+    fn_name: str = "filter"
+
+
+@dataclass
+class Project(LogicalOperator):
+    columns: Optional[List[str]] = None
+    rename: Optional[dict] = None
+    drop: Optional[List[str]] = None
+
+
+@dataclass
+class AddColumn(LogicalOperator):
+    col_name: str = ""
+    fn: Optional[Callable] = None
+    batch_format: str = "numpy"
+
+
+@dataclass
+class Limit(LogicalOperator):
+    limit: int = 0
+
+
+@dataclass
+class RandomShuffle(LogicalOperator):
+    seed: Optional[int] = None
+    num_outputs: Optional[int] = None
+
+
+@dataclass
+class Repartition(LogicalOperator):
+    num_outputs: int = 1
+    shuffle: bool = False
+
+
+@dataclass
+class Sort(LogicalOperator):
+    key: Any = None
+    descending: bool = False
+
+
+@dataclass
+class Union(LogicalOperator):
+    pass
+
+
+@dataclass
+class Zip(LogicalOperator):
+    pass
+
+
+@dataclass
+class GroupBy(LogicalOperator):
+    key: Any = None
+    aggs: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class Write(LogicalOperator):
+    datasink: Optional[Datasink] = None
+
+
+@dataclass
+class LogicalPlan:
+    dag: LogicalOperator
+
+    def sources(self) -> List[LogicalOperator]:
+        out = []
+
+        def visit(op):
+            if not op.inputs:
+                out.append(op)
+            for i in op.inputs:
+                visit(i)
+
+        visit(self.dag)
+        return out

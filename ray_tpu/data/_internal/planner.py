@@ -1,0 +1,382 @@
+"""Lower the logical plan to physical operators, fusing adjacent map-style
+operators into single task functions (reference:
+python/ray/data/_internal/planner/planner.py + logical/rules/operator_fusion.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal import logical as L
+from ray_tpu.data._internal.executor import (
+    ActorPoolMapOperator,
+    AllToAllOperator,
+    InputDataBuffer,
+    LimitOperator,
+    PhysicalOperator,
+    RefBundle,
+    TaskPoolMapOperator,
+    UnionOperator,
+    _run_read_task,
+    _run_transforms,
+    _slice_task,
+    _write_task,
+    bulk_groupby,
+    bulk_repartition,
+    bulk_sort,
+    bulk_zip,
+)
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, build_block
+from ray_tpu.data.context import DataContext
+
+BlockTransform = Callable[[Block], Block]
+
+
+# ---------------------------------------------------------------------------
+# Block transforms compiled from logical map ops. These run inside tasks.
+
+
+def _to_batch(block: Block, fmt: str):
+    acc = BlockAccessor.for_block(block)
+    if fmt in ("numpy", "default"):
+        return acc.to_numpy()
+    if fmt == "pandas":
+        return acc.to_pandas()
+    if fmt in ("pyarrow", "arrow"):
+        return acc.to_arrow()
+    raise ValueError(f"unknown batch_format {fmt!r}")
+
+
+def _from_batch(batch) -> Block:
+    return build_block(batch)
+
+
+def make_map_batches_transform(
+    fn, batch_size: Optional[int], batch_format: str
+) -> BlockTransform:
+    def transform(block: Block) -> Block:
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        if batch_size is None or n <= batch_size:
+            return _from_batch(fn(_to_batch(block, batch_format)))
+        outs = []
+        for lo in range(0, n, batch_size):
+            piece = acc.slice(lo, min(lo + batch_size, n))
+            outs.append(_from_batch(fn(_to_batch(piece, batch_format))))
+        return BlockAccessor.concat(outs)
+
+    return transform
+
+
+def make_map_rows_transform(fn) -> BlockTransform:
+    def transform(block: Block) -> Block:
+        rows = [fn(row) for row in BlockAccessor.for_block(block).iter_rows()]
+        return build_block(rows)
+
+    return transform
+
+
+def make_flat_map_transform(fn) -> BlockTransform:
+    def transform(block: Block) -> Block:
+        rows = []
+        for row in BlockAccessor.for_block(block).iter_rows():
+            rows.extend(fn(row))
+        return build_block(rows)
+
+    return transform
+
+
+def make_filter_transform(fn) -> BlockTransform:
+    def transform(block: Block) -> Block:
+        acc = BlockAccessor.for_block(block)
+        keep = [i for i, row in enumerate(acc.iter_rows()) if fn(row)]
+        return acc.take(keep)
+
+    return transform
+
+
+def make_project_transform(columns, rename, drop) -> BlockTransform:
+    def transform(block: Block) -> Block:
+        acc = BlockAccessor.for_block(block)
+        if columns:
+            block = acc.select(columns)
+            acc = BlockAccessor.for_block(block)
+        if rename:
+            block = acc.rename(rename)
+            acc = BlockAccessor.for_block(block)
+        if drop:
+            block = acc.drop(drop)
+        return block
+
+    return transform
+
+
+def make_add_column_transform(col_name, fn, batch_format) -> BlockTransform:
+    def transform(block: Block) -> Block:
+        values = fn(_to_batch(block, batch_format))
+        if not isinstance(values, np.ndarray):
+            values = np.asarray(values)
+        return BlockAccessor.for_block(block).append_column(col_name, values)
+
+    return transform
+
+
+def _compile_transform(op: L.LogicalOperator) -> Optional[BlockTransform]:
+    if isinstance(op, L.MapBatches):
+        fn = op.fn
+        if op.fn_constructor is not None:
+            return None  # actor-only path
+        return make_map_batches_transform(fn, op.batch_size, op.batch_format)
+    if isinstance(op, L.MapRows):
+        return make_map_rows_transform(op.fn)
+    if isinstance(op, L.FlatMapRows):
+        return make_flat_map_transform(op.fn)
+    if isinstance(op, L.FilterRows):
+        return make_filter_transform(op.fn)
+    if isinstance(op, L.Project):
+        return make_project_transform(op.columns, op.rename, op.drop)
+    if isinstance(op, L.AddColumn):
+        return make_add_column_transform(op.col_name, op.fn, op.batch_format)
+    return None
+
+
+def _is_fusable_map(op: L.LogicalOperator) -> bool:
+    if isinstance(op, (L.Project, L.AddColumn)):
+        return True
+    return isinstance(op, L.AbstractMap) and op.compute == "tasks"
+
+
+# ---------------------------------------------------------------------------
+# Actor-pool map worker
+
+
+class _MapWorker:
+    """Long-lived map actor (reference: actor_pool_map_operator.py _MapWorker)."""
+
+    def __init__(self, fn_constructor_blob, transform_blob):
+        from ray_tpu._private import serialization
+
+        ctor = serialization.loads_function(fn_constructor_blob) if fn_constructor_blob else None
+        self._udf = ctor() if ctor else None
+        self._transform = serialization.loads_function(transform_blob)
+
+    def ready(self):
+        return True
+
+    def map(self, block):
+        from ray_tpu.data._internal.executor import _with_meta
+
+        return _with_meta(self._transform(block, self._udf))
+
+
+# ---------------------------------------------------------------------------
+# Planner
+
+
+class Planner:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self._ctx = ctx or DataContext.get_current()
+
+    def plan(self, plan: L.LogicalPlan) -> PhysicalOperator:
+        return self._lower(plan.dag)
+
+    # -- helpers
+
+    def _reads_to_input_buffer(self, op: L.Read) -> InputDataBuffer:
+        parallelism = op.parallelism
+        if parallelism is None or parallelism < 0:
+            est = op.datasource.estimate_inmemory_data_size()
+            if est:
+                parallelism = max(
+                    self._ctx.min_read_parallelism,
+                    min(
+                        self._ctx.read_parallelism_auto_max,
+                        est // self._ctx.target_max_block_size + 1,
+                    ),
+                )
+            else:
+                parallelism = self._ctx.min_read_parallelism
+        read_tasks = op.datasource.get_read_tasks(parallelism)
+        bundles = [RefBundle(rt, rt.metadata) for rt in read_tasks]
+        return InputDataBuffer(bundles)
+
+    def _make_task_map(
+        self,
+        name: str,
+        input_op: PhysicalOperator,
+        transforms: List[BlockTransform],
+        is_read: bool,
+        resource_opts: Optional[dict] = None,
+    ) -> TaskPoolMapOperator:
+        opts = {"num_returns": 2, "name": name}
+        if resource_opts:
+            opts.update({k: v for k, v in resource_opts.items() if v is not None})
+
+        if is_read:
+            remote_fn = ray_tpu.remote(_run_read_task).options(**opts)
+
+            def factory(bundle: RefBundle, task_idx: int):
+                return remote_fn.remote(bundle.block_ref, transforms)
+
+        else:
+            remote_fn = ray_tpu.remote(_run_transforms).options(**opts)
+
+            def factory(bundle: RefBundle, task_idx: int):
+                return remote_fn.remote(transforms, bundle.block_ref)
+
+        return TaskPoolMapOperator(name, input_op, factory)
+
+    def _make_actor_map(self, op: L.AbstractMap, input_op: PhysicalOperator):
+        from ray_tpu._private import serialization
+
+        fn = op.fn
+        batch_size, batch_format = op.batch_size, op.batch_format
+        if op.fn_constructor is not None:
+            ctor_blob = serialization.dumps_function(op.fn_constructor)
+
+            def transform(block, udf):
+                return make_map_batches_transform(udf, batch_size, batch_format)(block)
+
+        else:
+            ctor_blob = None
+            base = _compile_transform(op)
+
+            def transform(block, udf, base=base):
+                return base(block)
+
+        transform_blob = serialization.dumps_function(transform)
+        actor_cls = ray_tpu.remote(_MapWorker)
+        if op.num_cpus or op.num_tpus:
+            actor_cls = actor_cls.options(num_cpus=op.num_cpus, num_tpus=op.num_tpus)
+
+        def actor_factory():
+            return actor_cls.remote(ctor_blob, transform_blob)
+
+        def submit(actor, bundle: RefBundle):
+            return actor.map.options(num_returns=2).remote(bundle.block_ref)
+
+        return ActorPoolMapOperator(
+            f"ActorMap[{op.fn_name}]", input_op, actor_factory, submit, op.max_actors
+        )
+
+    def _lower(self, op: L.LogicalOperator) -> PhysicalOperator:
+        # Collect a fusable chain ending at `op` going back to its input.
+        if _is_fusable_map(op):
+            chain: List[L.LogicalOperator] = []
+            cur = op
+            resource_opts = {}
+            while _is_fusable_map(cur):
+                chain.append(cur)
+                if isinstance(cur, L.AbstractMap):
+                    if cur.num_cpus:
+                        resource_opts["num_cpus"] = cur.num_cpus
+                    if cur.num_tpus:
+                        resource_opts["num_tpus"] = cur.num_tpus
+                if not cur.inputs:
+                    break
+                cur = cur.inputs[0]
+            chain.reverse()
+            transforms = [_compile_transform(c) for c in chain]
+            names = "->".join(c.name() for c in chain)
+            if isinstance(cur, L.Read):
+                input_buffer = self._reads_to_input_buffer(cur)
+                return self._make_task_map(
+                    f"Read{cur.datasource.get_name()}->{names}",
+                    input_buffer,
+                    transforms,
+                    is_read=True,
+                    resource_opts=resource_opts,
+                )
+            upstream = self._lower(cur)
+            return self._make_task_map(
+                names, upstream, transforms, is_read=False, resource_opts=resource_opts
+            )
+
+        if isinstance(op, L.Read):
+            input_buffer = self._reads_to_input_buffer(op)
+            return self._make_task_map(
+                f"Read{op.datasource.get_name()}", input_buffer, [], is_read=True
+            )
+
+        if isinstance(op, L.InputData):
+            return InputDataBuffer(list(op.bundles))
+
+        if isinstance(op, L.AbstractMap) and op.compute == "actors":
+            return self._make_actor_map(op, self._lower(op.inputs[0]))
+
+        if isinstance(op, L.Limit):
+            upstream = self._lower(op.inputs[0])
+            slice_remote = ray_tpu.remote(_slice_task).options(num_returns=2, name="limit_slice")
+
+            def slice_fn(block_ref, n):
+                return slice_remote.remote(block_ref, n)
+
+            return LimitOperator(upstream, op.limit, slice_fn)
+
+        if isinstance(op, L.Union):
+            return UnionOperator("Union", [self._lower(i) for i in op.inputs])
+
+        if isinstance(op, L.Repartition):
+            upstream = self._lower(op.inputs[0])
+            n, shuffle = op.num_outputs, op.shuffle
+
+            def bulk(buffers):
+                seed = 0 if shuffle else None
+                return bulk_repartition(buffers[0], n, shuffle_seed=seed)
+
+            return AllToAllOperator(f"Repartition[{n}]", [upstream], bulk)
+
+        if isinstance(op, L.RandomShuffle):
+            upstream = self._lower(op.inputs[0])
+            seed = op.seed if op.seed is not None else 0
+            num_outputs = op.num_outputs
+
+            def bulk(buffers):
+                n = num_outputs or max(1, len(buffers[0]))
+                return bulk_repartition(buffers[0], n, shuffle_seed=seed)
+
+            return AllToAllOperator("RandomShuffle", [upstream], bulk)
+
+        if isinstance(op, L.Sort):
+            upstream = self._lower(op.inputs[0])
+            key, desc = op.key, op.descending
+
+            def bulk(buffers):
+                return bulk_sort(buffers[0], key, desc)
+
+            return AllToAllOperator(f"Sort[{key}]", [upstream], bulk)
+
+        if isinstance(op, L.GroupBy):
+            upstream = self._lower(op.inputs[0])
+            key, aggs = op.key, op.aggs
+
+            def bulk(buffers):
+                return bulk_groupby(buffers[0], key, aggs)
+
+            return AllToAllOperator(f"GroupBy[{key}]", [upstream], bulk)
+
+        if isinstance(op, L.Zip):
+            left = self._lower(op.inputs[0])
+            right = self._lower(op.inputs[1])
+
+            def bulk(buffers):
+                return bulk_zip(buffers[0], buffers[1])
+
+            return AllToAllOperator("Zip", [left, right], bulk)
+
+        if isinstance(op, L.Write):
+            upstream = self._lower(op.inputs[0])
+            sink = op.datasink
+            sink.on_write_start()
+            remote_fn = ray_tpu.remote(_write_task).options(num_returns=2, name="write")
+
+            def factory(bundle: RefBundle, task_idx: int):
+                return remote_fn.remote(sink, task_idx, bundle.block_ref)
+
+            return TaskPoolMapOperator("Write", upstream, factory)
+
+        raise NotImplementedError(f"no physical plan for {op.name()}")

@@ -1,0 +1,208 @@
+"""Block model: a Dataset is a list of object-store refs to Blocks.
+
+A Block is a pyarrow.Table (the reference's default block format,
+python/ray/data/block.py). ``BlockAccessor`` wraps one block with
+format-agnostic row/batch operations (reference:
+python/ray/data/block.py BlockAccessor; arrow impl
+python/ray/data/_internal/arrow_block.py).
+
+Tensor columns: fixed-shape ndarrays are stored as
+``pyarrow.FixedShapeTensorArray`` so batches round-trip to numpy with
+zero copies where possible — the TPU-relevant path, since
+``iter_jax_batches`` feeds contiguous numpy straight into
+``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+_TENSOR_META = b"ray_tpu.tensor.shape"
+
+
+@dataclass
+class BlockMetadata:
+    """Sidecar stats shipped with every block ref (reference:
+    python/ray/data/block.py BlockMetadata)."""
+
+    num_rows: Optional[int]
+    size_bytes: Optional[int]
+    schema: Optional[pa.Schema] = None
+    input_files: List[str] = field(default_factory=list)
+    exec_stats: Optional[dict] = None
+
+
+def _ndarray_to_arrow(arr: np.ndarray) -> pa.Array:
+    """Encode an ndarray column. 1-D → plain array; N-D fixed-shape →
+    FixedShapeTensorArray."""
+    if arr.ndim == 1:
+        return pa.array(arr)
+    tensor_type = pa.fixed_shape_tensor(pa.from_numpy_dtype(arr.dtype), arr.shape[1:])
+    flat = pa.array(arr.reshape(arr.shape[0], -1).ravel())
+    storage = pa.FixedSizeListArray.from_arrays(flat, int(np.prod(arr.shape[1:])))
+    return pa.ExtensionArray.from_storage(tensor_type, storage)
+
+
+def _arrow_to_ndarray(col: pa.ChunkedArray | pa.Array) -> np.ndarray:
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    if isinstance(col.type, pa.FixedShapeTensorType):
+        return col.to_numpy_ndarray()
+    if pa.types.is_fixed_size_list(col.type):
+        width = col.type.list_size
+        return col.flatten().to_numpy(zero_copy_only=False).reshape(-1, width)
+    return col.to_numpy(zero_copy_only=False)
+
+
+def build_block(rows_or_columns: Any) -> Block:
+    """Build an arrow block from a dict of columns, list of row-dicts,
+    pandas DataFrame, numpy array, or an existing table."""
+    x = rows_or_columns
+    if isinstance(x, pa.Table):
+        return x
+    if isinstance(x, dict):
+        cols = {}
+        for name, v in x.items():
+            if isinstance(v, np.ndarray):
+                cols[name] = _ndarray_to_arrow(v)
+            else:
+                cols[name] = pa.array(v)
+        return pa.table(cols)
+    if isinstance(x, np.ndarray):
+        return pa.table({"data": _ndarray_to_arrow(x)})
+    if hasattr(x, "to_dict") and hasattr(x, "columns"):  # pandas.DataFrame
+        return pa.Table.from_pandas(x, preserve_index=False)
+    if isinstance(x, list):
+        if not x:
+            return pa.table({})
+        if isinstance(x[0], dict):
+            cols: Dict[str, list] = {k: [] for k in x[0]}
+            for row in x:
+                for k in cols:
+                    cols[k].append(row.get(k))
+            return build_block(
+                {
+                    k: np.stack(v) if isinstance(v[0], np.ndarray) else v
+                    for k, v in cols.items()
+                }
+            )
+        return pa.table({"item": pa.array(x)})
+    raise TypeError(f"cannot build a block from {type(x)}")
+
+
+class BlockAccessor:
+    """Format-agnostic operations over one arrow block."""
+
+    def __init__(self, block: Block):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        if not isinstance(block, pa.Table):
+            block = build_block(block)
+        return BlockAccessor(block)
+
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        return build_block(batch)
+
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def column_names(self) -> List[str]:
+        return self._table.column_names
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take(self, indices: Sequence[int]) -> Block:
+        return self._table.take(pa.array(indices, type=pa.int64()))
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_numpy(self, columns: Optional[Sequence[str]] = None) -> Dict[str, np.ndarray]:
+        names = list(columns) if columns else self._table.column_names
+        return {n: _arrow_to_ndarray(self._table.column(n)) for n in names}
+
+    def iter_rows(self) -> Iterator[dict]:
+        cols = {n: self._table.column(n) for n in self._table.column_names}
+        tensor = {n: isinstance(c.type, pa.FixedShapeTensorType) for n, c in cols.items()}
+        np_cols = {n: _arrow_to_ndarray(c) for n, c in cols.items() if tensor[n]}
+        for i in range(self._table.num_rows):
+            row = {}
+            for n, c in cols.items():
+                row[n] = np_cols[n][i] if tensor[n] else c[i].as_py()
+            yield row
+
+    def select(self, columns: Sequence[str]) -> Block:
+        return self._table.select(list(columns))
+
+    def rename(self, mapping: Dict[str, str]) -> Block:
+        return self._table.rename_columns(
+            [mapping.get(n, n) for n in self._table.column_names]
+        )
+
+    def drop(self, columns: Sequence[str]) -> Block:
+        return self._table.drop_columns(list(columns))
+
+    def append_column(self, name: str, values: Any) -> Block:
+        arr = _ndarray_to_arrow(values) if isinstance(values, np.ndarray) else pa.array(values)
+        t = self._table
+        if name in t.column_names:
+            t = t.drop_columns([name])
+        return t.append_column(name, arr)
+
+    def sample(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        n = min(n, self._table.num_rows)
+        idx = rng.choice(self._table.num_rows, size=n, replace=False)
+        return self.take(idx.tolist())
+
+    def sort(self, key: str | List[str], descending: bool = False) -> Block:
+        keys = [key] if isinstance(key, str) else list(key)
+        order = "descending" if descending else "ascending"
+        return self._table.sort_by([(k, order) for k in keys])
+
+    def get_metadata(self, input_files: Optional[List[str]] = None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self._table.num_rows,
+            size_bytes=self._table.nbytes,
+            schema=self._table.schema,
+            input_files=input_files or [],
+        )
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b is not None and b.num_rows >= 0]
+        nonempty = [b for b in blocks if b.num_rows > 0]
+        if not nonempty:
+            return blocks[0] if blocks else pa.table({})
+        return pa.concat_tables(nonempty, promote_options="permissive")
+
+
+def split_block(block: Block, num_splits: int) -> List[Block]:
+    """Split one block into ``num_splits`` row-contiguous pieces."""
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    out = []
+    for i in range(num_splits):
+        lo = (n * i) // num_splits
+        hi = (n * (i + 1)) // num_splits
+        out.append(acc.slice(lo, hi))
+    return out

@@ -1,0 +1,61 @@
+"""Execution context / knobs for ray_tpu.data
+(reference: python/ray/data/context.py DataContext)."""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    """Per-driver configuration for dataset planning and execution.
+
+    Mirrors the reference's DataContext singleton pattern
+    (python/ray/data/context.py): ``DataContext.get_current()`` returns a
+    thread-local-free process-wide context that transformations capture at
+    call time.
+    """
+
+    # Target on-disk/in-memory size for one block produced by reads and
+    # all-to-all stages.
+    target_max_block_size: int = 128 * 1024 * 1024
+    # Rows per block cap used when splitting oversized in-memory inputs.
+    target_max_rows_per_block: int = 1_000_000
+    # Default parallelism for reads when the user passes -1 ("auto").
+    min_read_parallelism: int = 2
+    read_parallelism_auto_max: int = 200
+    # Streaming executor limits (backpressure).
+    max_in_flight_tasks_per_op: int = 8
+    op_output_queue_max_blocks: int = 16
+    # Resource request attached to each data task.
+    task_num_cpus: float = 1.0
+    # Shuffle strategy: "pull" (1-stage) or "push" (2-stage).
+    shuffle_strategy: str = "pull"
+    # Whether iter_jax_batches double-buffers device transfers.
+    jax_prefetch: bool = True
+    # Extra metadata propagated to tasks.
+    scheduling_strategy: Optional[str] = None
+    # Verbose progress logging from the streaming executor.
+    verbose_progress: bool = False
+    execution_options: dict = field(default_factory=dict)
+
+    _current = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = cls()
+            return cls._current
+
+    @classmethod
+    def _set_current(cls, ctx: "DataContext") -> None:
+        with cls._lock:
+            cls._current = ctx
+
+    def copy(self) -> "DataContext":
+        return copy.deepcopy(self)

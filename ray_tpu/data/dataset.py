@@ -1,0 +1,531 @@
+"""Dataset: lazy, distributed, streaming-executed collection of blocks.
+
+Reference: python/ray/data/dataset.py (5,537 LoC facade). Transformations
+append logical operators; consumption lowers the plan (planner.py) and
+runs it on the streaming executor (executor.py). Blocks live in the
+object store; the driver only ever touches metadata unless the user asks
+for rows.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal import logical as L
+from ray_tpu.data._internal.executor import RefBundle, execute_streaming
+from ray_tpu.data._internal.planner import Planner
+from ray_tpu.data.block import BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasource import (
+    CSVDatasink,
+    Datasink,
+    JSONDatasink,
+    ParquetDatasink,
+)
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    def __init__(self, dag: L.LogicalOperator, ctx: Optional[DataContext] = None):
+        self._dag = dag
+        self._ctx = ctx or DataContext.get_current().copy()
+        self._stats: Dict[str, float] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _with_op(self, op: L.LogicalOperator) -> "Dataset":
+        return Dataset(op, self._ctx)
+
+    def _execute(self) -> Iterator[RefBundle]:
+        t0 = time.time()
+        sink = Planner(self._ctx).plan(L.LogicalPlan(self._dag))
+        for bundle in execute_streaming(sink, self._ctx):
+            yield bundle
+        self._stats["wall_s"] = time.time() - t0
+
+    def _materialize_bundles(self) -> List[RefBundle]:
+        return list(self._execute())
+
+    # -- transformations (lazy) -------------------------------------------
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[str] = None,
+        concurrency: Optional[Union[int, Tuple[int, int]]] = None,
+        fn_constructor_args: Optional[tuple] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        **_: Any,
+    ) -> "Dataset":
+        import inspect
+
+        fn_constructor = None
+        if inspect.isclass(fn):
+            ctor_args = fn_constructor_args or ()
+            cls = fn
+
+            def fn_constructor():
+                return cls(*ctor_args)
+
+            fn = None
+            compute = compute or "actors"
+        compute = compute or "tasks"
+        max_actors = 4
+        if concurrency:
+            max_actors = concurrency if isinstance(concurrency, int) else concurrency[1]
+        return self._with_op(
+            L.MapBatches(
+                inputs=[self._dag],
+                fn=fn,
+                compute=compute,
+                batch_size=batch_size,
+                batch_format=batch_format,
+                fn_constructor=fn_constructor,
+                max_actors=max_actors,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+            )
+        )
+
+    def map(self, fn: Callable[[dict], dict], **kwargs) -> "Dataset":
+        return self._with_op(L.MapRows(inputs=[self._dag], fn=fn))
+
+    def flat_map(self, fn: Callable[[dict], List[dict]], **kwargs) -> "Dataset":
+        return self._with_op(L.FlatMapRows(inputs=[self._dag], fn=fn))
+
+    def filter(self, fn: Callable[[dict], bool], **kwargs) -> "Dataset":
+        return self._with_op(L.FilterRows(inputs=[self._dag], fn=fn))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(L.Project(inputs=[self._dag], columns=list(cols)))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._with_op(L.Project(inputs=[self._dag], rename=dict(mapping)))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(L.Project(inputs=[self._dag], drop=list(cols)))
+
+    def add_column(self, name: str, fn: Callable, batch_format: str = "numpy") -> "Dataset":
+        return self._with_op(
+            L.AddColumn(inputs=[self._dag], col_name=name, fn=fn, batch_format=batch_format)
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(L.Limit(inputs=[self._dag], limit=n))
+
+    def repartition(self, num_blocks: int, *, shuffle: bool = False) -> "Dataset":
+        return self._with_op(
+            L.Repartition(inputs=[self._dag], num_outputs=num_blocks, shuffle=shuffle)
+        )
+
+    def random_shuffle(self, *, seed: Optional[int] = None, num_blocks: Optional[int] = None) -> "Dataset":
+        return self._with_op(
+            L.RandomShuffle(inputs=[self._dag], seed=seed, num_outputs=num_blocks)
+        )
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        # Cheap approximation with identical semantics at block granularity.
+        return self._with_op(L.RandomShuffle(inputs=[self._dag], seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with_op(L.Sort(inputs=[self._dag], key=key, descending=descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with_op(L.Union(inputs=[self._dag] + [o._dag for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with_op(L.Zip(inputs=[self._dag, other._dag]))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        rng_seed = seed if seed is not None else 0
+
+        def sample_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            n = next(iter(batch.values())).shape[0] if batch else 0
+            rng = np.random.default_rng(rng_seed + n)
+            keep = rng.random(n) < fraction
+            return {k: v[keep] for k, v in batch.items()}
+
+        return self.map_batches(sample_batch)
+
+    # -- consumption -------------------------------------------------------
+
+    def iterator(self) -> DataIterator:
+        def factory():
+            return (b.block_ref for b in self._execute())
+
+        return DataIterator(factory)
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_jax_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_torch_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[dict]:
+        return self.iterator().iter_rows()
+
+    def take(self, n: int = 20) -> List[dict]:
+        out: List[dict] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def take_batch(self, batch_size: int = 20, *, batch_format: str = "numpy"):
+        for batch in self.limit(batch_size).iter_batches(
+            batch_size=batch_size, batch_format=batch_format, prefetch_batches=0
+        ):
+            return batch
+        return {}
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        total = 0
+        for b in self._execute():
+            if b.metadata.num_rows is not None:
+                total += b.metadata.num_rows
+            else:
+                total += ray_tpu.get(b.block_ref).num_rows
+        return total
+
+    def schema(self):
+        for b in self._execute():
+            if b.metadata.schema is not None:
+                return b.metadata.schema
+            return BlockAccessor.for_block(ray_tpu.get(b.block_ref)).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def num_blocks(self) -> int:
+        return len(self._materialize_bundles())
+
+    def size_bytes(self) -> int:
+        return sum(b.metadata.size_bytes or 0 for b in self._materialize_bundles())
+
+    def _agg_column(self, col: str, kind: str):
+        def agg_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            v = batch[col]
+            if kind == "sum":
+                r = np.sum(v)
+            elif kind == "min":
+                r = np.min(v) if len(v) else np.inf
+            elif kind == "max":
+                r = np.max(v) if len(v) else -np.inf
+            else:
+                raise ValueError(kind)
+            return {"partial": np.asarray([r]), "n": np.asarray([len(v)])}
+
+        parts = self.map_batches(agg_batch).iterator().materialize_numpy()
+        if not parts or parts["n"].sum() == 0:
+            return None
+        if kind == "sum":
+            return parts["partial"].sum()
+        if kind == "min":
+            return parts["partial"].min()
+        return parts["partial"].max()
+
+    def sum(self, col: str):
+        return self._agg_column(col, "sum")
+
+    def min(self, col: str):
+        return self._agg_column(col, "min")
+
+    def max(self, col: str):
+        return self._agg_column(col, "max")
+
+    def mean(self, col: str):
+        def agg_batch(batch):
+            v = batch[col]
+            return {"s": np.asarray([np.sum(v)]), "n": np.asarray([len(v)])}
+
+        parts = self.map_batches(agg_batch).iterator().materialize_numpy()
+        n = parts["n"].sum()
+        return parts["s"].sum() / n if n else None
+
+    def std(self, col: str, ddof: int = 1):
+        def agg_batch(batch):
+            v = batch[col].astype(np.float64)
+            return {
+                "s": np.asarray([np.sum(v)]),
+                "s2": np.asarray([np.sum(v * v)]),
+                "n": np.asarray([len(v)]),
+            }
+
+        parts = self.map_batches(agg_batch).iterator().materialize_numpy()
+        n = parts["n"].sum()
+        if n <= ddof:
+            return None
+        s, s2 = parts["s"].sum(), parts["s2"].sum()
+        var = (s2 - s * s / n) / (n - ddof)
+        return float(np.sqrt(max(var, 0.0)))
+
+    def unique(self, col: str) -> List[Any]:
+        vals = set()
+        for batch in self.select_columns([col]).iter_batches(batch_size=None, prefetch_batches=0):
+            vals.update(np.unique(batch[col]).tolist())
+        return sorted(vals)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = [
+            BlockAccessor.for_block(ray_tpu.get(b.block_ref)).to_pandas()
+            for b in self._execute()
+        ]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def to_arrow_refs(self) -> List[Any]:
+        return [b.block_ref for b in self._materialize_bundles()]
+
+    def get_internal_block_refs(self) -> List[Any]:
+        return self.to_arrow_refs()
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = self._materialize_bundles()
+        return MaterializedDataset(L.InputData(bundles=bundles), self._ctx)
+
+    def stats(self) -> str:
+        return f"Dataset stats: {self._stats}"
+
+    # -- splitting ---------------------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> List["MaterializedDataset"]:
+        bundles = self._materialize_bundles()
+        if equal:
+            bundles = (
+                Dataset(L.InputData(bundles=bundles), self._ctx)
+                .repartition(n)
+                ._materialize_bundles()
+            )
+            groups = [[b] for b in bundles[:n]]
+        else:
+            groups = [bundles[i::n] for i in range(n)]
+        return [
+            MaterializedDataset(L.InputData(bundles=g), self._ctx) for g in groups
+        ]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        n_test = int(n * test_size) if isinstance(test_size, float) else test_size
+        mat = ds.materialize()
+        return mat._row_split(n - n_test)
+
+    def streaming_split(
+        self, n: int, *, equal: bool = False, locality_hints=None
+    ) -> List[DataIterator]:
+        """n coordinated iterators for n concurrent consumers (training
+        workers). Reference: dataset.py:1482 + stream_split_iterator.py.
+
+        A SplitCoordinator actor runs the streaming execution and deals
+        blocks round-robin to per-split queues; each DataIterator pulls
+        from its split over actor calls. Iterating a split a second time
+        starts a new epoch (re-executes the plan)."""
+        coordinator = _SplitCoordinator.remote(self, n)
+
+        def make_factory(idx: int):
+            def factory():
+                # Epochs after the first are a barrier: every split must
+                # finish epoch k before epoch k+1 starts (otherwise one
+                # fast consumer would wipe the queues of the others).
+                while True:
+                    epoch = ray_tpu.get(coordinator.start_epoch.remote(idx))
+                    if epoch is not None:
+                        break
+                    time.sleep(0.05)
+                while True:
+                    ref = ray_tpu.get(coordinator.get_next.remote(idx, epoch))
+                    if ref is None:
+                        return
+                    yield ref
+
+            return factory
+
+        return [DataIterator(make_factory(i)) for i in range(n)]
+
+    # -- writes ------------------------------------------------------------
+
+    def write_datasink(self, sink: Datasink) -> None:
+        results = list(
+            Dataset(L.Write(inputs=[self._dag], datasink=sink), self._ctx)._execute()
+        )
+        sink.on_write_complete([r.metadata for r in results])
+
+    def write_parquet(self, path: str) -> None:
+        self.write_datasink(ParquetDatasink(path))
+
+    def write_csv(self, path: str) -> None:
+        self.write_datasink(CSVDatasink(path))
+
+    def write_json(self, path: str) -> None:
+        self.write_datasink(JSONDatasink(path))
+
+    def __repr__(self) -> str:
+        return f"Dataset(dag={self._dag.name()})"
+
+
+class MaterializedDataset(Dataset):
+    """Fully-executed dataset: blocks pinned in the object store."""
+
+    def _row_split(self, split_row: int) -> Tuple["MaterializedDataset", "MaterializedDataset"]:
+        bundles: List[RefBundle] = self._dag.bundles
+        left, right = [], []
+        acc = 0
+        for b in bundles:
+            n = b.metadata.num_rows or ray_tpu.get(b.block_ref).num_rows
+            if acc + n <= split_row:
+                left.append(b)
+            elif acc >= split_row:
+                right.append(b)
+            else:
+                k = split_row - acc
+                block = ray_tpu.get(b.block_ref)
+                a = BlockAccessor.for_block(block)
+                lb, rb = a.slice(0, k), a.slice(k, n)
+                left.append(
+                    RefBundle(ray_tpu.put(lb), BlockAccessor.for_block(lb).get_metadata())
+                )
+                right.append(
+                    RefBundle(ray_tpu.put(rb), BlockAccessor.for_block(rb).get_metadata())
+                )
+            acc += n
+        ctx = self._ctx
+        return (
+            MaterializedDataset(L.InputData(bundles=left), ctx),
+            MaterializedDataset(L.InputData(bundles=right), ctx),
+        )
+
+
+class GroupedData:
+    """Reference: python/ray/data/grouped_data.py."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, specs: List[Tuple[str, Optional[str], str]]) -> Dataset:
+        return self._ds._with_op(
+            L.GroupBy(inputs=[self._ds._dag], key=self._key, aggs=specs)
+        )
+
+    def count(self) -> Dataset:
+        return self._agg([("count", None, "count()")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([("sum", col, f"sum({col})")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([("mean", col, f"mean({col})")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([("min", col, f"min({col})")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([("max", col, f"max({col})")])
+
+    def aggregate(self, *specs) -> Dataset:
+        return self._agg(list(specs))
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "numpy") -> Dataset:
+        key = self._key
+
+        def apply_groups(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            keys = batch[key]
+            order = np.argsort(keys, kind="stable")
+            sorted_batch = {k: v[order] for k, v in batch.items()}
+            skeys = sorted_batch[key]
+            outs = []
+            lo = 0
+            for hi in list(np.nonzero(skeys[1:] != skeys[:-1])[0] + 1) + [len(skeys)]:
+                grp = {k: v[lo:hi] for k, v in sorted_batch.items()}
+                outs.append(fn(grp))
+                lo = hi
+            if not outs:
+                return {}
+            return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+
+        # Bring each group onto one block first via sort-based repartition.
+        return self._ds.sort(key).map_batches(apply_groups, batch_size=None)
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Runs dataset execution and deals blocks to n split queues
+    (reference: _internal/iterator/stream_split_iterator.py SplitCoordinator)."""
+
+    def __init__(self, ds: Dataset, n: int):
+        self._ds = ds
+        self._n = n
+        self._epoch = -1
+        self._queues: List[List[Any]] = [[] for _ in range(n)]
+        self._iter = None
+        self._exhausted = True
+        self._rr = 0
+        self._finished: set = set()
+        self._want_next: set = set()
+
+    def start_epoch(self, idx: int):
+        """Returns the epoch to consume, or None if this split must wait
+        for the others to finish the current epoch (client polls)."""
+        if self._epoch < 0:
+            self._begin()
+            return self._epoch
+        if idx not in self._finished:
+            return self._epoch  # join the epoch in flight
+        self._want_next.add(idx)
+        if self._want_next >= self._finished and len(self._want_next) >= self._n:
+            self._begin()
+            return self._epoch
+        return None
+
+    def _begin(self):
+        self._epoch += 1
+        self._queues = [[] for _ in range(self._n)]
+        self._rr = 0
+        self._iter = self._ds._execute()
+        self._exhausted = False
+        self._finished = set()
+        self._want_next = set()
+
+    def get_next(self, idx: int, epoch: int):
+        if epoch != self._epoch:
+            self._finished.add(idx)
+            return None
+        while not self._queues[idx] and not self._exhausted:
+            try:
+                bundle = next(self._iter)
+                self._queues[self._rr % self._n].append(bundle.block_ref)
+                self._rr += 1
+            except StopIteration:
+                self._exhausted = True
+                self._iter = None
+        if self._queues[idx]:
+            return self._queues[idx].pop(0)
+        self._finished.add(idx)
+        return None

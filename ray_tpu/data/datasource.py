@@ -1,0 +1,341 @@
+"""Datasources and datasinks.
+
+Reference interfaces: python/ray/data/datasource/datasource.py
+(Datasource, ReadTask), file_based_datasource.py (path expansion, per-file
+read tasks), and the concrete sources under
+python/ray/data/_internal/datasource/.
+
+A ReadTask is a zero-arg callable returning an iterator of Blocks, plus
+metadata estimates used by the optimizer to pick parallelism. ReadTasks
+are executed as ray_tpu tasks by the streaming executor.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, build_block
+
+
+@dataclass
+class ReadTask:
+    read_fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata
+
+    def __call__(self) -> Iterable[Block]:
+        return self.read_fn()
+
+
+class Datasource:
+    """Pluggable source. Subclasses implement get_read_tasks()."""
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+
+class Datasink:
+    """Pluggable sink. write() runs inside a ray_tpu task per block group."""
+
+    def on_write_start(self) -> None:
+        pass
+
+    def write(self, blocks: Iterable[Block], ctx: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def on_write_complete(self, write_results: List[Any]) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# In-memory sources
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, *, tensor_shape: Optional[tuple] = None, column: str = "id"):
+        self._n = n
+        self._shape = tensor_shape
+        self._column = column
+
+    def estimate_inmemory_data_size(self) -> int:
+        per_row = 8 * (int(np.prod(self._shape)) if self._shape else 1)
+        return self._n * per_row
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks = []
+        for i in range(parallelism):
+            lo = (self._n * i) // parallelism
+            hi = (self._n * (i + 1)) // parallelism
+            shape, column = self._shape, self._column
+
+            def read(lo=lo, hi=hi) -> Iterator[Block]:
+                ids = np.arange(lo, hi, dtype=np.int64)
+                if shape:
+                    data = np.broadcast_to(
+                        ids.reshape((-1,) + (1,) * len(shape)), (hi - lo,) + shape
+                    ).copy()
+                    yield build_block({column: data})
+                else:
+                    yield build_block({column: ids})
+            nrows = hi - lo
+            tasks.append(
+                ReadTask(read, BlockMetadata(num_rows=nrows, size_bytes=nrows * 8))
+            )
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n or 1))
+        tasks = []
+        for i in range(parallelism):
+            lo, hi = (n * i) // parallelism, (n * (i + 1)) // parallelism
+            chunk = items[lo:hi]
+
+            def read(chunk=chunk) -> Iterator[Block]:
+                yield build_block(chunk)
+
+            tasks.append(ReadTask(read, BlockMetadata(num_rows=hi - lo, size_bytes=None)))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """Wraps pre-materialized blocks (from_pandas / from_arrow / from_numpy)."""
+
+    def __init__(self, blocks: List[Block]):
+        self._blocks = [BlockAccessor.for_block(b).to_arrow() for b in blocks]
+
+    def estimate_inmemory_data_size(self) -> int:
+        return sum(b.nbytes for b in self._blocks)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for b in self._blocks:
+            def read(b=b) -> Iterator[Block]:
+                yield b
+
+            tasks.append(ReadTask(read, BlockAccessor.for_block(b).get_metadata()))
+        return tasks
+
+
+# ---------------------------------------------------------------------------
+# File-based sources
+
+
+def _expand_paths(paths: str | List[str], suffixes: Optional[List[str]] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        elif os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files))
+        else:
+            out.append(p)
+    if suffixes:
+        out = [p for p in out if any(p.endswith(s) for s in suffixes)]
+    if not out:
+        raise FileNotFoundError(f"no input files found for {paths}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """Per-file read tasks; subclasses implement _read_file(path)."""
+
+    _FILE_SUFFIXES: Optional[List[str]] = None
+
+    def __init__(self, paths: str | List[str], **read_args):
+        self._paths = _expand_paths(paths, self._FILE_SUFFIXES)
+        self._read_args = read_args
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        try:
+            return sum(os.path.getsize(p) for p in self._paths)
+        except OSError:
+            return None
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, len(self._paths)))
+        groups: List[List[str]] = [[] for _ in range(parallelism)]
+        for i, p in enumerate(self._paths):
+            groups[i % parallelism].append(p)
+        tasks = []
+        for grp in groups:
+            if not grp:
+                continue
+
+            def read(grp=grp, self=self) -> Iterator[Block]:
+                for path in grp:
+                    yield from self._read_file(path)
+
+            size = None
+            try:
+                size = sum(os.path.getsize(p) for p in grp)
+            except OSError:
+                pass
+            tasks.append(
+                ReadTask(read, BlockMetadata(num_rows=None, size_bytes=size, input_files=grp))
+            )
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _FILE_SUFFIXES = [".parquet"]
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        columns = self._read_args.get("columns")
+        yield pq.read_table(path, columns=columns)
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from pyarrow import csv
+
+        yield csv.read_csv(path)
+
+
+class JSONDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import json as _json
+
+        from pyarrow import json as pajson
+
+        try:
+            yield pajson.read_json(path)
+        except pa.ArrowInvalid:
+            # Fall back to a top-level JSON array document.
+            with open(path) as f:
+                rows = _json.load(f)
+            yield build_block(rows)
+
+
+class NumpyDatasource(FileBasedDatasource):
+    _FILE_SUFFIXES = [".npy"]
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        arr = np.load(path)
+        yield build_block({"data": arr})
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterator[Block]:
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": pa.array([data], type=pa.binary()), "path": [path]})
+
+
+class ImageDatasource(FileBasedDatasource):
+    _FILE_SUFFIXES = [".png", ".jpg", ".jpeg", ".bmp", ".gif"]
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from PIL import Image
+
+        img = Image.open(path)
+        size = self._read_args.get("size")
+        if size:
+            img = img.resize(size)
+        mode = self._read_args.get("mode")
+        if mode:
+            img = img.convert(mode)
+        arr = np.asarray(img)
+        yield build_block({"image": arr[None, ...]})
+
+
+class TFRecordsDatasource(FileBasedDatasource):
+    """Minimal TFRecord reader: raw records as bytes rows (the reference
+    parses tf.train.Example; we expose bytes + a decode helper so torch/tf
+    are not required)."""
+
+    _FILE_SUFFIXES = [".tfrecords", ".tfrecord"]
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        records = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                (length,) = np.frombuffer(header, dtype="<u8", count=1)
+                f.read(4)  # length crc
+                records.append(f.read(int(length)))
+                f.read(4)  # data crc
+        yield pa.table({"bytes": pa.array(records, type=pa.binary())})
+
+
+# ---------------------------------------------------------------------------
+# File-based sinks
+
+
+class _FileDatasink(Datasink):
+    def __init__(self, path: str, file_format: str):
+        self._path = path
+        self._format = file_format
+
+    def on_write_start(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def write(self, blocks: Iterable[Block], ctx: Dict[str, Any]) -> Any:
+        written = []
+        for i, block in enumerate(blocks):
+            table = BlockAccessor.for_block(block).to_arrow()
+            name = f"part-{ctx['task_idx']:05d}-{i:03d}.{self._format}"
+            out = os.path.join(self._path, name)
+            self._write_table(table, out)
+            written.append(out)
+        return written
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        raise NotImplementedError
+
+
+class ParquetDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "parquet")
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, path)
+
+
+class CSVDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "csv")
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        from pyarrow import csv
+
+        csv.write_csv(table, path)
+
+
+class JSONDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "json")
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        df = table.to_pandas()
+        df.to_json(path, orient="records", lines=True)

@@ -33,10 +33,13 @@ class RayTaskError(RayError):
             return self
         cls = type(cause)
         try:
+            # __init__/__reduce__ must tolerate pickle round-trips: the
+            # dynamic class is serialized by value, and exception reduce
+            # calls cls(*args).
             derived = type(
                 "RayTaskError(" + cls.__name__ + ")",
                 (RayTaskError, cls),
-                {"__init__": lambda s: None},
+                {"__init__": lambda s, *a, **k: None},
             )()
             derived.function_name = self.function_name
             derived.traceback_str = self.traceback_str
