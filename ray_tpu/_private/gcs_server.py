@@ -19,7 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import rpc
@@ -73,6 +73,11 @@ class GcsServer:
 
         # --- pubsub: channel -> set of conns ---
         self.subs: Dict[str, Set[rpc.ClientConn]] = defaultdict(set)
+
+        # --- observability (reference: gcs_task_manager.h:86 task events;
+        # stats/metric_exporter.h metric aggregation) ---
+        self.task_events: "deque" = deque(maxlen=int(CONFIG.task_events_buffer_size))
+        self.metrics: Dict[bytes, list] = {}  # worker_id -> latest snapshot
 
         self.server.on_disconnect = self._on_disconnect
         self._bg_tasks: List[asyncio.Task] = []
@@ -175,7 +180,11 @@ class GcsServer:
             # Broadcast the updated view so raylets can make spillback
             # decisions locally (reference: ray_syncer resource view sync).
             self.publish("resources", (node_id.binary(), payload["available"]))
-            if payload.get("has_pending"):
+            if (
+                payload.get("has_pending")
+                or self.pending_actors
+                or any(pg.state == "PENDING" for pg in self.placement_groups.values())
+            ):
                 self._kick_pending()
         return True
 
@@ -445,6 +454,12 @@ class GcsServer:
         info.node_id = node_id
         info.raylet_address = self.nodes[node_id].raylet_address
         info.state = "PENDING_CREATION"
+        # Optimistically deduct from the GCS view so concurrent scheduling
+        # decisions don't over-commit one node; the next resource report
+        # replaces the view with the raylet's ground truth.
+        avail = self.available.get(node_id)
+        if avail is not None and spec.scheduling_strategy.kind != "PLACEMENT_GROUP":
+            avail.subtract(resources)
         try:
             # Unbounded: actor __init__ may legitimately take a long time;
             # worker death is reported separately.
@@ -454,6 +469,16 @@ class GcsServer:
             self.publish("actors", self._actor_dict(info))
             self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
         except Exception as e:  # creation failed
+            msg = str(e)
+            if "insufficient resources" in msg or "bundle cannot host" in msg:
+                # The GCS view was stale (resources not yet freed on the
+                # node).  Queue and retry when the view refreshes — the
+                # reference never fails an actor for transient resource
+                # shortage (gcs_actor_scheduler retries leases).
+                if info.actor_id not in self.pending_actors:
+                    self.pending_actors.append(info.actor_id)
+                self.loop.call_later(0.2, self._kick_pending)
+                return
             await self._on_actor_failure(info, f"creation failed: {e}")
 
     def _kick_pending(self):
@@ -737,3 +762,43 @@ class GcsServer:
                 for k, v in avail.items():
                     total[k] = total.get(k, 0.0) + v
         return total
+
+    # ------------------------------------------------------------------
+    # observability (reference: gcs_task_manager.h:86, metric export
+    # pipeline SURVEY.md §5)
+    # ------------------------------------------------------------------
+    async def rpc_task_event_report(self, payload, conn):
+        """Batched task events from a worker's event buffer (reference:
+        core_worker/task_event_buffer.h)."""
+        for e in payload.get("events", ()):
+            self.task_events.append(e)
+        return True
+
+    async def rpc_list_task_events(self, payload, conn):
+        limit = (payload or {}).get("limit", 10000)
+        events = list(self.task_events)
+        return events[-limit:]
+
+    async def rpc_metrics_report(self, payload, conn):
+        self.metrics[payload.get("worker_id", b"")] = payload.get("metrics", [])
+        return True
+
+    async def rpc_metrics_get(self, payload, conn):
+        """Aggregate metric records across workers: counters/histograms sum,
+        gauges last-write-wins per (name, tags)."""
+        merged: Dict[tuple, dict] = {}
+        for snapshot in self.metrics.values():
+            for m in snapshot:
+                key = (m["name"], tuple(sorted((m.get("tags") or {}).items())))
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = {k: (list(v) if isinstance(v, list) else v) for k, v in m.items()}
+                elif m["type"] == "counter":
+                    cur["value"] += m["value"]
+                elif m["type"] == "gauge":
+                    cur["value"] = m["value"]
+                elif m["type"] == "histogram":
+                    cur["counts"] = [a + b for a, b in zip(cur["counts"], m["counts"])]
+                    cur["sum"] += m["sum"]
+                    cur["count"] += m["count"]
+        return list(merged.values())

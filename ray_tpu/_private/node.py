@@ -132,6 +132,7 @@ def start_head(
     memory=None,
     session_dir: Optional[str] = None,
     wait: bool = True,
+    owner_pid: Optional[int] = None,
 ) -> NodeProcesses:
     session_dir = session_dir or new_session_dir()
     session_name = os.path.basename(session_dir)
@@ -151,7 +152,7 @@ def start_head(
             "--store-dir", store_dir,
             "--resources", json.dumps(res),
             "--config", CONFIG.dump(),
-            "--owner-pid", str(os.getpid()),
+            "--owner-pid", str(os.getpid() if owner_pid is None else owner_pid),
         ],
         stdout=log,
         stderr=subprocess.STDOUT,
@@ -176,6 +177,7 @@ def start_worker_node(
     resources=None,
     memory=None,
     wait: bool = True,
+    owner_pid: Optional[int] = None,
 ):
     node_tag = uuid.uuid4().hex[:8]
     raylet_address = f"unix:{session_dir}/sockets/raylet_{node_tag}.sock"
@@ -194,7 +196,7 @@ def start_worker_node(
             "--store-dir", store_dir,
             "--resources", json.dumps(res),
             "--config", CONFIG.dump(),
-            "--owner-pid", str(os.getpid()),
+            "--owner-pid", str(os.getpid() if owner_pid is None else owner_pid),
         ],
         stdout=log,
         stderr=subprocess.STDOUT,
@@ -263,9 +265,12 @@ def head_raylet_address(gcs_address: str) -> str:
 async def owner_watchdog(owner_pid: int, stop_event):
     """Tear the cluster down if its owner process dies without a clean
     shutdown (SIGKILL skips atexit).  Shared by head_main/raylet_main;
-    callers must hold a strong reference to the task."""
+    callers must hold a strong reference to the task.  owner_pid <= 0
+    means detached (`ray-tpu start`): no watchdog."""
     import asyncio
 
+    if owner_pid <= 0:
+        return
     while True:
         await asyncio.sleep(2)
         try:

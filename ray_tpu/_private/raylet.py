@@ -201,13 +201,8 @@ class Raylet:
                     }
                 elif state == "DEAD":
                     self.cluster_view.pop(nb, None)
-        elif method == "job_finished":
-            self._on_job_finished(JobID(payload))
-        elif method == "kill_actor":
-            self._kill_actor_local(ActorID(payload["actor_id"]), intended=True)
-        elif method == "store_free":
-            for oid in payload:
-                self.store.delete(ObjectID(oid))
+        # NOTE: kill_actor/job_finished/store_free arrive via the GCS's
+        # node client as push_* handlers below, not on this channel.
 
     # ------------------------------------------------------------------
     # resource reporting (reference: ray_syncer)
@@ -778,6 +773,13 @@ class Raylet:
     async def push_store_free(self, payload, conn):
         for oid in payload:
             self.store.delete(ObjectID(oid))
+
+    async def push_kill_actor(self, payload, conn):
+        """From GCS over its node client (reference: raylet KillActor rpc)."""
+        self._kill_actor_local(ActorID(payload["actor_id"]), intended=True)
+
+    async def push_job_finished(self, payload, conn):
+        self._on_job_finished(JobID(payload))
 
     async def rpc_store_free(self, payload, conn):
         for oid in payload:

@@ -173,6 +173,9 @@ class Worker:
         self._async_loop_thread = None
         self._exec_pool = None
         self._shutdown_event = threading.Event()
+        self._task_events: list = []
+        self._task_event_flusher = None
+        self._task_event_lock = threading.Lock()
         self._intended_exit = False
         self.runtime_context_info: dict = {}
 
@@ -531,10 +534,53 @@ class Worker:
         self.disconnect()
 
     def _execute_task_guarded(self, spec: TaskSpec):
+        start = time.time()
+        error = None
         try:
             self._execute_task(spec)
-        except BaseException:  # pragma: no cover — never crash the loop
+        except BaseException as e:  # pragma: no cover — never crash the loop
+            error = repr(e)
             traceback.print_exc()
+        self._record_task_event(spec, start, time.time(), error)
+
+    def _record_task_event(self, spec: TaskSpec, start: float, end: float, error):
+        """Buffer a task event; a background thread flushes batches to the
+        GCS task table (reference: core_worker/task_event_buffer.h →
+        gcs_task_manager.h:86)."""
+        try:
+            event = {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": "FAILED" if error else "FINISHED",
+                "error": error,
+                "start_time": start,
+                "end_time": end,
+                "worker_id": self.worker_id.hex() if self.worker_id else "",
+                "node_id": self.node_id.hex() if self.node_id else "",
+                "job_id": spec.job_id.hex(),
+                "actor_id": spec.actor_id.hex() if spec.is_actor_task else None,
+            }
+            with self._task_event_lock:
+                self._task_events.append(event)
+                if self._task_event_flusher is None:
+                    self._task_event_flusher = threading.Thread(
+                        target=self._task_event_flush_loop, daemon=True, name="task-events"
+                    )
+                    self._task_event_flusher.start()
+        except Exception:
+            pass
+
+    def _task_event_flush_loop(self):
+        while not self._shutdown_event.is_set():
+            time.sleep(1.0)
+            if not self._task_events or self.gcs_client is None:
+                continue
+            with self._task_event_lock:
+                events, self._task_events = self._task_events, []
+            try:
+                self.gcs_client.call("task_event_report", {"events": events})
+            except Exception:
+                pass
 
     def _resolve_args(self, spec: TaskSpec):
         packed = spec.args

@@ -74,6 +74,10 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 1
     log_to_file: bool = False
+    # Trial stop criteria: dict ({"training_iteration": 10} /
+    # {"metric": threshold}) or callable(result)->bool (reference:
+    # air.RunConfig(stop=...) / tune.run stop).
+    stop: Optional[Any] = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,257 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/ — ASHA
+`async_hyperband.py`, HyperBand `hyperband.py`, PBT `pbt.py`, median
+stopping `median_stopping_rule.py`)."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+TRAINING_ITERATION = "training_iteration"
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+
+    def __init__(self, time_attr: str = TRAINING_ITERATION, metric: Optional[str] = None, mode: Optional[str] = None):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric, mode) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        if self.metric is None or self.metric not in result:
+            return None
+        v = float(result[self.metric])
+        return v if (self.mode or "max") == "max" else -v
+
+    def on_trial_add(self, trial):
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]):
+        pass
+
+    def on_trial_remove(self, trial):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py): rungs at
+    grace_period × reduction_factor^k; a trial reaching a rung is stopped
+    unless its score is in the top 1/reduction_factor of results recorded
+    at that rung so far."""
+
+    def __init__(
+        self,
+        time_attr: str = TRAINING_ITERATION,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+        brackets: int = 1,
+    ):
+        super().__init__(time_attr, metric, mode)
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # Each bracket b has rungs grace*rf^(k+b); one bracket by default.
+        self._brackets: List[Dict[float, List[float]]] = []
+        for b in range(brackets):
+            rungs: Dict[float, List[float]] = {}
+            t = grace_period * (self.rf ** b)
+            while t < max_t:
+                rungs[t] = []
+                t *= self.rf
+            self._brackets.append(rungs)
+        self._trial_bracket: Dict[str, int] = {}
+        self._rng = random.Random(0)
+
+    def on_trial_add(self, trial):
+        self._trial_bracket[trial.trial_id] = self._rng.randrange(len(self._brackets))
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return TrialScheduler.CONTINUE
+        if t >= self.max_t:
+            return TrialScheduler.STOP
+        rungs = self._brackets[self._trial_bracket.get(trial.trial_id, 0)]
+        decision = TrialScheduler.CONTINUE
+        for rung_t in sorted(rungs, reverse=True):
+            if t < rung_t:
+                continue
+            recorded = rungs[rung_t]
+            cutoff = None
+            if recorded:
+                k = max(1, int(len(recorded) / self.rf))
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+            if not getattr(trial, "_rungs_done", None):
+                trial._rungs_done = set()
+            if rung_t in trial._rungs_done:
+                continue
+            trial._rungs_done.add(rung_t)
+            recorded.append(score)
+            if cutoff is not None and score < cutoff:
+                decision = TrialScheduler.STOP
+            break
+        return decision
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Multi-bracket ASHA — the asynchronous formulation subsumes the
+    original synchronous HyperBand (reference: tune/schedulers/hyperband.py)
+    without its straggler barriers."""
+
+    def __init__(self, time_attr: str = TRAINING_ITERATION, metric=None, mode=None, max_t: int = 81, reduction_factor: float = 3):
+        n_brackets = max(1, int(math.log(max_t, reduction_factor)))
+        super().__init__(
+            time_attr,
+            metric,
+            mode,
+            max_t=max_t,
+            grace_period=1,
+            reduction_factor=reduction_factor,
+            brackets=n_brackets,
+        )
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score at step t is below the median of other
+    trials' running averages at t (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        time_attr: str = TRAINING_ITERATION,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        super().__init__(time_attr, metric, mode)
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._histories: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return TrialScheduler.CONTINUE
+        hist = self._histories.setdefault(trial.trial_id, [])
+        hist.append(score)
+        if t < self.grace_period:
+            return TrialScheduler.CONTINUE
+        others = [
+            sum(h) / len(h)
+            for tid, h in self._histories.items()
+            if tid != trial.trial_id and h
+        ]
+        if len(others) < self.min_samples:
+            return TrialScheduler.CONTINUE
+        median = sorted(others)[len(others) // 2]
+        if max(hist) < median:
+            return TrialScheduler.STOP
+        return TrialScheduler.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): at each perturbation
+    interval, bottom-quantile trials clone the checkpoint of a top-quantile
+    trial and continue with a mutated config.  The controller performs the
+    exploit via trial.restart_with (checkpoint + new config)."""
+
+    def __init__(
+        self,
+        time_attr: str = TRAINING_ITERATION,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: int = 0,
+    ):
+        super().__init__(time_attr, metric, mode)
+        self.perturbation_interval = perturbation_interval
+        self.hyperparam_mutations = hyperparam_mutations or {}
+        self.quantile_fraction = quantile_fraction
+        self.resample_probability = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._latest: Dict[str, float] = {}  # trial_id -> score
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.sample import Domain
+
+        new = dict(config)
+        for key, spec in self.hyperparam_mutations.items():
+            cur = new.get(key)
+            if self._rng.random() < self.resample_probability or cur is None:
+                if isinstance(spec, Domain):
+                    new[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    new[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    new[key] = spec()
+            else:
+                if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+                    factor = self._rng.choice([0.8, 1.2])
+                    new[key] = type(cur)(cur * factor) if isinstance(cur, float) else max(1, int(cur * factor))
+                elif isinstance(spec, list):
+                    # nudge along the list
+                    try:
+                        i = spec.index(cur)
+                        new[key] = spec[max(0, min(len(spec) - 1, i + self._rng.choice([-1, 1])))]
+                    except ValueError:
+                        new[key] = self._rng.choice(spec)
+        return new
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return TrialScheduler.CONTINUE
+        self._latest[trial.trial_id] = score
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.perturbation_interval:
+            return TrialScheduler.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        scores = sorted(self._latest.values())
+        n = len(scores)
+        if n < 4:
+            return TrialScheduler.CONTINUE
+        k = max(1, int(n * self.quantile_fraction))
+        lower_cut = scores[k - 1]
+        upper_cut = scores[n - k]
+        if score > lower_cut:
+            return TrialScheduler.CONTINUE
+        # bottom quantile: exploit a top trial
+        top_ids = [tid for tid, s in self._latest.items() if s >= upper_cut and tid != trial.trial_id]
+        if not top_ids:
+            return TrialScheduler.CONTINUE
+        source_id = self._rng.choice(top_ids)
+        trial._pbt_exploit = {"source": source_id, "mutate": self._mutate}
+        return TrialScheduler.PAUSE  # controller performs clone + restart
+
+    def on_trial_complete(self, trial, result):
+        self._latest.pop(trial.trial_id, None)

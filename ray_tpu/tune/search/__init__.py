@@ -1,0 +1,5 @@
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
+from ray_tpu.tune.search.tpe import TPESearcher
+
+__all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator", "TPESearcher"]
