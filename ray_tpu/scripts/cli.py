@@ -1,0 +1,236 @@
+"""CLI: `python -m ray_tpu.scripts.cli <cmd>` or the `ray-tpu` console
+script (reference: python/ray/scripts/scripts.py — ray
+start/stop/status/submit/memory/timeline/list)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def cmd_start(args):
+    from ray_tpu._private import node as node_mod
+
+    if args.head:
+        procs = node_mod.start_head(
+            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            resources=json.loads(args.resources) if args.resources else None,
+            # detached unless --block: survive this CLI process
+            owner_pid=os.getpid() if args.block else 0,
+        )
+        print(f"started head: gcs={procs.gcs_address}")
+        print(f"session dir: {procs.session_dir}")
+        print("connect with ray_tpu.init(address='auto') or "
+              f"ray_tpu.init(address='{procs.gcs_address}')")
+        if args.block:
+            try:
+                while all(p.poll() is None for p in procs.procs):
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                procs.terminate()
+        return 0
+    else:
+        address = args.address or _auto_address()
+        if not address:
+            print("error: --address required (or start a head first)", file=sys.stderr)
+            return 1
+        from ray_tpu._private.node import new_session_dir, start_worker_node
+
+        session_dir = _session_dir_of(address) or new_session_dir()
+        proc, raylet_addr = start_worker_node(
+            address, session_dir,
+            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            resources=json.loads(args.resources) if args.resources else None,
+            owner_pid=os.getpid() if args.block else 0,
+        )
+        print(f"started worker node: raylet={raylet_addr}")
+        if args.block:
+            try:
+                proc.wait()
+            except KeyboardInterrupt:
+                proc.terminate()
+        return 0
+
+
+def cmd_stop(args):
+    """Terminate all ray_tpu processes of the current user (reference:
+    `ray stop`)."""
+    out = subprocess.run(
+        ["pkill", "-f", "ray_tpu._private.(head_main|raylet_main|default_worker)"],
+        capture_output=True,
+    )
+    from ray_tpu._private.node import CLUSTER_ADDRESS_FILE
+
+    try:
+        os.unlink(CLUSTER_ADDRESS_FILE)
+    except OSError:
+        pass
+    print("stopped" if out.returncode in (0, 1) else "pkill failed")
+    return 0
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=args.address or "auto")
+    return ray_tpu
+
+
+def cmd_status(args):
+    ray_tpu = _connect(args)
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    nodes = ray_tpu.nodes()
+    print(f"nodes: {sum(1 for n in nodes if n['Alive'])} alive / {len(nodes)} total")
+    for n in nodes:
+        mark = "*" if n["IsHead"] else " "
+        print(f" {mark} {n['NodeID'][:12]} alive={n['Alive']} {n['Resources']}")
+    print("resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g} available")
+    return 0
+
+
+def cmd_list(args):
+    from ray_tpu.util import state
+
+    _connect(args)
+    kind = args.kind
+    fn = {
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "workers": state.list_workers,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[kind]
+    rows = fn()
+    print(json.dumps(rows, indent=1, default=str))
+    return 0
+
+
+def cmd_summary(args):
+    from ray_tpu.util import state
+
+    _connect(args)
+    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors}[args.kind]
+    print(json.dumps(fn(), indent=1, default=str))
+    return 0
+
+
+def cmd_timeline(args):
+    from ray_tpu.util import state
+
+    _connect(args)
+    path = args.output or f"ray_tpu_timeline_{int(time.time())}.json"
+    state.timeline(path)
+    print(f"wrote chrome trace to {path} (open in chrome://tracing or perfetto)")
+    return 0
+
+
+def cmd_memory(args):
+    from ray_tpu.util import state
+
+    _connect(args)
+    objs = state.list_objects()
+    total = sum(o.get("size", 0) for o in objs)
+    print(f"{len(objs)} objects, {total / 1e6:.1f} MB total")
+    for o in objs[: args.limit]:
+        print(f"  {o.get('object_id', '?')[:16]} {o.get('size', 0):>10} B node={o.get('node_id', '?')[:8]}")
+    return 0
+
+
+def cmd_submit(args):
+    """Run a script against a cluster (reference: `ray job submit` /
+    dashboard/modules/job — here: direct subprocess with the cluster
+    address injected)."""
+    address = args.address or _auto_address()
+    if not address:
+        print("error: no running cluster found", file=sys.stderr)
+        return 1
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = address
+    cmd = [sys.executable, args.script] + args.script_args
+    print(f"submitting {' '.join(cmd)} to {address}")
+    return subprocess.call(cmd, env=env)
+
+
+def _auto_address():
+    from ray_tpu._private.node import CLUSTER_ADDRESS_FILE
+
+    try:
+        with open(CLUSTER_ADDRESS_FILE) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _session_dir_of(address: str):
+    # unix:/tmp/ray_tpu/session_x/sockets/gcs.sock -> /tmp/ray_tpu/session_x
+    if address.startswith("unix:"):
+        p = address[len("unix:"):]
+        d = os.path.dirname(os.path.dirname(p))
+        if os.path.isdir(d):
+            return d
+    return None
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu", description="ray_tpu cluster CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address to join (worker nodes)")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", help="JSON dict of custom resources")
+    p.add_argument("--block", action="store_true", help="stay attached")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all local ray_tpu processes")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status),):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["actors", "nodes", "tasks", "objects", "workers", "placement-groups", "jobs"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="summarize tasks/actors")
+    p.add_argument("kind", choices=["tasks", "actors"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline", help="export chrome trace of task events")
+    p.add_argument("-o", "--output", default=None)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("memory", help="object store usage")
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("submit", help="run a script with the cluster address injected")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_submit)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ __all__ = [
     "NodeAffinitySchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
     "ActorPool",
+    "Queue",
 ]
 
 
@@ -27,16 +28,12 @@ def __getattr__(name):
         from ray_tpu.util.actor_pool import ActorPool
 
         return ActorPool
-    if name == "collective":
+    if name == "Queue":
+        from ray_tpu.util.queue import Queue
+
+        return Queue
+    if name in ("collective", "state", "metrics", "queue"):
         import importlib
 
-        return importlib.import_module("ray_tpu.util.collective")
-    if name == "state":
-        import importlib
-
-        return importlib.import_module("ray_tpu.util.state")
-    if name == "metrics":
-        import importlib
-
-        return importlib.import_module("ray_tpu.util.metrics")
+        return importlib.import_module(f"ray_tpu.util.{name}")
     raise AttributeError(name)

@@ -1,0 +1,195 @@
+"""User-defined application metrics (reference: python/ray/util/metrics.py
+Counter :137, Histogram :187, Gauge :262; export pipeline SURVEY.md §5 —
+C++ opencensus → dashboard agent → Prometheus).
+
+Here: each worker process batches metric records locally and flushes them
+to the GCS metrics table (rpc `metrics_report`) on a background thread;
+`ray_tpu.util.state.metrics()` and the dashboard's /metrics endpoint read
+the aggregated view (Prometheus text format).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_FLUSH_INTERVAL_S = 2.0
+
+_lock = threading.Lock()
+_registry: Dict[Tuple[str, tuple], dict] = {}
+_flusher_started = False
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def flush_loop():
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            try:
+                flush()
+            except Exception:
+                pass
+
+    threading.Thread(target=flush_loop, daemon=True, name="metrics-flush").start()
+
+
+def flush():
+    """Push the current snapshot to GCS (no-op when not connected)."""
+    from ray_tpu._private.worker import global_worker_maybe
+
+    w = global_worker_maybe()
+    if w is None or not w.connected or w.gcs_client is None:
+        return
+    with _lock:
+        snapshot = [
+            {
+                "name": name,
+                "tags": dict(tags),
+                "type": rec["type"],
+                "value": rec["value"] if rec["type"] != "histogram" else None,
+                "buckets": rec.get("buckets"),
+                "counts": list(rec.get("counts", [])),
+                "sum": rec.get("sum", 0.0),
+                "count": rec.get("count", 0),
+                "description": rec.get("description", ""),
+            }
+            for (name, tags), rec in _registry.items()
+        ]
+    if snapshot:
+        try:
+            w.gcs_client.call(
+                "metrics_report",
+                {"worker_id": w.worker_id.binary() if w.worker_id else b"", "metrics": snapshot},
+            )
+        except Exception:
+            pass
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Optional[Tuple[str, ...]] = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, tuple]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return (self._name, tuple(sorted(merged.items())))
+
+    @property
+    def info(self) -> dict:
+        return {
+            "name": self._name,
+            "description": self._description,
+            "tag_keys": self._tag_keys,
+            "default_tags": self._default_tags,
+        }
+
+
+class Counter(_Metric):
+    """Monotonically increasing (reference: util/metrics.py:137)."""
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc() requires value > 0")
+        key = self._key(tags)
+        with _lock:
+            rec = _registry.setdefault(
+                key, {"type": "counter", "value": 0.0, "description": self._description}
+            )
+            rec["value"] += value
+
+
+class Gauge(_Metric):
+    """Last-value-wins (reference: util/metrics.py:262)."""
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with _lock:
+            _registry[key] = {"type": "gauge", "value": float(value), "description": self._description}
+
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+
+class Histogram(_Metric):
+    """Bucketed observations (reference: util/metrics.py:187)."""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[List[float]] = None,
+        tag_keys: Optional[Tuple[str, ...]] = None,
+    ):
+        super().__init__(name, description, tag_keys)
+        bounds = boundaries if boundaries is not None else list(DEFAULT_BUCKETS)
+        if any(b <= 0 for b in bounds) or sorted(bounds) != list(bounds):
+            raise ValueError("histogram boundaries must be positive and sorted")
+        self._boundaries = list(bounds)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with _lock:
+            rec = _registry.setdefault(
+                key,
+                {
+                    "type": "histogram",
+                    "buckets": self._boundaries,
+                    "counts": [0] * (len(self._boundaries) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                    "description": self._description,
+                },
+            )
+            i = 0
+            while i < len(self._boundaries) and value > self._boundaries[i]:
+                i += 1
+            rec["counts"][i] += 1
+            rec["sum"] += value
+            rec["count"] += 1
+
+
+def prometheus_text(metrics: List[dict]) -> str:
+    """Render aggregated metric records in Prometheus exposition format."""
+    lines = []
+    by_name = defaultdict(list)
+    for m in metrics:
+        by_name[m["name"]].append(m)
+    for name, group in sorted(by_name.items()):
+        mtype = group[0]["type"]
+        desc = group[0].get("description", "")
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {mtype if mtype != 'histogram' else 'histogram'}")
+        for m in group:
+            label = ",".join(f'{k}="{v}"' for k, v in sorted((m.get("tags") or {}).items()))
+            label = "{" + label + "}" if label else ""
+            if mtype == "histogram":
+                cum = 0
+                for bound, cnt in zip(m["buckets"] + [float("inf")], m["counts"]):
+                    cum += cnt
+                    b = "+Inf" if bound == float("inf") else repr(bound)
+                    sep = "," if m.get("tags") else ""
+                    tag_body = label[1:-1] if label else ""
+                    lines.append(f'{name}_bucket{{{tag_body}{sep}le="{b}"}} {cum}')
+                lines.append(f"{name}_sum{label} {m['sum']}")
+                lines.append(f"{name}_count{label} {m['count']}")
+            else:
+                lines.append(f"{name}{label} {m['value']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,194 @@
+"""State API (reference: python/ray/util/state/api.py:110 StateApiClient,
+list_actors :781, summarize_tasks :1365; served by the dashboard state
+head aggregating GCS + raylets).
+
+Here the GCS is the aggregation point: actors/nodes/jobs/PGs come from
+its tables; per-node task/object stats come from raylet `node_stats`;
+task events come from the GCS task-event table fed by worker reports
+(reference: core_worker/task_event_buffer.h → gcs_task_manager.h:86).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu._private.worker import get_global_worker
+
+
+def _gcs():
+    w = get_global_worker()
+    if not w.connected:
+        raise RuntimeError("ray_tpu is not initialized")
+    return w.gcs_client
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    info = _gcs().call("get_cluster_info")
+    return [
+        {
+            "node_id": NodeID(n["node_id"]).hex(),
+            "state": n["state"],
+            "is_head": n.get("is_head", False),
+            "resources_total": n["resources_total"],
+            "raylet_address": n["raylet_address"],
+            "hostname": n.get("hostname", ""),
+        }
+        for n in info["nodes"].values()
+    ]
+
+
+def list_actors(filters: Optional[List[tuple]] = None) -> List[Dict[str, Any]]:
+    actors = _gcs().call("list_actors", None)
+    out = []
+    for a in actors:
+        rec = {
+            "actor_id": ActorID(a["actor_id"]).hex(),
+            "state": a["state"],
+            "class_name": a.get("class_name", ""),
+            "name": a.get("name"),
+            "node_id": NodeID(a["node_id"]).hex() if a.get("node_id") else None,
+            "pid": a.get("pid", 0),
+            "num_restarts": a.get("num_restarts", 0),
+            "death_cause": a.get("death_cause"),
+        }
+        if _matches(rec, filters):
+            out.append(rec)
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _gcs().call("list_placement_groups", None)
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _gcs().call("list_jobs", None)
+
+
+def list_tasks(filters: Optional[List[tuple]] = None, limit: int = 10000) -> List[Dict[str, Any]]:
+    events = _gcs().call("list_task_events", {"limit": limit})
+    out = []
+    for e in events:
+        if _matches(e, filters):
+            out.append(e)
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Aggregate object-store stats over all raylets."""
+    out = []
+    for n in list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            stats = _node_call(n["raylet_address"], "node_stats", {"include_objects": True})
+        except Exception:
+            continue
+        for obj in stats.get("objects", []):
+            obj["node_id"] = n["node_id"]
+            out.append(obj)
+    return out
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    out = []
+    for n in list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            stats = _node_call(n["raylet_address"], "node_stats", {})
+        except Exception:
+            continue
+        for w in stats.get("workers", []):
+            w["node_id"] = n["node_id"]
+            out.append(w)
+    return out
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Group task events by (name, state) (reference: summarize_tasks)."""
+    tasks = list_tasks()
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in tasks:
+        name = t.get("name", "?")
+        state = t.get("state", "?")
+        summary.setdefault(name, {})
+        summary[name][state] = summary[name].get(state, 0) + 1
+    return {"node_count": len([n for n in list_nodes() if n["state"] == "ALIVE"]), "summary": summary}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    actors = list_actors()
+    summary: Dict[str, Dict[str, int]] = {}
+    for a in actors:
+        cls = a.get("class_name", "?")
+        summary.setdefault(cls, {})
+        summary[cls][a["state"]] = summary[cls].get(a["state"], 0) + 1
+    return {"summary": summary}
+
+
+def metrics() -> List[Dict[str, Any]]:
+    """Aggregated user + system metric records from the GCS."""
+    return _gcs().call("metrics_get", None)
+
+
+def timeline(filename: Optional[str] = None) -> Optional[str]:
+    """Chrome-trace (catapult) export of task events (reference:
+    `ray timeline`, GcsTaskManager → chrome://tracing format)."""
+    events = _gcs().call("list_task_events", {"limit": 100000})
+    trace = []
+    for e in events:
+        start = e.get("start_time")
+        end = e.get("end_time") or time.time()
+        if start is None:
+            continue
+        trace.append(
+            {
+                "cat": "task",
+                "name": e.get("name", "task"),
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(0.0, (end - start)) * 1e6,
+                "pid": e.get("node_id", "node")[:8] if e.get("node_id") else "node",
+                "tid": e.get("worker_id", "worker")[:8] if e.get("worker_id") else "worker",
+                "args": {k: v for k, v in e.items() if isinstance(v, (str, int, float, bool))},
+            }
+        )
+    if filename is None:
+        return json.dumps(trace)
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename
+
+
+# ----------------------------------------------------------------------
+def _matches(rec: Dict[str, Any], filters: Optional[List[tuple]]) -> bool:
+    if not filters:
+        return True
+    for f in filters:
+        key, op, value = f
+        actual = rec.get(key)
+        if op in ("=", "=="):
+            if str(actual) != str(value):
+                return False
+        elif op == "!=":
+            if str(actual) == str(value):
+                return False
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return True
+
+
+_node_clients: Dict[str, Any] = {}
+
+
+def _node_call(address: str, method: str, payload: Any):
+    from ray_tpu._private import rpc
+
+    client = _node_clients.get(address)
+    if client is None:
+        client = rpc.RpcClient(address)
+        _node_clients[address] = client
+    return client.call(method, payload)

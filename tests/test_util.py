@@ -1,0 +1,165 @@
+"""util extras: ActorPool, Queue, metrics, state API, timeline.
+
+Reference test model: python/ray/tests/test_actor_pool.py, test_queue.py,
+test_metrics_agent.py, python/ray/tests/test_state_api.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def pool_actors(ray_cluster):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, v):
+            return 2 * v
+
+        def slow_double(self, v):
+            time.sleep(0.2 if v == 0 else 0.01)
+            return 2 * v
+
+    actors = [Doubler.remote() for _ in range(2)]
+    yield actors
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_ordered(pool_actors):
+    from ray_tpu.util import ActorPool
+
+    pool = ActorPool(pool_actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), list(range(8))))
+    assert out == [2 * v for v in range(8)]
+
+
+def test_actor_pool_unordered(pool_actors):
+    from ray_tpu.util import ActorPool
+
+    pool = ActorPool(pool_actors)
+    out = list(pool.map_unordered(lambda a, v: a.slow_double.remote(v), list(range(6))))
+    assert sorted(out) == [2 * v for v in range(6)]
+
+
+def test_actor_pool_submit_get(pool_actors):
+    from ray_tpu.util import ActorPool
+
+    pool = ActorPool(pool_actors)
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+
+
+def test_queue_basic(ray_cluster):
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    q.shutdown()
+
+
+def test_queue_producers_consumers(ray_cluster):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+
+    @ray_tpu.remote
+    def produce(q, lo, hi):
+        for i in range(lo, hi):
+            q.put(i)
+        return hi - lo
+
+    n = ray_tpu.get([produce.remote(q, 0, 5), produce.remote(q, 5, 10)])
+    assert sum(n) == 10
+    got = sorted(q.get() for _ in range(10))
+    assert got == list(range(10))
+    q.shutdown()
+
+
+def test_state_api_actors_and_nodes(ray_cluster):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Sleeper:
+        def ping(self):
+            return "pong"
+
+    a = Sleeper.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    actors = state.list_actors([("state", "=", "ALIVE")])
+    assert any(x["class_name"].endswith("Sleeper") for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["state"] == "ALIVE"
+    ray_tpu.kill(a)
+
+
+def test_task_events_and_timeline(ray_cluster, tmp_path):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def traced_task(x):
+        return x + 1
+
+    ray_tpu.get([traced_task.remote(i) for i in range(5)])
+    # worker flushes events at most 1/s; poll the GCS table
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        events = [e for e in state.list_tasks() if "traced_task" in e["name"]]
+        if len(events) >= 5:
+            break
+        time.sleep(0.5)
+    assert len(events) >= 5
+    assert all(e["state"] == "FINISHED" for e in events)
+    summary = state.summarize_tasks()
+    assert any("traced_task" in name for name in summary["summary"])
+
+    out = state.timeline(str(tmp_path / "trace.json"))
+    import json
+
+    with open(out) as f:
+        trace = json.load(f)
+    assert any("traced_task" in ev["name"] for ev in trace)
+
+
+def test_metrics_roundtrip(ray_cluster):
+    from ray_tpu.util import metrics as m
+    from ray_tpu.util import state
+
+    c = m.Counter("test_requests_total", description="reqs", tag_keys=("route",))
+    c.inc(1.0, tags={"route": "a"})
+    c.inc(2.0, tags={"route": "a"})
+    g = m.Gauge("test_inflight")
+    g.set(7.0)
+    h = m.Histogram("test_latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    m.flush()
+
+    deadline = time.monotonic() + 10
+    recs = []
+    while time.monotonic() < deadline:
+        recs = state.metrics()
+        if {r["name"] for r in recs} >= {"test_requests_total", "test_inflight", "test_latency_s"}:
+            break
+        time.sleep(0.5)
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["test_requests_total"]["value"] == 3.0
+    assert by_name["test_inflight"]["value"] == 7.0
+    assert by_name["test_latency_s"]["count"] == 3
+    assert by_name["test_latency_s"]["counts"] == [1, 1, 1]
+
+    text = m.prometheus_text(recs)
+    assert "test_requests_total" in text and 'le="+Inf"' in text
