@@ -1,0 +1,38 @@
+"""ray_tpu.rllib — reinforcement learning at scale (reference: rllib/).
+
+JAX-native new-API-stack equivalent: RLModule (pure-function nets),
+Learner (jitted update over a device mesh), EnvRunnerGroup (CPU actors),
+Algorithm (a tune.Trainable).  Algorithms: PPO, DQN, IMPALA.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import QModule, RLModule, RLModuleSpec
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "Learner",
+    "LearnerGroup",
+    "RLModule",
+    "RLModuleSpec",
+    "QModule",
+    "SingleAgentEnvRunner",
+    "EnvRunnerGroup",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+    "SampleBatch",
+]

@@ -1,0 +1,250 @@
+"""Algorithm + AlgorithmConfig (reference:
+rllib/algorithms/algorithm.py:229 — step() :889, training_step() :1658,
+setup() :610; algorithm_config.py builder).
+
+An Algorithm is a tune.Trainable: `algo.train()` runs one iteration;
+Tuner(PPO, ...) sweeps it; checkpoints flow through the same
+save/restore hooks the reference uses (Checkpointable)."""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent builder (reference: rllib/algorithms/algorithm_config.py).
+
+    cfg = (PPOConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=2)
+           .training(lr=3e-4, train_batch_size=2000))
+    algo = cfg.build()
+    """
+
+    algo_class: Optional[Type["Algorithm"]] = None
+
+    def __init__(self):
+        # environment
+        self.env: Optional[str] = None
+        self.env_creator: Optional[Callable[[], Any]] = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        self.num_cpus_per_env_runner = 1.0
+        self.restart_failed_env_runners = True
+        # training
+        self.gamma = 0.99
+        self.lr = 5e-5
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 1
+        self.grad_clip: Optional[float] = None
+        # learners
+        self.num_learners = 0
+        self.num_cpus_per_learner = 1.0
+        # module
+        self.model: Dict[str, Any] = {"hidden": (64, 64), "vf_share_layers": False}
+        # debug
+        self.seed = 0
+
+    # -- builder steps ---------------------------------------------------
+    def environment(self, env: Optional[str] = None, *, env_creator=None, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_creator is not None:
+            self.env_creator = env_creator
+        if env_config:
+            self.env_config.update(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None, num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None, num_cpus_per_env_runner: Optional[float] = None,
+                    restart_failed_env_runners: Optional[bool] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        if restart_failed_env_runners is not None:
+            self.restart_failed_env_runners = restart_failed_env_runners
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k) and k != "model":
+                raise ValueError(f"unknown training option {k!r}")
+            if k == "model":
+                self.model.update(v)
+            else:
+                setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None, num_cpus_per_learner: Optional[float] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_cpus_per_learner is not None:
+            self.num_cpus_per_learner = num_cpus_per_learner
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- finalize --------------------------------------------------------
+    def make_env_creator(self) -> Callable[[], Any]:
+        if self.env_creator is not None:
+            return self.env_creator
+        env_name, env_cfg = self.env, dict(self.env_config)
+        if env_name is None:
+            raise ValueError("config.environment(...) must set an env")
+
+        def creator():
+            import gymnasium as gym
+
+            return gym.make(env_name, **env_cfg)
+
+        return creator
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise NotImplementedError("use a concrete config (PPOConfig/DQNConfig/...)")
+        return self.algo_class(self)
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_") and not callable(v)}
+
+    def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+
+class Algorithm(Trainable):
+    """Drives EnvRunnerGroup + LearnerGroup (reference: algorithm.py:229)."""
+
+    config_class: Type[AlgorithmConfig] = AlgorithmConfig
+    learner_class = None  # set by subclasses
+
+    def __init__(self, config=None, trial_dir: str = "."):
+        # Accept AlgorithmConfig directly or a tune config dict (for
+        # Tuner(PPO, param_space={...}))
+        if isinstance(config, AlgorithmConfig):
+            self.algo_config = config
+            tune_cfg = {}
+        else:
+            tune_cfg = dict(config or {})
+            self.algo_config = self.config_class().update_from_dict(tune_cfg)
+        super().__init__(tune_cfg, trial_dir)
+
+    # -- Trainable hooks -------------------------------------------------
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        env_creator = cfg.make_env_creator()
+        probe_env = env_creator()
+        self.module_spec = RLModuleSpec.from_gym_env(
+            probe_env,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+            vf_share_layers=cfg.model.get("vf_share_layers", False),
+        )
+        probe_env.close()
+        self.env_runner_group = EnvRunnerGroup(
+            env_creator,
+            self.module_spec,
+            num_env_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            gamma=cfg.gamma,
+            lambda_=getattr(cfg, "lambda_", 0.95),
+            compute_advantages=self._needs_advantages(),
+            num_cpus_per_runner=cfg.num_cpus_per_env_runner,
+            restart_failed=cfg.restart_failed_env_runners,
+            seed=cfg.seed,
+        )
+        self.learner_group = LearnerGroup(
+            type(self).learner_class,
+            self.module_spec,
+            config=self._learner_config(),
+            num_learners=cfg.num_learners,
+            resources={"num_cpus": cfg.num_cpus_per_learner},
+        )
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._timesteps_total = 0
+
+    def _needs_advantages(self) -> bool:
+        return True
+
+    def _learner_config(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        return {"lr": cfg.lr, "grad_clip": cfg.grad_clip, "gamma": cfg.gamma, "seed": cfg.seed}
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.time()
+        results = self.training_step()
+        results.setdefault("timesteps_total", self._timesteps_total)
+        results.update(self.env_runner_group.aggregate_metrics())
+        results["time_this_iter_s"] = time.time() - t0
+        return results
+
+    def train(self) -> Dict[str, Any]:
+        """Standalone use: algo.train() outside a Tuner."""
+        self.iteration += 1
+        out = self.step()
+        out.setdefault("training_iteration", self.iteration)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- checkpoints (reference: rllib/utils/checkpoints.py
+    # Checkpointable) ----------------------------------------------------
+    def save_checkpoint(self, checkpoint_dir: str):
+        state = {
+            "learner": self.learner_group.get_state(),
+            "timesteps_total": self._timesteps_total,
+            "config": self.algo_config.to_dict(),
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str):
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self._timesteps_total = state.get("timesteps_total", 0)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str) -> "Algorithm":
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        cfg = cls.config_class().update_from_dict(state["config"])
+        algo = cls(cfg)
+        algo.load_checkpoint(checkpoint_dir)
+        return algo
+
+    def get_policy_weights(self):
+        return self.learner_group.get_weights()
+
+    def cleanup(self):
+        self.env_runner_group.stop()
+        self.learner_group.shutdown()
+
+    stop = cleanup

@@ -1,0 +1,268 @@
+"""DQN (reference: rllib/algorithms/dqn/ — double-Q + dueling +
+prioritized replay).  The env runners collect with epsilon-greedy
+exploration; the learner's jitted update does double-Q targets."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import QModule
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import (
+    ACTIONS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+    TERMINATEDS,
+)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.replay_buffer_capacity = 50_000
+        self.prioritized_replay = True
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # env steps
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_timesteps = 10_000
+        self.rollout_fragment_length = 4
+        self.num_env_runners = 0  # DQN default: inline sampling
+        self.sample_batch_size = 64
+        self.updates_per_iteration = 32
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQNLearner(Learner):
+    """Double-Q learner with a target network."""
+
+    def __init__(self, module_spec, config=None):
+        import jax
+
+        self.qmodule = QModule(module_spec)
+        super().__init__(module_spec, config)
+        # Learner.__init__ built policy params via self.module; override
+        # with Q-net params.
+        self._rng, init_rng = jax.random.split(self._rng)
+        self.params = self.qmodule.init(init_rng)
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+
+    def _build_update_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        gamma = self.config.get("gamma", 0.99)
+
+        def update(params, target_params, opt_state, batch, rng):
+            def loss_fn(p):
+                q = self.qmodule.q_values(p, batch[OBS])
+                q_taken = jnp.take_along_axis(
+                    q, batch[ACTIONS][..., None].astype(jnp.int32), axis=-1
+                )[..., 0]
+                next_q_online = self.qmodule.q_values(p, batch[NEXT_OBS])
+                next_act = next_q_online.argmax(axis=-1)
+                next_q = self.qmodule.q_values(target_params, batch[NEXT_OBS])
+                next_val = jnp.take_along_axis(next_q, next_act[..., None], axis=-1)[..., 0]
+                target = batch[REWARDS] + gamma * (1.0 - batch[TERMINATEDS].astype(jnp.float32)) * next_val
+                td = q_taken - jax.lax.stop_gradient(target)
+                weights = batch.get("weights", jnp.ones_like(td))
+                loss = (weights * jnp.square(td)).mean()
+                return loss, {"td_error_abs": jnp.abs(td), "qf_mean": q_taken.mean()}
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        # no donation: target_params may alias params right after a target
+        # sync (tree_map identity keeps the same buffers)
+        return jax.jit(update)
+
+    def update_from_batch(self, batch) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        self._rng, rng = jax.random.split(self._rng)
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items() if k != "batch_indexes"}
+        self.params, self.opt_state, aux = self._update_fn(
+            self.params, self.target_params, self.opt_state, jbatch, rng
+        )
+        self._last_td = np.asarray(aux.pop("td_error_abs"))
+        self._metrics = {k: float(v) for k, v in aux.items()}
+        return self._metrics
+
+    def last_td_error(self) -> np.ndarray:
+        return self._last_td
+
+    def update_target(self):
+        import jax
+
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+
+    def get_state(self):
+        state = super().get_state()
+        import jax
+
+        state["target"] = jax.tree_util.tree_map(np.asarray, self.target_params)
+        return state
+
+    def set_state(self, state):
+        super().set_state(state)
+        import jax.numpy as jnp
+        import jax
+
+        self.target_params = jax.tree_util.tree_map(jnp.asarray, state["target"])
+
+
+class _EpsilonGreedySampler:
+    """Inline sampler: epsilon-greedy over Q-values with transition
+    collection into (obs, action, reward, next_obs, terminated)."""
+
+    def __init__(self, env_creator, qmodule: QModule, cfg: "DQNConfig"):
+        import gymnasium as gym
+        import jax
+
+        self.envs = gym.vector.SyncVectorEnv([env_creator for _ in range(cfg.num_envs_per_env_runner)])
+        self.qmodule = qmodule
+        self.cfg = cfg
+        self._q_fn = jax.jit(qmodule.q_values)
+        obs, _ = self.envs.reset(seed=cfg.seed)
+        self._obs = obs
+        self._rng = np.random.default_rng(cfg.seed)
+        self._episode_returns = np.zeros(self.envs.num_envs)
+        self._episode_lens = np.zeros(self.envs.num_envs, dtype=np.int64)
+        self.completed_returns = []
+        self.completed_lens = []
+
+    def epsilon(self, t: int) -> float:
+        c = self.cfg
+        frac = min(1.0, t / max(1, c.epsilon_decay_timesteps))
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def sample(self, params, num_steps: int, t: int) -> SampleBatch:
+        cols = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS)}
+        n_envs = self.envs.num_envs
+        for _ in range(num_steps):
+            eps = self.epsilon(t)
+            q = np.asarray(self._q_fn(params, self._obs))
+            greedy = q.argmax(axis=-1)
+            rand = self._rng.integers(0, q.shape[-1], n_envs)
+            actions = np.where(self._rng.random(n_envs) < eps, rand, greedy)
+            next_obs, rewards, term, trunc, info = self.envs.step(actions)
+            real_next = next_obs.copy()
+            cols[OBS].append(self._obs.copy())
+            cols[ACTIONS].append(actions)
+            cols[REWARDS].append(np.asarray(rewards, np.float32))
+            cols[NEXT_OBS].append(real_next)
+            cols[TERMINATEDS].append(term.copy())
+            self._episode_returns += rewards
+            self._episode_lens += 1
+            for i in np.where(term | trunc)[0]:
+                self.completed_returns.append(float(self._episode_returns[i]))
+                self.completed_lens.append(int(self._episode_lens[i]))
+                self._episode_returns[i] = 0.0
+                self._episode_lens[i] = 0
+            self._obs = next_obs
+            t += n_envs
+        return SampleBatch({k: np.concatenate(v, axis=0) for k, v in cols.items()})
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+    learner_class = DQNLearner
+
+    def _needs_advantages(self) -> bool:
+        return False
+
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        env_creator = cfg.make_env_creator()
+        probe = env_creator()
+        self.module_spec = RLModuleSpec.from_gym_env(probe, hidden=tuple(cfg.model.get("hidden", (64, 64))))
+        probe.close()
+        self.learner = DQNLearner(self.module_spec, self._learner_config())
+        self.sampler = _EpsilonGreedySampler(env_creator, self.learner.qmodule, cfg)
+        self.buffer = (
+            PrioritizedReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+            if cfg.prioritized_replay
+            else ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        )
+        self._timesteps_total = 0
+        self._last_target_update = 0
+
+    def _learner_config(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        return {"lr": cfg.lr, "grad_clip": cfg.grad_clip, "gamma": cfg.gamma, "seed": cfg.seed}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batch = self.sampler.sample(self.learner.params, cfg.sample_batch_size, self._timesteps_total)
+        self.buffer.add(batch)
+        self._timesteps_total += batch.count
+        metrics: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        if self._timesteps_total >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                replay = self.buffer.sample(cfg.train_batch_size)
+                metrics.update(self.learner.update_from_batch(replay))
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    self.buffer.update_priorities(replay["batch_indexes"], self.learner.last_td_error())
+            if self._timesteps_total - self._last_target_update >= cfg.target_network_update_freq:
+                self.learner.update_target()
+                self._last_target_update = self._timesteps_total
+        metrics["epsilon"] = self.sampler.epsilon(self._timesteps_total)
+        metrics["num_env_steps_sampled"] = self._timesteps_total
+        rets = self.sampler.completed_returns[-100:]
+        metrics["episode_return_mean"] = float(np.mean(rets)) if rets else None
+        return metrics
+
+    def step(self) -> Dict[str, Any]:
+        import time
+
+        t0 = time.time()
+        out = self.training_step()
+        out.setdefault("timesteps_total", self._timesteps_total)
+        out["time_this_iter_s"] = time.time() - t0
+        return out
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        state = {
+            "learner": self.learner.get_state(),
+            "timesteps_total": self._timesteps_total,
+            "config": self.algo_config.to_dict(),
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner.set_state(state["learner"])
+        self._timesteps_total = state.get("timesteps_total", 0)
+
+    def cleanup(self):
+        self.sampler.envs.close()
+
+    stop = cleanup

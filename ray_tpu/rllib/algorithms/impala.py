@@ -1,0 +1,144 @@
+"""IMPALA (reference: rllib/algorithms/impala/impala.py + the learner
+queue threads in rllib/execution/learner_thread.py): asynchronous
+actor-learner — env runners sample against slightly-stale policies;
+the learner corrects off-policy-ness with V-trace."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.utils.sample_batch import (
+    ACTIONS,
+    LOGP,
+    OBS,
+    REWARDS,
+    SampleBatch,
+    TERMINATEDS,
+    VF_PREDS,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rollout_fragment_length = 50
+        self.num_env_runners = 2
+        self.max_requests_in_flight = 2
+        self.broadcast_interval = 1  # learner steps between weight pushes
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+class IMPALALearner(Learner):
+    """V-trace actor-critic loss (Espeholt et al. 2018), computed fully
+    inside jit with lax.scan over the time axis."""
+
+    def compute_loss(self, params, batch: Dict[str, Any], rng):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        rho_clip = cfg.get("vtrace_clip_rho", 1.0)
+        c_clip = cfg.get("vtrace_clip_c", 1.0)
+
+        logp, entropy, values = self.module.forward_train(params, batch[OBS], batch[ACTIONS])
+        # [T] sequences (the runner ships time-major fragments per env)
+        behaviour_logp = batch[LOGP]
+        rhos = jnp.exp(logp - behaviour_logp)
+        clipped_rho = jnp.minimum(rho_clip, rhos)
+        clipped_c = jnp.minimum(c_clip, rhos)
+
+        rewards = batch[REWARDS]
+        discounts = gamma * (1.0 - batch[TERMINATEDS].astype(jnp.float32))
+        # bootstrap with the final value (stop-gradient target chain)
+        v = jax.lax.stop_gradient(values)
+        next_v = jnp.concatenate([v[1:], v[-1:]], axis=0)
+        deltas = clipped_rho * (rewards + discounts * next_v - v)
+
+        def scan_fn(carry, t):
+            acc = deltas[t] + discounts[t] * clipped_c[t] * carry
+            return acc, acc
+
+        T = rewards.shape[0]
+        _, vs_minus_v = jax.lax.scan(scan_fn, jnp.zeros_like(v[0]), jnp.arange(T - 1, -1, -1))
+        vs_minus_v = vs_minus_v[::-1]
+        vs = v + vs_minus_v
+        next_vs = jnp.concatenate([vs[1:], v[-1:]], axis=0)
+
+        pg_adv = jax.lax.stop_gradient(clipped_rho * (rewards + discounts * next_vs - v))
+        pi_loss = -(logp * pg_adv).mean()
+        vf_loss = 0.5 * jnp.square(values - jax.lax.stop_gradient(vs)).mean()
+        ent = entropy.mean()
+        total = pi_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss - cfg.get("entropy_coeff", 0.01) * ent
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss, "entropy": ent, "mean_rho": rhos.mean()}
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+    learner_class = IMPALALearner
+
+    def _needs_advantages(self) -> bool:
+        return False  # V-trace replaces GAE
+
+    def setup(self, config: Dict[str, Any]):
+        super().setup(config)
+        self._in_flight: Dict[Any, int] = {}  # sample ObjectRef -> runner idx
+        self._steps_since_broadcast = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        """Async pipeline: keep max_requests_in_flight sample() calls
+        outstanding per runner; each arriving fragment is trained on
+        immediately (reference: impala.py async request pipeline)."""
+        import ray_tpu
+
+        cfg = self.algo_config
+        group = self.env_runner_group
+        if group.local_runner is not None:
+            # degenerate sync mode
+            batch = group.sample(cfg.rollout_fragment_length)
+            metrics = self.learner_group.update_from_batch(batch)
+            group.sync_weights(self.learner_group.get_weights())
+            self._timesteps_total += batch.count
+            metrics["num_env_steps_sampled"] = batch.count
+            return metrics
+
+        # fill the pipeline
+        for i, runner in enumerate(group.runners):
+            outstanding = sum(1 for v in self._in_flight.values() if v == i)
+            for _ in range(cfg.max_requests_in_flight - outstanding):
+                self._in_flight[runner.sample.remote(cfg.rollout_fragment_length)] = i
+
+        ready, _ = ray_tpu.wait(list(self._in_flight), num_returns=1, timeout=30.0)
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        for ref in ready:
+            i = self._in_flight.pop(ref)
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("impala: lost sample from runner %d: %s", i, e)
+                continue
+            metrics = self.learner_group.update_from_batch(batch)
+            steps += batch.count
+            self._steps_since_broadcast += 1
+            if self._steps_since_broadcast >= cfg.broadcast_interval:
+                group.sync_weights(self.learner_group.get_weights())
+                self._steps_since_broadcast = 0
+            # immediately re-request from this runner
+            self._in_flight[group.runners[i].sample.remote(cfg.rollout_fragment_length)] = i
+        self._timesteps_total += steps
+        metrics["num_env_steps_sampled"] = steps
+        return metrics

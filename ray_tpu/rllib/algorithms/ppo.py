@@ -1,0 +1,107 @@
+"""PPO (reference: rllib/algorithms/ppo/ppo.py:374, training_step :400;
+loss parity with rllib/algorithms/ppo/torch/ppo_torch_learner.py —
+clipped surrogate + clipped value loss + entropy bonus).
+
+The whole update is one jitted function on the learner; rollouts come
+from CPU env-runner actors (SURVEY.md §2.5: env runners stay CPU actors,
+learner → JAX)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.utils.postprocessing import standardize
+from ray_tpu.rllib.utils.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGP,
+    OBS,
+    SampleBatch,
+    VALUE_TARGETS,
+    VF_PREDS,
+)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.lambda_ = 0.95
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.kl_coeff = 0.0  # clip-only variant by default (modern PPO)
+        self.num_epochs = 8
+        self.minibatch_size = 128
+        self.train_batch_size = 4000
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+class PPOLearner(Learner):
+    def compute_loss(self, params, batch: Dict[str, Any], rng):
+        import jax.numpy as jnp
+
+        logp, entropy, value = self.module.forward_train(params, batch[OBS], batch[ACTIONS])
+        ratio = jnp.exp(logp - batch[LOGP])
+        adv = batch[ADVANTAGES]
+        clip = self.config.get("clip_param", 0.3)
+        surrogate = jnp.minimum(ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+
+        vf_clip = self.config.get("vf_clip_param", 10.0)
+        vf_err = jnp.clip((value - batch[VALUE_TARGETS]) ** 2, 0.0, vf_clip ** 2)
+
+        pi_loss = -surrogate.mean()
+        vf_loss = vf_err.mean()
+        ent = entropy.mean()
+        total = (
+            pi_loss
+            + self.config.get("vf_loss_coeff", 0.5) * vf_loss
+            - self.config.get("entropy_coeff", 0.0) * ent
+        )
+        metrics = {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "mean_kl": (batch[LOGP] - logp).mean(),
+        }
+        return total, metrics
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+    learner_class = PPOLearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        out = super()._learner_config()
+        out.update(
+            clip_param=cfg.clip_param,
+            vf_clip_param=cfg.vf_clip_param,
+            vf_loss_coeff=cfg.vf_loss_coeff,
+            entropy_coeff=cfg.entropy_coeff,
+        )
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        # ① synchronous parallel rollouts (ppo.py:408)
+        runners = max(1, cfg.num_env_runners)
+        per_runner = max(1, cfg.train_batch_size // (runners * cfg.num_envs_per_env_runner))
+        batch = self.env_runner_group.sample(per_runner)
+        self._timesteps_total += batch.count
+        batch[ADVANTAGES] = standardize(batch[ADVANTAGES])
+        # ② minibatch SGD epochs on the learner (ppo.py:439)
+        metrics = self.learner_group.update_from_batch(
+            batch, minibatch_size=cfg.minibatch_size, num_epochs=cfg.num_epochs
+        )
+        # ③ broadcast fresh weights (ppo.py:466)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        out = dict(metrics)
+        out["num_env_steps_sampled"] = batch.count
+        return out

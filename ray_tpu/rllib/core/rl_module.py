@@ -1,0 +1,172 @@
+"""RLModule: the neural-network component of an algorithm (reference:
+rllib/core/rl_module/rl_module.py — forward_inference /
+forward_exploration / forward_train).
+
+JAX-native redesign: an RLModule is a *pure-function* bundle — flax
+module + explicit params — so the same definition runs in env-runner
+actors (CPU inference) and in the learner's jitted TPU train step with no
+framework switches.  Action distributions are computed inside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Builds an RLModule for an env's spaces (reference:
+    rllib/core/rl_module/rl_module.py RLModuleSpec)."""
+
+    observation_dim: int
+    action_dim: int
+    discrete: bool = True
+    hidden: Tuple[int, ...] = (64, 64)
+    vf_share_layers: bool = False
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def from_gym_env(cls, env, hidden=(64, 64), vf_share_layers=False) -> "RLModuleSpec":
+        import gymnasium as gym
+
+        obs_space = env.single_observation_space if hasattr(env, "single_observation_space") else env.observation_space
+        act_space = env.single_action_space if hasattr(env, "single_action_space") else env.action_space
+        obs_dim = int(np.prod(obs_space.shape))
+        if isinstance(act_space, gym.spaces.Discrete):
+            return cls(obs_dim, int(act_space.n), True, tuple(hidden), vf_share_layers)
+        return cls(obs_dim, int(np.prod(act_space.shape)), False, tuple(hidden), vf_share_layers)
+
+    def build(self) -> "RLModule":
+        return RLModule(self)
+
+
+class _PiVfNet(nn.Module):
+    spec: RLModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        spec = self.spec
+        x = obs.reshape(obs.shape[0], -1).astype(spec.dtype)
+
+        def torso(tag):
+            h = x
+            for i, w in enumerate(spec.hidden):
+                h = nn.tanh(nn.Dense(w, dtype=spec.dtype, name=f"{tag}_dense_{i}")(h))
+            return h
+
+        pi_h = torso("pi")
+        vf_h = pi_h if self.spec.vf_share_layers else torso("vf")
+        if spec.discrete:
+            logits = nn.Dense(spec.action_dim, dtype=spec.dtype, name="pi_head")(pi_h)
+        else:
+            mean = nn.Dense(spec.action_dim, dtype=spec.dtype, name="pi_head")(pi_h)
+            log_std = self.param("log_std", nn.initializers.zeros, (spec.action_dim,), spec.dtype)
+            logits = jnp.concatenate([mean, jnp.broadcast_to(log_std, mean.shape)], axis=-1)
+        value = nn.Dense(1, dtype=spec.dtype, name="vf_head")(vf_h)[..., 0]
+        return logits, value
+
+
+class RLModule:
+    """Pure-function policy+value bundle.  All forward_* helpers are
+    jittable; params flow explicitly (functional JAX style — the learner
+    owns the authoritative copy)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self.net = _PiVfNet(spec)
+
+    def init(self, rng) -> Any:
+        dummy = jnp.zeros((1, self.spec.observation_dim), self.spec.dtype)
+        return self.net.init(rng, dummy)["params"]
+
+    # -- distribution math (jit-safe) -----------------------------------
+    def _dist_sample(self, logits, rng):
+        if self.spec.discrete:
+            return jax.random.categorical(rng, logits, axis=-1)
+        mean, log_std = jnp.split(logits, 2, axis=-1)
+        return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+    def _dist_logp(self, logits, actions):
+        if self.spec.discrete:
+            logp_all = jax.nn.log_softmax(logits)
+            return jnp.take_along_axis(logp_all, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        mean, log_std = jnp.split(logits, 2, axis=-1)
+        var = jnp.exp(2 * log_std)
+        logp = -0.5 * (((actions - mean) ** 2) / var + 2 * log_std + jnp.log(2 * jnp.pi))
+        return logp.sum(axis=-1)
+
+    def _dist_entropy(self, logits):
+        if self.spec.discrete:
+            p = jax.nn.softmax(logits)
+            return -(p * jax.nn.log_softmax(logits)).sum(axis=-1)
+        _, log_std = jnp.split(logits, 2, axis=-1)
+        return (log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum(axis=-1)
+
+    # -- forward passes (reference: rl_module.py forward_{inference,
+    # exploration,train}) ------------------------------------------------
+    def forward_inference(self, params, obs):
+        """Greedy/deterministic actions."""
+        logits, value = self.net.apply({"params": params}, obs)
+        if self.spec.discrete:
+            return logits.argmax(axis=-1), value
+        mean, _ = jnp.split(logits, 2, axis=-1)
+        return mean, value
+
+    def forward_exploration(self, params, obs, rng):
+        """Stochastic actions + logp + value (rollout collection)."""
+        logits, value = self.net.apply({"params": params}, obs)
+        actions = self._dist_sample(logits, rng)
+        logp = self._dist_logp(logits, actions)
+        return actions, logp, value
+
+    def forward_train(self, params, obs, actions):
+        """(logp, entropy, value) for the learner loss."""
+        logits, value = self.net.apply({"params": params}, obs)
+        return self._dist_logp(logits, actions), self._dist_entropy(logits), value
+
+    # -- weights ---------------------------------------------------------
+    @staticmethod
+    def get_weights(params) -> Any:
+        return jax.tree_util.tree_map(np.asarray, params)
+
+    @staticmethod
+    def set_weights(weights) -> Any:
+        return jax.tree_util.tree_map(jnp.asarray, weights)
+
+
+class QModule:
+    """Q-network bundle for value-based algorithms (DQN family)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        if not spec.discrete:
+            raise ValueError("QModule requires a discrete action space")
+        self.spec = spec
+
+        class _QNet(nn.Module):
+            spec_: RLModuleSpec
+
+            @nn.compact
+            def __call__(self, obs):
+                s = self.spec_
+                h = obs.reshape(obs.shape[0], -1).astype(s.dtype)
+                for i, w in enumerate(s.hidden):
+                    h = nn.relu(nn.Dense(w, dtype=s.dtype, name=f"q_dense_{i}")(h))
+                # dueling heads (reference: rllib DQN dueling=True default)
+                adv = nn.Dense(s.action_dim, dtype=s.dtype, name="adv_head")(h)
+                val = nn.Dense(1, dtype=s.dtype, name="val_head")(h)
+                return val + adv - adv.mean(axis=-1, keepdims=True)
+
+        self.net = _QNet(spec)
+
+    def init(self, rng):
+        dummy = jnp.zeros((1, self.spec.observation_dim), self.spec.dtype)
+        return self.net.init(rng, dummy)["params"]
+
+    def q_values(self, params, obs):
+        return self.net.apply({"params": params}, obs)
