@@ -1,0 +1,38 @@
+"""ray_tpu.serve — model serving (reference: python/ray/serve).
+
+Controller actor reconciles deployments → replica actors; requests route
+via power-of-two-choices; an aiohttp proxy serves HTTP; @serve.batch
+coalesces requests into TPU-friendly batches.
+"""
+
+from ray_tpu.serve._private.common import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "start",
+    "status",
+    "delete",
+    "shutdown",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "AutoscalingConfig",
+    "DeploymentConfig",
+    "batch",
+]

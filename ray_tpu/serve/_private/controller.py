@@ -1,0 +1,198 @@
+"""ServeController actor (reference: serve/_private/controller.py +
+deployment_state.py): reconciles desired deployment configs against live
+replica actors; rolling updates on version change; queue-depth
+autoscaling."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    def __init__(self):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self.deployments: Dict[str, dict] = {}  # name -> {config, init, replicas}
+        self._loop_task = None
+        self._stopped = False
+        self._last_scale_action: Dict[str, float] = {}
+        self._load_history: Dict[str, List[float]] = {}
+
+    async def _ensure_loop(self):
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_event_loop().create_task(self._reconcile_loop())
+
+    # -- API (called by serve.run / handles) ----------------------------
+    async def deploy(self, config_dict: dict, serialized_init) -> bool:
+        """Create or update a deployment; rolling update on version change."""
+        await self._ensure_loop()
+        name = config_dict["name"]
+        existing = self.deployments.get(name)
+        self.deployments[name] = {
+            "config": config_dict,
+            "init": serialized_init,
+            "replicas": existing["replicas"] if existing else [],
+            "target": config_dict["num_replicas"],
+        }
+        if existing and existing["config"].get("version") != config_dict.get("version"):
+            # mark old-version replicas for replacement (rolling)
+            for r in self.deployments[name]["replicas"]:
+                r["stale"] = True
+        await self._reconcile_once()
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        dep = self.deployments.pop(name, None)
+        if dep:
+            for r in dep["replicas"]:
+                self._stop_replica(r)
+        return True
+
+    async def get_replicas(self, name: str) -> List[dict]:
+        dep = self.deployments.get(name)
+        if not dep:
+            return []
+        return [
+            {"replica_id": r["replica_id"], "actor_name": r["actor_name"]}
+            for r in dep["replicas"]
+            if r["state"] == "RUNNING" and not r.get("stale")
+        ]
+
+    async def list_deployments(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "config": dep["config"],
+                "num_running": sum(1 for r in dep["replicas"] if r["state"] == "RUNNING"),
+                "target": dep["target"],
+            }
+            for name, dep in self.deployments.items()
+        }
+
+    async def record_load(self, name: str, ongoing_per_replica: float):
+        """Routers report observed queue depth for autoscaling."""
+        self._load_history.setdefault(name, []).append(ongoing_per_replica)
+        self._load_history[name] = self._load_history[name][-60:]
+
+    async def shutdown(self):
+        self._stopped = True
+        for name in list(self.deployments):
+            await self.delete_deployment(name)
+        return True
+
+    # -- reconciliation --------------------------------------------------
+    async def _reconcile_loop(self):
+        while not self._stopped:
+            try:
+                await self._reconcile_once()
+            except Exception:
+                logger.exception("serve reconcile failed")
+            await asyncio.sleep(0.5)
+
+    async def _reconcile_once(self):
+        for name, dep in self.deployments.items():
+            cfg = dep["config"]
+            replicas = dep["replicas"]
+            # drop dead handles
+            for r in list(replicas):
+                if r["state"] == "DEAD":
+                    replicas.remove(r)
+            self._autoscale(name, dep)
+            target = dep["target"]
+            fresh = [r for r in replicas if not r.get("stale")]
+            # rolling replacement: start fresh replicas first, then retire
+            # stale ones once enough fresh are running
+            while len(fresh) < target:
+                r = self._start_replica(name, cfg, dep["init"])
+                replicas.append(r)
+                fresh.append(r)
+            running_fresh = [r for r in fresh if r["state"] == "RUNNING"]
+            stale = [r for r in replicas if r.get("stale")]
+            if len(running_fresh) >= target:
+                for r in stale:
+                    self._stop_replica(r)
+                    replicas.remove(r)
+            # scale down
+            extra = len(fresh) - target
+            for r in list(fresh)[:max(0, extra)]:
+                self._stop_replica(r)
+                replicas.remove(r)
+            # health-check STARTING replicas: submit one ping and poll its
+            # completion with a zero-timeout wait — no blocked threads
+            for r in replicas:
+                if r["state"] != "STARTING":
+                    continue
+                if "ping_ref" not in r:
+                    r["ping_ref"] = r["actor"].ping.remote()
+                ready, _ = self._ray.wait([r["ping_ref"]], num_returns=1, timeout=0)
+                if ready:
+                    try:
+                        self._ray.get(r.pop("ping_ref"))
+                        r["state"] = "RUNNING"
+                    except Exception:
+                        r["state"] = "DEAD"
+
+    def _start_replica(self, name: str, cfg: dict, init) -> dict:
+        from ray_tpu.serve._private.replica import Replica
+
+        rid = f"{name}#{uuid.uuid4().hex[:6]}"
+        actor_name = f"SERVE_REPLICA::{rid}"
+        opts = dict(cfg.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts["name"] = actor_name
+        opts["namespace"] = "serve"
+        opts["max_concurrency"] = 1000
+        actor = self._ray.remote(**opts)(Replica).remote(
+            rid, name, init, cfg.get("user_config"), cfg.get("max_ongoing_requests", 100)
+        )
+        logger.info("serve: started replica %s", rid)
+        return {
+            "replica_id": rid,
+            "actor": actor,
+            "actor_name": actor_name,
+            "state": "STARTING",
+            "version": cfg.get("version", "1"),
+        }
+
+    def _stop_replica(self, r):
+        try:
+            self._ray.kill(r["actor"])
+        except Exception:
+            pass
+        r["state"] = "DEAD"
+        logger.info("serve: stopped replica %s", r["replica_id"])
+
+    def _autoscale(self, name: str, dep):
+        cfg = dep["config"]
+        auto = cfg.get("autoscaling_config")
+        if not auto:
+            dep["target"] = cfg["num_replicas"]
+            return
+        hist = self._load_history.get(name, [])
+        if not hist:
+            return
+        recent = hist[-10:]
+        avg = sum(recent) / len(recent)
+        now = time.monotonic()
+        last = self._last_scale_action.get(name, 0.0)
+        target = dep["target"]
+        if avg > auto["target_ongoing_requests"] and now - last > auto["upscale_delay_s"]:
+            new_target = min(auto["max_replicas"], target + 1)
+            if new_target != target:
+                dep["target"] = new_target
+                self._last_scale_action[name] = now
+                logger.info("serve: autoscale %s up to %d (load %.2f)", name, new_target, avg)
+        elif avg < 0.5 * auto["target_ongoing_requests"] and now - last > auto["downscale_delay_s"]:
+            new_target = max(auto["min_replicas"], target - 1)
+            if new_target != target:
+                dep["target"] = new_target
+                self._last_scale_action[name] = now
+                logger.info("serve: autoscale %s down to %d (load %.2f)", name, new_target, avg)

@@ -1,0 +1,116 @@
+"""HTTP proxy actor (reference: serve/_private/proxy.py:1135 ProxyActor,
+HTTPProxy :759 — uvicorn/ASGI there; aiohttp here): routes requests by
+route_prefix to deployment handles."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ProxyActor:
+    def __init__(self, port: int = 8000, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._handles: Dict[str, Any] = {}
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment name
+        self._started = False
+
+    async def _start(self):
+        from aiohttp import web
+
+        import ray_tpu
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+        self._controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+
+        app = web.Application()
+        app.router.add_route("*", "/-/routes", self._routes_endpoint)
+        app.router.add_route("*", "/-/healthz", self._healthz)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info("serve proxy listening on %s:%d", self.host, self.port)
+        return True
+
+    async def ready(self) -> bool:
+        if not self._started:
+            await self._start()
+            self._started = True
+        return True
+
+    async def _refresh_routes(self):
+        import ray_tpu
+
+        deployments = await self._await_ref(self._controller.list_deployments.remote())
+        self._routes = {
+            (dep["config"].get("route_prefix") or f"/{name}"): name
+            for name, dep in deployments.items()
+        }
+
+    async def _await_ref(self, ref):
+        import ray_tpu
+
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(None, ray_tpu.get, ref)
+
+    async def _routes_endpoint(self, request):
+        from aiohttp import web
+
+        await self._refresh_routes()
+        return web.json_response(self._routes)
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.Response(text="ok")
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        path = "/" + request.match_info["tail"]
+        name = None
+        for prefix, dep_name in sorted(self._routes.items(), key=lambda kv: -len(kv[0])):
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                name = dep_name
+                break
+        if name is None:
+            await self._refresh_routes()
+            for prefix, dep_name in sorted(self._routes.items(), key=lambda kv: -len(kv[0])):
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                    name = dep_name
+                    break
+        if name is None:
+            return web.Response(status=404, text=f"no deployment for path {path}")
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = DeploymentHandle(name, self._controller)
+            self._handles[name] = handle
+        # request body: JSON → kwargs-style single payload argument
+        if request.can_read_body:
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = (await request.read()).decode("utf-8", "replace")
+        else:
+            payload = dict(request.query)
+        try:
+            response = handle.remote(payload)
+            result = await self._await_ref(response.object_ref)
+            response._router.done(response._replica_id)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("proxy request failed")
+            return web.Response(status=500, text=str(e))
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        return web.Response(text=str(result))

@@ -1,0 +1,70 @@
+"""Replica actor (reference: serve/_private/replica.py): hosts one copy of
+the user's deployment class/function; async so many requests interleave up
+to max_ongoing_requests."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(
+        self,
+        replica_id: str,
+        deployment_name: str,
+        serialized_init: tuple,  # (cls_or_fn, args, kwargs)
+        user_config: Any = None,
+        max_ongoing: int = 100,
+    ):
+        self.replica_id = replica_id
+        self.deployment_name = deployment_name
+        target, args, kwargs = serialized_init
+        if inspect.isclass(target):
+            self.callable = target(*args, **kwargs)
+        else:
+            self.callable = target
+        self.max_ongoing = max_ongoing
+        self._ongoing = 0
+        self._total = 0
+        self._sem = asyncio.Semaphore(max_ongoing)
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: Any):
+        """(reference: user_config → replica reconfigure)"""
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        async with self._sem:
+            self._ongoing += 1
+            self._total += 1
+            try:
+                target = self.callable if method == "__call__" else getattr(self.callable, method)
+                if method == "__call__" and not callable(target):
+                    raise AttributeError(f"deployment {self.deployment_name} is not callable")
+                if method == "__call__" and hasattr(self.callable, "__call__") and not inspect.isfunction(self.callable):
+                    target = self.callable.__call__
+                result = target(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+                return result
+            finally:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        """Ongoing requests — the router's power-of-two-choices signal."""
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"replica_id": self.replica_id, "ongoing": self._ongoing, "total": self._total}
+
+    def ping(self) -> str:
+        return "pong"
+
+    def prepare_shutdown(self):
+        return True

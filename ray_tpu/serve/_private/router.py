@@ -1,0 +1,88 @@
+"""Replica router: power-of-two-choices on queue length (reference:
+serve/_private/replica_scheduler/pow_2_scheduler.py:52
+PowerOfTwoChoicesReplicaScheduler + serve/_private/router.py)."""
+
+from __future__ import annotations
+
+import random
+import time
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Router:
+    """Caches the replica set from the controller; picks replicas by
+    sampling two and routing to the shorter queue."""
+
+    REFRESH_S = 1.0
+
+    def __init__(self, controller, deployment_name: str):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self.controller = controller
+        self.deployment_name = deployment_name
+        self._replicas: List[dict] = []
+        self._queue_estimate: Dict[str, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+        self._reported = 0.0
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_S:
+            return
+        with self._lock:
+            replicas = self._ray.get(
+                self.controller.get_replicas.remote(self.deployment_name)
+            )
+            by_id = {r["replica_id"]: r for r in self._replicas}
+            new = []
+            for rinfo in replicas:
+                cur = by_id.get(rinfo["replica_id"])
+                if cur is not None:
+                    new.append(cur)
+                else:
+                    try:
+                        actor = self._ray.get_actor(rinfo["actor_name"], "serve")
+                        new.append({"replica_id": rinfo["replica_id"], "actor": actor})
+                    except Exception:
+                        pass
+            self._replicas = new
+            self._last_refresh = now
+        # report average load for autoscaling
+        if self._replicas:
+            avg = sum(self._queue_estimate.get(r["replica_id"], 0) for r in self._replicas) / len(self._replicas)
+            try:
+                self.controller.record_load.remote(self.deployment_name, avg)
+            except Exception:
+                pass
+
+    def pick(self) -> dict:
+        self._refresh()
+        deadline = time.monotonic() + 30
+        while not self._replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"no running replicas for deployment {self.deployment_name}")
+            time.sleep(0.1)
+            self._refresh(force=True)
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = self._rng.sample(self._replicas, 2)
+        qa = self._queue_estimate.get(a["replica_id"], 0)
+        qb = self._queue_estimate.get(b["replica_id"], 0)
+        return a if qa <= qb else b
+
+    def route(self, method: str, args: tuple, kwargs: dict):
+        """Dispatch to the chosen replica; returns (ObjectRef, replica_id).
+        Callers MUST call `done(replica_id)` when the response resolves so
+        the in-flight estimate stays honest."""
+        r = self.pick()
+        rid = r["replica_id"]
+        self._queue_estimate[rid] = self._queue_estimate.get(rid, 0) + 1
+        ref = r["actor"].handle_request.remote(method, args, kwargs)
+        return ref, rid
+
+    def done(self, replica_id: str):
+        self._queue_estimate[replica_id] = max(0, self._queue_estimate.get(replica_id, 1) - 1)

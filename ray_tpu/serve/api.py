@@ -1,0 +1,195 @@
+"""Public Serve API (reference: serve/api.py — serve.run :492,
+@serve.deployment decorator, serve.start, serve.shutdown)."""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import logging
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.serve._private.common import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+logger = logging.getLogger(__name__)
+
+_started = False
+
+
+class Application:
+    """A deployment bound to init args (reference: Application =
+    Deployment.bind())."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target, config: DeploymentConfig):
+        self._target = target
+        self._config = config
+        self.name = config.name
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = dataclasses.replace(self._config)
+        for k, v in kwargs.items():
+            if k == "autoscaling_config" and isinstance(v, dict):
+                v = AutoscalingConfig(**v)
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown deployment option {k!r}")
+            setattr(cfg, k, v)
+        return Deployment(self._target, cfg)
+
+
+def deployment(
+    _target=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 100,
+    route_prefix: Optional[str] = None,
+    autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+    ray_actor_options: Optional[dict] = None,
+    version: str = "1",
+    user_config: Any = None,
+):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+
+    def wrap(target):
+        if isinstance(autoscaling_config, dict):
+            auto = AutoscalingConfig(**autoscaling_config)
+        else:
+            auto = autoscaling_config
+        cfg = DeploymentConfig(
+            name=name or target.__name__,
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            route_prefix=route_prefix,
+            autoscaling_config=auto,
+            ray_actor_options=ray_actor_options or {},
+            version=version,
+            user_config=user_config,
+        )
+        return Deployment(target, cfg)
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def start(http_port: Optional[int] = None) -> Any:
+    """Start (or connect to) the Serve controller; optionally the HTTP
+    proxy (reference: serve.start + proxy bring-up)."""
+    global _started
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+    except Exception:
+        controller = ray_tpu.remote(
+            name=CONTROLLER_NAME,
+            namespace="serve",
+            num_cpus=0.1,
+            max_concurrency=1000,
+            lifetime="detached",
+        )(ServeController).remote()
+    _started = True
+    if http_port is not None:
+        _ensure_proxy(controller, http_port)
+    return controller
+
+
+def _ensure_proxy(controller, port: int):
+    import ray_tpu
+
+    from ray_tpu.serve._private.proxy import ProxyActor
+
+    name = "SERVE_PROXY"
+    try:
+        ray_tpu.get_actor(name, "serve")
+    except Exception:
+        proxy = ray_tpu.remote(
+            name=name, namespace="serve", num_cpus=0.1, max_concurrency=1000
+        )(ProxyActor).remote(port)
+        ray_tpu.get(proxy.ready.remote())
+
+
+def run(
+    app: Union[Application, Deployment],
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    http_port: Optional[int] = None,
+    _blocking: bool = False,
+) -> DeploymentHandle:
+    """Deploy an application and return a handle (reference:
+    serve/api.py:492)."""
+    import ray_tpu
+    import time
+
+    controller = start(http_port=http_port)
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    cfg = dep._config
+    if route_prefix is not None:
+        cfg.route_prefix = route_prefix
+    if cfg.route_prefix is None:
+        cfg.route_prefix = f"/{cfg.name}"
+    cfg_dict = dataclasses.asdict(cfg)
+    init = (dep._target, app.init_args, app.init_kwargs)
+    ray_tpu.get(controller.deploy.remote(cfg_dict, init))
+    handle = DeploymentHandle(cfg.name, controller)
+    # wait for at least one running replica
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.get(controller.get_replicas.remote(cfg.name)):
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError(f"deployment {cfg.name} failed to start replicas")
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str):
+    import ray_tpu
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown():
+    global _started
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor("SERVE_PROXY", "serve")
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    _started = False

@@ -1,0 +1,81 @@
+"""DeploymentHandle (reference: serve/handle.py): composable handle for
+calling deployments from Python or other deployments."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the replica call (reference:
+    serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref, router, replica_id: str):
+        self._ref = ref
+        self._router = router
+        self._replica_id = replica_id
+        self._resolved = False
+        self._value = None
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        import ray_tpu
+
+        if not self._resolved:
+            try:
+                self._value = ray_tpu.get(self._ref, timeout=timeout)
+            finally:
+                self._router.done(self._replica_id)
+                self._resolved = True
+        return self._value
+
+    @property
+    def object_ref(self):
+        return self._ref
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller=None):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._router = None
+
+    def _ensure_router(self):
+        if self._router is None:
+            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+            from ray_tpu.serve._private.router import Router
+
+            import ray_tpu
+
+            controller = self._controller or ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+            self._controller = controller
+            self._router = Router(controller, self.deployment_name)
+        return self._router
+
+    def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        router = self._ensure_router()
+        ref, rid = router.route(method, args, kwargs)
+        return DeploymentResponse(ref, router, rid)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def options(self, **kwargs) -> "DeploymentHandle":
+        return self
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        # handles cross process boundaries by name; the router re-resolves
+        return (DeploymentHandle, (self.deployment_name,))
