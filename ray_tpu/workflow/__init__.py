@@ -1,0 +1,185 @@
+"""ray_tpu.workflow — durable DAG execution (reference: python/ray/workflow
+— workflow_executor.py:32 WorkflowExecutor, storage-backed step
+checkpoints, resume via workflow_state_from_storage.py).
+
+Each DAG node's output is checkpointed to storage as it completes; a
+crashed/cancelled workflow resumes from the last completed step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+
+__all__ = ["init", "run", "run_async", "resume", "get_output", "get_status", "list_all", "delete"]
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage: Optional[str] = None):
+    global _storage_dir
+    _storage_dir = storage or os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE", os.path.expanduser("~/ray_tpu_workflows")
+    )
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _storage() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir
+
+
+def _wf_dir(workflow_id: str) -> str:
+    d = os.path.join(_storage(), workflow_id)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _step_key(node: DAGNode, topo_index: int) -> str:
+    """Stable step identity across runs: structure position + node type +
+    target name (uuids differ between processes, so use the topo index)."""
+    name = ""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "_name", "")
+    return f"step_{topo_index:04d}_{hashlib.md5(name.encode()).hexdigest()[:8]}"
+
+
+class _WorkflowRun:
+    def __init__(self, workflow_id: str, dag: DAGNode, input_val: Any):
+        self.workflow_id = workflow_id
+        self.dag = dag
+        self.input_val = input_val
+        self.dir = _wf_dir(workflow_id)
+
+    def _meta_path(self):
+        return os.path.join(self.dir, "workflow_meta.json")
+
+    def _write_meta(self, status: str):
+        with open(self._meta_path(), "w") as f:
+            json.dump({"status": status, "updated_at": time.time(), "workflow_id": self.workflow_id}, f)
+
+    def execute(self) -> Any:
+        import ray_tpu
+
+        self._write_meta("RUNNING")
+        # pickle the dag + input so resume() can rebuild them
+        dag_blob_path = os.path.join(self.dir, "dag.pkl")
+        if not os.path.exists(dag_blob_path):
+            from ray_tpu._private import serialization
+
+            with open(dag_blob_path, "wb") as f:
+                f.write(serialization.dumps_function((self.dag, self.input_val)))
+        order = self.dag._topo()
+        cache: Dict[str, Any] = {}
+        ctx: dict = {"actors": {}}
+        try:
+            for i, node in enumerate(order):
+                key = _step_key(node, i)
+                ckpt = os.path.join(self.dir, key + ".pkl")
+                if os.path.exists(ckpt):
+                    with open(ckpt, "rb") as f:
+                        cache[node._stable_uuid] = pickle.load(f)
+                    continue
+                out = node._execute_one(cache, self.input_val, ctx)
+                # resolve task outputs so the checkpoint stores values
+                if isinstance(out, ray_tpu.ObjectRef):
+                    out = ray_tpu.get(out)
+                elif isinstance(out, list) and out and isinstance(out[0], ray_tpu.ObjectRef):
+                    out = ray_tpu.get(out)
+                cache[node._stable_uuid] = out
+                if isinstance(node, (FunctionNode, MultiOutputNode)):
+                    with open(ckpt + ".tmp", "wb") as f:
+                        pickle.dump(out, f, protocol=5)
+                    os.replace(ckpt + ".tmp", ckpt)
+            result = cache[self.dag._stable_uuid]
+            with open(os.path.join(self.dir, "output.pkl"), "wb") as f:
+                pickle.dump(result, f, protocol=5)
+            self._write_meta("SUCCESSFUL")
+            return result
+        except BaseException:
+            self._write_meta("FAILED")
+            raise
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None, input_val: Any = None) -> Any:
+    """Execute a DAG durably; returns the final output (reference:
+    workflow.run)."""
+    workflow_id = workflow_id or f"wf_{int(time.time())}_{os.getpid()}"
+    return _WorkflowRun(workflow_id, dag, input_val).execute()
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None, input_val: Any = None):
+    """Run in a background task; returns an ObjectRef of the output."""
+    import ray_tpu
+
+    workflow_id = workflow_id or f"wf_{int(time.time())}_{os.getpid()}"
+
+    dag_input = (dag, input_val)
+
+    @ray_tpu.remote
+    def _driver(blob_id):
+        from ray_tpu import workflow as wf
+
+        d, iv = blob_id
+        return wf.run(d, workflow_id=workflow_id, input_val=iv)
+
+    return _driver.remote(dag_input)
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a stored workflow; completed steps are skipped via their
+    checkpoints (reference: workflow resume /
+    workflow_state_from_storage.py)."""
+    d = _wf_dir(workflow_id)
+    out_path = os.path.join(d, "output.pkl")
+    if os.path.exists(out_path):
+        with open(out_path, "rb") as f:
+            return pickle.load(f)
+    dag_blob = os.path.join(d, "dag.pkl")
+    if not os.path.exists(dag_blob):
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    from ray_tpu._private import serialization
+
+    with open(dag_blob, "rb") as f:
+        dag, input_val = serialization.loads_function(f.read())
+    return _WorkflowRun(workflow_id, dag, input_val).execute()
+
+
+def get_output(workflow_id: str) -> Any:
+    out_path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    if not os.path.exists(out_path):
+        raise ValueError(f"workflow {workflow_id!r} has no output (status: {get_status(workflow_id)})")
+    with open(out_path, "rb") as f:
+        return pickle.load(f)
+
+
+def get_status(workflow_id: str) -> str:
+    meta = os.path.join(_wf_dir(workflow_id), "workflow_meta.json")
+    if not os.path.exists(meta):
+        return "NOT_FOUND"
+    with open(meta) as f:
+        return json.load(f)["status"]
+
+
+def list_all() -> List[tuple]:
+    out = []
+    base = _storage()
+    for wid in sorted(os.listdir(base)):
+        meta = os.path.join(base, wid, "workflow_meta.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                out.append((wid, json.load(f)["status"]))
+    return out
+
+
+def delete(workflow_id: str):
+    import shutil
+
+    shutil.rmtree(os.path.join(_storage(), workflow_id), ignore_errors=True)
