@@ -602,10 +602,19 @@ def bulk_sort(bundles: List[RefBundle], key: str, descending: bool) -> List[RefB
         return [RefBundle(block_ref, ray_tpu.get(meta_ref))]
     # 1) Sample each block to estimate range boundaries.
     samples = ray_tpu.get([_submit(_sample_task, r, key, 20, name="sample") for r in refs])
-    allv = np.sort(np.concatenate([s for s in samples if len(s)]))
+    non_empty = [s for s in samples if len(s)]
+    if not non_empty:
+        # every block is empty — collapse to one (empty) sorted block
+        block_ref, meta_ref = (
+            ray_tpu.remote(_merge_task)
+            .options(num_returns=2, name="sort")
+            .remote(*refs, sort_key=key, descending=descending)
+        )
+        return [RefBundle(block_ref, ray_tpu.get(meta_ref))]
+    allv = np.sort(np.concatenate(non_empty))
     if descending:
         allv = allv[::-1]
-    qs = [allv[int(len(allv) * (i + 1) / n)] for i in range(n - 1)] if len(allv) else []
+    qs = [allv[int(len(allv) * (i + 1) / n)] for i in range(n - 1)]
     # 2) Range-partition every block.
     split_refs = [
         _submit(_range_partition_task, r, key, qs, descending, num_returns=n, name="partition")

@@ -82,7 +82,11 @@ def build_block(rows_or_columns: Any) -> Block:
         if not x:
             return pa.table({})
         if isinstance(x[0], dict):
-            cols: Dict[str, list] = {k: [] for k in x[0]}
+            keys: Dict[str, None] = {}  # union of keys, first-seen order
+            for row in x:
+                for k in row:
+                    keys.setdefault(k)
+            cols: Dict[str, list] = {k: [] for k in keys}
             for row in x:
                 for k in cols:
                     cols[k].append(row.get(k))

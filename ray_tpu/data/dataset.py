@@ -148,11 +148,23 @@ class Dataset:
         return GroupedData(self, key)
 
     def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
-        rng_seed = seed if seed is not None else 0
-
         def sample_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
             n = next(iter(batch.values())).shape[0] if batch else 0
-            rng = np.random.default_rng(rng_seed + n)
+            if seed is None:
+                rng = np.random.default_rng()  # fresh OS entropy per block
+            else:
+                # reproducible but decorrelated across blocks: fold a cheap
+                # content digest into the seed (equal-sized blocks must NOT
+                # share a keep-mask)
+                import hashlib
+
+                h = hashlib.blake2b(digest_size=8)
+                h.update(str(n).encode())
+                for k in sorted(batch):
+                    v = batch[k]
+                    h.update(k.encode())
+                    h.update(np.ascontiguousarray(v[: min(4, n)]).tobytes())
+                rng = np.random.default_rng((seed, int.from_bytes(h.digest(), "little")))
             keep = rng.random(n) < fraction
             return {k: v[keep] for k, v in batch.items()}
 
@@ -346,8 +358,15 @@ class Dataset:
         A SplitCoordinator actor runs the streaming execution and deals
         blocks round-robin to per-split queues; each DataIterator pulls
         from its split over actor calls. Iterating a split a second time
-        starts a new epoch (re-executes the plan)."""
-        coordinator = _SplitCoordinator.remote(self, n)
+        starts a new epoch (re-executes the plan).
+
+        equal=True slices every block into n exact-size pieces (remainder
+        rows dropped) so all splits yield identical row counts — required
+        when each consumer drives one rank of a collective train step and
+        a short split would deadlock the others.  locality_hints are
+        accepted for API parity but are a no-op: splits are dealt from one
+        coordinator queue, not per-node."""
+        coordinator = _SplitCoordinator.remote(self, n, equal)
 
         def make_factory(idx: int):
             def factory():
@@ -474,14 +493,24 @@ class GroupedData:
         return self._ds.sort(key).map_batches(apply_groups, batch_size=None)
 
 
+def _equal_split_task(block, n: int):
+    """Slice one block into n pieces of exactly num_rows//n rows each
+    (remainder dropped) — the streaming_split(equal=True) dealing unit."""
+    acc = BlockAccessor.for_block(block)
+    per = acc.num_rows() // n
+    pieces = tuple(acc.slice(j * per, (j + 1) * per) for j in range(n))
+    return pieces if n > 1 else pieces[0]
+
+
 @ray_tpu.remote
 class _SplitCoordinator:
     """Runs dataset execution and deals blocks to n split queues
     (reference: _internal/iterator/stream_split_iterator.py SplitCoordinator)."""
 
-    def __init__(self, ds: Dataset, n: int):
+    def __init__(self, ds: Dataset, n: int, equal: bool = False):
         self._ds = ds
         self._n = n
+        self._equal = equal
         self._epoch = -1
         self._queues: List[List[Any]] = [[] for _ in range(n)]
         self._iter = None
@@ -520,8 +549,21 @@ class _SplitCoordinator:
         while not self._queues[idx] and not self._exhausted:
             try:
                 bundle = next(self._iter)
-                self._queues[self._rr % self._n].append(bundle.block_ref)
-                self._rr += 1
+                if self._equal:
+                    # slice into n equal pieces; every split advances by
+                    # the same row count for every source block
+                    pieces = (
+                        ray_tpu.remote(_equal_split_task)
+                        .options(num_returns=self._n, name="equal_split")
+                        .remote(bundle.block_ref, self._n)
+                    )
+                    if not isinstance(pieces, list):
+                        pieces = [pieces]
+                    for j, piece in enumerate(pieces):
+                        self._queues[j].append(piece)
+                else:
+                    self._queues[self._rr % self._n].append(bundle.block_ref)
+                    self._rr += 1
             except StopIteration:
                 self._exhausted = True
                 self._iter = None

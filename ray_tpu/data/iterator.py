@@ -48,29 +48,74 @@ def _batcher(
 
 
 def _prefetch(it: Iterator, depth: int) -> Iterator:
-    """Run `it` on a background thread with a bounded queue."""
+    """Run `it` on a background thread with a bounded queue.
+
+    If the consumer stops early (break / GeneratorExit), the producer is
+    signalled to stop and the upstream iterator is closed so its
+    finalizers run (e.g. the streaming executor killing its actor pools)
+    — otherwise the thread would block forever on the full queue and the
+    upstream resources would leak for the life of the driver."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     DONE = object()
+    stop = threading.Event()
     err: List[BaseException] = []
 
     def worker():
         try:
             for x in it:
-                q.put(x)
+                while not stop.is_set():
+                    try:
+                        q.put(x, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    break
         except BaseException as e:  # noqa: BLE001 — propagate to consumer
             err.append(e)
         finally:
-            q.put(DONE)
+            if stop.is_set():
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except BaseException:  # noqa: BLE001
+                        pass
+            while True:
+                if stop.is_set():
+                    # consumer is gone: evicting queued items is fine
+                    try:
+                        q.put_nowait(DONE)
+                        break
+                    except queue.Full:
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            pass
+                else:
+                    try:
+                        q.put(DONE, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        x = q.get()
-        if x is DONE:
-            if err:
-                raise err[0]
-            return
-        yield x
+    try:
+        while True:
+            x = q.get()
+            if x is DONE:
+                if err:
+                    raise err[0]
+                return
+            yield x
+    finally:
+        stop.set()
+        try:  # wake a producer blocked on a full queue
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
 
 
 class DataIterator:

@@ -2,6 +2,8 @@
 test_basic.py / test_map.py / test_sort.py / test_consumption.py,
 shrunk to the 1-core CI box)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -213,6 +215,48 @@ def test_split_and_streaming_split(ray_cluster):
         for b in it.iter_batches(batch_size=None, prefetch_batches=0):
             seen.extend(b["id"].tolist())
     assert sorted(seen) == list(range(40))
+
+
+def test_streaming_split_equal(ray_cluster):
+    """equal=True must give every split the same row count even with
+    uneven blocks (regression: flag was silently ignored)."""
+    import ray_tpu.data as rd
+
+    # 3 blocks of uneven sizes: 10+10+10 → equal slices of each block
+    ds = rd.range(30, parallelism=3)
+    its = ds.streaming_split(2, equal=True)
+    counts = []
+    for it in its:
+        total = 0
+        for b in it.iter_batches(batch_size=None, prefetch_batches=0):
+            total += len(b["id"])
+        counts.append(total)
+    assert counts[0] == counts[1] > 0
+
+
+def test_sort_empty_blocks(ray_cluster):
+    """Sorting a fully filtered dataset must not crash (regression:
+    np.concatenate([]) in bulk_sort)."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(20, parallelism=2).filter(lambda r: False).sort("id")
+    assert ds.count() == 0
+
+
+def test_iter_batches_early_break_no_leak(ray_cluster):
+    """Abandoning iter_batches mid-stream must not leak the producer
+    (regression: _prefetch thread blocked on a full queue forever)."""
+    import threading
+
+    import ray_tpu.data as rd
+
+    before = threading.active_count()
+    for _ in range(3):
+        for b in rd.range(1000, parallelism=4).iter_batches(batch_size=10, prefetch_batches=2):
+            break
+    time.sleep(1.0)
+    after = threading.active_count()
+    assert after - before <= 1, f"leaked {after - before} prefetch threads"
 
 
 def test_streaming_split_multi_epoch(ray_cluster):
