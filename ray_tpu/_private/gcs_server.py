@@ -78,6 +78,7 @@ class GcsServer:
         # stats/metric_exporter.h metric aggregation) ---
         self.task_events: "deque" = deque(maxlen=int(CONFIG.task_events_buffer_size))
         self.metrics: Dict[bytes, list] = {}  # worker_id -> latest snapshot
+        self.pending_shapes: Dict[NodeID, list] = {}  # autoscaler demand
 
         self.server.on_disconnect = self._on_disconnect
         self._bg_tasks: List[asyncio.Task] = []
@@ -174,6 +175,7 @@ class GcsServer:
         node_id = NodeID(payload["node_id"])
         self.last_heartbeat[node_id] = time.monotonic()
         if node_id in self.nodes and self.nodes[node_id].state == "ALIVE":
+            self.pending_shapes[node_id] = payload.get("pending_shapes", [])
             self.available[node_id] = ResourceSet.of(payload["available"])
             if payload.get("total"):
                 self.nodes[node_id].resources_total = ResourceSet.of(payload["total"])
@@ -216,6 +218,7 @@ class GcsServer:
         info.state = "DEAD"
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self.available.pop(node_id, None)
+        self.pending_shapes.pop(node_id, None)
         client = self.node_clients.pop(node_id, None)
         if client:
             client.close()
@@ -762,6 +765,31 @@ class GcsServer:
                 for k, v in avail.items():
                     total[k] = total.get(k, 0.0) + v
         return total
+
+    async def rpc_get_load_metrics(self, payload, conn):
+        """Aggregate demand/usage view for the autoscaler (reference:
+        gcs_autoscaler_state_manager.h:30 GetClusterResourceState)."""
+        demands = []
+        for shapes in self.pending_shapes.values():
+            demands.extend(shapes)
+        for actor_id in self.pending_actors:
+            info = self.actors.get(actor_id)
+            if info is not None and info.creation_spec is not None:
+                demands.append(dict(info.creation_spec.resources))
+        for pg in self.placement_groups.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                demands.extend(dict(b.resources) for b in pg.bundles)
+        nodes = {}
+        for node_id, info in self.nodes.items():
+            if info.state != "ALIVE":
+                continue
+            nodes[node_id.hex()] = {
+                "total": dict(info.resources_total),
+                "available": dict(self.available.get(node_id, ResourceSet())),
+                "is_head": info.is_head,
+                "raylet_address": info.raylet_address,
+            }
+        return {"pending_demands": demands, "nodes": nodes}
 
     # ------------------------------------------------------------------
     # observability (reference: gcs_task_manager.h:86, metric export

@@ -217,6 +217,13 @@ class Raylet:
                         "available": dict(self.resources_available),
                         "total": dict(self.resources_total),
                         "has_pending": bool(self.queue or self.infeasible),
+                        # resource shapes of queued/infeasible work — the
+                        # autoscaler's demand signal (reference:
+                        # resource_load_by_shape in ray_syncer reports)
+                        "pending_shapes": [
+                            dict(self._task_resources(s))
+                            for s in list(self.queue)[:64] + self.infeasible[:64]
+                        ],
                     },
                     timeout=10,
                 )

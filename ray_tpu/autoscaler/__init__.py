@@ -1,0 +1,28 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling (reference:
+python/ray/autoscaler).  StandardAutoscaler reads pending resource
+shapes from the GCS, bin-packs them onto node types, and drives a
+pluggable NodeProvider; FakeMultiNodeProvider simulates nodes as local
+raylet processes for tests.  TPU note: node types carry slice-topology
+resources (e.g. {"TPU": 4, "TPU-v5e-8-head": 1}) so a pending
+slice-aware placement group pulls up a whole slice's hosts."""
+
+from ray_tpu.autoscaler.autoscaler import Monitor, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (
+    TAG_NODE_KIND,
+    TAG_NODE_STATUS,
+    TAG_NODE_TYPE,
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+
+__all__ = [
+    "StandardAutoscaler",
+    "Monitor",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "get_nodes_to_launch",
+    "TAG_NODE_KIND",
+    "TAG_NODE_TYPE",
+    "TAG_NODE_STATUS",
+]

@@ -1,0 +1,159 @@
+"""StandardAutoscaler (reference: autoscaler/_private/autoscaler.py:172):
+periodic loop — read load from GCS, launch nodes for unmet demand,
+terminate idle nodes past the timeout."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (
+    TAG_NODE_KIND,
+    TAG_NODE_STATUS,
+    TAG_NODE_TYPE,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+
+logger = logging.getLogger(__name__)
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        provider: NodeProvider,
+        node_types: Dict[str, dict],
+        *,
+        max_workers: int = 8,
+        idle_timeout_s: float = 60.0,
+        upscaling_speed: float = 1.0,
+        gcs_client=None,
+    ):
+        self.provider = provider
+        self.node_types = node_types
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.upscaling_speed = upscaling_speed
+        self.gcs_client = gcs_client
+        self._idle_since: Dict[str, float] = {}
+        # launches whose nodes have not yet registered with the GCS:
+        # (node_type, launch time) — trimmed as nodes come up
+        self._booting: List[tuple] = []
+        self._warned_no_mapping = False
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # -- one reconcile pass ---------------------------------------------
+    def update(self, load_metrics: Optional[dict] = None):
+        if load_metrics is None:
+            load_metrics = self.gcs_client.call("get_load_metrics")
+        demands: List[Dict[str, float]] = load_metrics.get("pending_demands", [])
+        nodes_view: Dict[str, dict] = load_metrics.get("nodes", {})
+
+        workers = self.provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+        live_workers = sum(1 for n in nodes_view.values() if not n.get("is_head"))
+        # launches still booting = provider nodes the GCS hasn't seen yet;
+        # keep only that many of the most recent launch records so an
+        # async create_node isn't double-counted as new demand next tick
+        booting_count = max(0, len(workers) - live_workers)
+        self._booting = self._booting[-booting_count:] if booting_count else []
+        pending_launches: Dict[str, int] = {}
+        for node_type, _t in self._booting:
+            pending_launches[node_type] = pending_launches.get(node_type, 0) + 1
+
+        # free capacity on live worker+head nodes
+        existing_free = [dict(n["available"]) for n in nodes_view.values()]
+
+        to_launch = get_nodes_to_launch(
+            demands,
+            existing_free,
+            self.node_types,
+            pending_launches,
+            self.max_workers,
+            len(workers),
+        )
+        budget = self.max_workers - len(workers)
+        for node_type, count in to_launch.items():
+            # upscaling_speed >1 launches ahead of demand but never past
+            # max_workers
+            count = min(max(1, int(count * self.upscaling_speed)), max(0, budget))
+            if count <= 0:
+                continue
+            budget -= count
+            logger.info("autoscaler: launching %d x %s", count, node_type)
+            self.provider.create_node(
+                self.node_types[node_type].get("node_config", {"resources": self.node_types[node_type].get("resources", {})}),
+                {TAG_NODE_KIND: "worker", TAG_NODE_TYPE: node_type},
+                count,
+            )
+            now = time.monotonic()
+            self._booting.extend((node_type, now) for _ in range(count))
+            self.num_launches += count
+
+        # idle termination: a worker node with full availability == idle
+        now = time.monotonic()
+        for node_id in workers:
+            addr = self.provider.raylet_address(node_id)
+            if addr is None:
+                if not self._warned_no_mapping:
+                    logger.warning(
+                        "provider %s does not implement raylet_address(); "
+                        "idle nodes will never be scaled down",
+                        type(self.provider).__name__,
+                    )
+                    self._warned_no_mapping = True
+                continue
+            rec = self._node_view_for(nodes_view, addr)
+            idle = rec is not None and _dicts_equal(rec["available"], rec["total"])
+            if idle and not demands:
+                first = self._idle_since.setdefault(node_id, now)
+                if now - first > self.idle_timeout_s:
+                    logger.info("autoscaler: terminating idle node %s", node_id)
+                    self.provider.terminate_node(node_id)
+                    self.num_terminations += 1
+                    self._idle_since.pop(node_id, None)
+            else:
+                self._idle_since.pop(node_id, None)
+
+    @staticmethod
+    def _node_view_for(nodes_view: dict, raylet_address: Optional[str]):
+        if raylet_address is None:
+            return None
+        for rec in nodes_view.values():
+            if rec.get("raylet_address") == raylet_address:
+                return rec
+        return None
+
+
+def _dicts_equal(a: Dict[str, float], b: Dict[str, float]) -> bool:
+    keys = set(a) | set(b)
+    return all(abs(a.get(k, 0.0) - b.get(k, 0.0)) < 1e-9 for k in keys)
+
+
+class Monitor:
+    """Autoscaler loop runner (reference: autoscaler/_private/monitor.py:127)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler, interval_s: float = 5.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.autoscaler.update()
+                except Exception:
+                    logger.exception("autoscaler update failed")
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="autoscaler")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -593,18 +593,13 @@ def bulk_sort(bundles: List[RefBundle], key: str, descending: bool) -> List[RefB
     if not refs:
         return []
     n = len(refs)
-    if n == 1:
-        block_ref, meta_ref = (
-            ray_tpu.remote(_merge_task)
-            .options(num_returns=2, name="sort")
-            .remote(refs[0], sort_key=key, descending=descending)
-        )
-        return [RefBundle(block_ref, ray_tpu.get(meta_ref))]
-    # 1) Sample each block to estimate range boundaries.
-    samples = ray_tpu.get([_submit(_sample_task, r, key, 20, name="sample") for r in refs])
-    non_empty = [s for s in samples if len(s)]
-    if not non_empty:
-        # every block is empty — collapse to one (empty) sorted block
+    non_empty = []
+    if n > 1:
+        # 1) Sample each block to estimate range boundaries.
+        samples = ray_tpu.get([_submit(_sample_task, r, key, 20, name="sample") for r in refs])
+        non_empty = [s for s in samples if len(s)]
+    if n == 1 or not non_empty:
+        # single block, or every block empty: one merge-sort task
         block_ref, meta_ref = (
             ray_tpu.remote(_merge_task)
             .options(num_returns=2, name="sort")

@@ -163,7 +163,13 @@ class Dataset:
                 for k in sorted(batch):
                     v = batch[k]
                     h.update(k.encode())
-                    h.update(np.ascontiguousarray(v[: min(4, n)]).tobytes())
+                    head = v[: min(4, n)]
+                    if head.dtype == object:
+                        # object arrays (arrow strings) would hash pointer
+                        # values; hash the repr of the values instead
+                        h.update(repr(head.tolist()).encode())
+                    else:
+                        h.update(np.ascontiguousarray(head).tobytes())
                 rng = np.random.default_rng((seed, int.from_bytes(h.digest(), "little")))
             keep = rng.random(n) < fraction
             return {k: v[keep] for k, v in batch.items()}

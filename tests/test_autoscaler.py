@@ -1,0 +1,114 @@
+"""Autoscaler: demand scheduler unit tests + fake-multinode integration
+(reference test model: python/ray/tests/test_resource_demand_scheduler.py,
+test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeMultiNodeProvider,
+    Monitor,
+    StandardAutoscaler,
+    get_nodes_to_launch,
+)
+
+
+def test_demand_scheduler_bin_packing():
+    node_types = {
+        "small": {"resources": {"CPU": 2}},
+        "big": {"resources": {"CPU": 8}},
+    }
+    # 5 x 1-CPU demands, 1 free CPU in cluster -> 4 CPUs needed -> 2 small
+    to_launch = get_nodes_to_launch(
+        [{"CPU": 1}] * 5,
+        [{"CPU": 1}],
+        node_types,
+        pending_launches={},
+        max_workers=10,
+        current_workers=0,
+    )
+    assert to_launch == {"small": 2}
+
+
+def test_demand_scheduler_prefers_smallest_fit():
+    node_types = {
+        "cpu": {"resources": {"CPU": 4}},
+        "tpu_host": {"resources": {"CPU": 4, "TPU": 4}},
+    }
+    to_launch = get_nodes_to_launch(
+        [{"TPU": 4}],
+        [],
+        node_types,
+        pending_launches={},
+        max_workers=10,
+        current_workers=0,
+    )
+    assert to_launch == {"tpu_host": 1}
+
+
+def test_demand_scheduler_respects_max_workers():
+    node_types = {"small": {"resources": {"CPU": 1}}}
+    to_launch = get_nodes_to_launch(
+        [{"CPU": 1}] * 10,
+        [],
+        node_types,
+        pending_launches={},
+        max_workers=3,
+        current_workers=1,
+    )
+    assert sum(to_launch.values()) == 2
+
+
+def test_demand_scheduler_counts_pending_launches():
+    node_types = {"small": {"resources": {"CPU": 4}}}
+    to_launch = get_nodes_to_launch(
+        [{"CPU": 1}] * 3,
+        [],
+        node_types,
+        pending_launches={"small": 1},
+        max_workers=10,
+        current_workers=0,
+    )
+    assert to_launch == {}  # the in-flight node covers the demand
+
+
+def test_autoscaler_scales_up_for_pending_actors(ray_cluster):
+    """Pending actors that do not fit the head node must pull up a fake
+    worker node, after which they get scheduled."""
+    worker = ray_tpu._private.worker.get_global_worker()
+    session_dir = worker.session_info.get("session_dir")
+    gcs_address = worker.gcs_client.address
+
+    provider = FakeMultiNodeProvider(
+        {"gcs_address": gcs_address, "session_dir": session_dir}
+    )
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=2,
+        idle_timeout_s=9999,
+        gcs_client=worker.gcs_client,
+    )
+    monitor = Monitor(autoscaler, interval_s=1.0)
+    monitor.start()
+    try:
+        # the module cluster has 4 CPUs; demand 6 CPUs of actors
+        @ray_tpu.remote(num_cpus=2)
+        class Chunk:
+            def ping(self):
+                return "ok"
+
+        actors = [Chunk.remote() for _ in range(3)]
+        refs = [a.ping.remote() for a in actors]
+        out = ray_tpu.get(refs, timeout=120)
+        assert out == ["ok"] * 3
+        assert autoscaler.num_launches >= 1
+        assert len(ray_tpu.nodes()) >= 2
+        for a in actors:
+            ray_tpu.kill(a)
+    finally:
+        monitor.stop()
+        for nid in provider.non_terminated_nodes({}):
+            provider.terminate_node(nid)
