@@ -2,17 +2,25 @@
 
 Role of the reference's plasma store embedded in the raylet (reference:
 src/ray/object_manager/plasma/store.h:55, object_lifecycle_manager.h:101,
-eviction_policy.h:160).  Design differences, on purpose:
+eviction_policy.h:160).
 
-- Objects live as individual files under /dev/shm (tmpfs), mmap'd by
-  clients — the same zero-copy property as plasma's single arena without
-  a custom allocator; the C++ arena store (ray_tpu/_native) can replace
-  this file-per-object backend behind the same client API.
-- Small objects (< max_direct_call_object_size) are stored inline in the
-  store process and returned inside RPC replies (the reference keeps these
-  in the owner's in-process memory store).
-- Clients on the same node create+write the shm file themselves, then
-  `seal` it with the store — a put is one RPC regardless of size.
+Two backends behind one API:
+
+- **Native arena** (default when the C++ library builds —
+  ray_tpu/_native/shm_arena.cpp): one mmap'd shared-memory arena with an
+  in-shm object index, first-fit allocator and LRU eviction, like
+  plasma's dlmalloc arena.  Local `get` of a sealed object touches NO
+  rpc: the client resolves (offset,size) from the shared index under a
+  process-shared mutex and deserializes zero-copy from the mapping;
+  per-object shm refcounts keep eviction from reclaiming mapped objects.
+- **File-per-object fallback** (no C++ toolchain): objects as individual
+  tmpfs files, mmap'd by clients; gets go through the raylet rpc.
+
+Small objects (< max_direct_call_object_size) are stored inline in the
+store process and returned inside RPC replies (the reference keeps these
+in the owner's in-process memory store).  Clients write large objects
+themselves, then `seal` with the store — a put is one RPC regardless of
+size.
 
 The *server* half (`ObjectStoreCore`) runs inside the raylet's asyncio
 loop; the *client* half (`StoreClient`) runs in drivers and workers.
@@ -51,6 +59,21 @@ class ObjectEntry:
         self.is_error = False
 
 
+ARENA_FILENAME = "arena"
+
+
+def _try_native_arena(store_dir: str, capacity: int, create: bool):
+    try:
+        from ray_tpu._native.arena import NativeArena
+
+        path = os.path.join(store_dir, ARENA_FILENAME)
+        if create:
+            return NativeArena.create(path, capacity)
+        return NativeArena.attach(path) if os.path.exists(path) else None
+    except Exception:
+        return None
+
+
 class ObjectStoreCore:
     """Server half; lives in the raylet process' asyncio loop."""
 
@@ -66,6 +89,28 @@ class ObjectStoreCore:
         self.num_puts = 0
         self.num_gets = 0
         self.num_evictions = 0
+        # Native arena backend (plasma-equivalent); None → file fallback.
+        self.arena = _try_native_arena(store_dir, capacity_bytes, create=True)
+
+    def reserve(self, need: int) -> bool:
+        """Make room for a `need`-byte allocation in the arena, evicting
+        LRU unreferenced objects and retracting them from the directory
+        (client calls this when arena_alloc reports no space)."""
+        if self.arena is None:
+            self._ensure_capacity(need)
+            return True
+        evicted = self.arena.evict_lru(need)
+        if evicted is None:
+            return False
+        for padded in evicted:
+            oid = ObjectID(padded[: ObjectID.SIZE])
+            e = self.objects.pop(oid, None)
+            if e is not None:
+                self.used -= e.size
+            self.num_evictions += 1
+            if self.on_evict:
+                self.on_evict(oid)
+        return True
 
     # -- lifecycle ---------------------------------------------------------
     def object_path(self, object_id: ObjectID) -> str:
@@ -90,12 +135,16 @@ class ObjectStoreCore:
         return True
 
     def seal_file(self, object_id: ObjectID, size: int) -> bool:
-        """Client already wrote `store_dir/<hex>`; account + announce it."""
+        """Client already wrote the data (arena slot, or `store_dir/<hex>`
+        in fallback mode); account + announce it."""
         if self.contains(object_id):
             return False
-        self._ensure_capacity(size)
         e = self.objects.get(object_id) or ObjectEntry(object_id)
-        e.path = self.object_path(object_id)
+        if self.arena is not None and self.arena.contains(object_id.binary()):
+            e.path = None  # arena-backed
+        else:
+            self._ensure_capacity(size)
+            e.path = self.object_path(object_id)
         e.size = size
         e.state = SEALED
         self.objects[object_id] = e
@@ -110,6 +159,18 @@ class ObjectStoreCore:
             return False
         if len(data) <= CONFIG.max_direct_call_object_size:
             return self.put_inline(object_id, data)
+        if self.arena is not None:
+            code, view = self.arena.alloc_status(object_id.binary(), len(data))
+            if code == -1 and self.reserve(len(data)):
+                code, view = self.arena.alloc_status(object_id.binary(), len(data))
+            if code == 0:
+                view[:] = data
+                del view
+                self.arena.seal(object_id.binary())
+                return self.seal_file(object_id, len(data))
+            if code == -2:
+                return False
+            # fall through to file path on arena exhaustion
         self._ensure_capacity(len(data))
         path = self.object_path(object_id)
         with open(path, "wb") as f:
@@ -123,6 +184,15 @@ class ObjectStoreCore:
         e.last_access = time.monotonic()
         if e.state == INLINE:
             return e.inline_data
+        if e.path is None and self.arena is not None:
+            view = self.arena.lookup(object_id.binary())
+            if view is None:
+                return None
+            try:
+                return bytes(view)
+            finally:
+                del view
+                self.arena.decref(object_id.binary())
         with open(e.path, "rb") as f:
             return f.read()
 
@@ -134,6 +204,8 @@ class ObjectStoreCore:
         self.num_gets += 1
         if e.state == INLINE:
             return {"inline": e.inline_data, "size": e.size}
+        if e.path is None:
+            return {"arena": True, "size": e.size}
         return {"path": e.path, "size": e.size}
 
     def delete(self, object_id: ObjectID):
@@ -147,16 +219,26 @@ class ObjectStoreCore:
                 os.unlink(e.path)
             except OSError:
                 pass
+        elif self.arena is not None:
+            # refcounted readers block reclamation; LRU eviction retries
+            self.arena.delete(object_id.binary())
 
     def pin(self, object_id: ObjectID):
         e = self.objects.get(object_id)
         if e is not None:
             e.pin_count += 1
+            if e.state == SEALED and e.path is None and self.arena is not None:
+                # hold an arena ref so LRU eviction can't reclaim it
+                view = self.arena.lookup(object_id.binary())
+                if view is not None:
+                    del view
 
     def unpin(self, object_id: ObjectID):
         e = self.objects.get(object_id)
         if e is not None and e.pin_count > 0:
             e.pin_count -= 1
+            if e.state == SEALED and e.path is None and self.arena is not None:
+                self.arena.decref(object_id.binary())
 
     async def wait_sealed(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
         e = self.objects.get(object_id)
@@ -217,6 +299,17 @@ def _close_mmap_quietly(m):
         pass
 
 
+def _arena_release(arena, id_bytes: bytes, view):
+    try:
+        view.release()
+    except BufferError:
+        pass
+    try:
+        arena.decref(id_bytes)
+    except Exception:
+        pass
+
+
 class StoreClient:
     """Client half; talks to the local raylet's store RPCs and mmaps shm
     files directly for large objects (zero-copy on the same node)."""
@@ -228,6 +321,8 @@ class StoreClient:
         # weakref finalizer; they stay open for the process lifetime (the
         # mapping, not a copy — same pinning semantics as plasma clients).
         self._unclosable_mmaps: list = []
+        # Attach to the node's native arena if the raylet created one.
+        self.arena = _try_native_arena(store_dir, 0, create=False)
 
     def put_serialized(self, object_id: ObjectID, meta: bytes, buffers: List[memoryview]) -> int:
         from ray_tpu._private import serialization
@@ -238,6 +333,21 @@ class StoreClient:
             serialization.write_into(memoryview(blob), meta, buffers)
             self._raylet.call("store_put_inline", (object_id.binary(), bytes(blob)))
             return total
+        if self.arena is not None:
+            code, view = self.arena.alloc_status(object_id.binary(), total)
+            if code == -1:
+                # ask the raylet to evict, then retry once
+                if self._raylet.call("store_reserve", total):
+                    code, view = self.arena.alloc_status(object_id.binary(), total)
+            if code == 0:
+                serialization.write_into(view, meta, buffers)
+                del view
+                self.arena.seal(object_id.binary())
+                self._raylet.call("store_seal", (object_id.binary(), total))
+                return total
+            if code == -2:  # already stored by someone else
+                return total
+            # arena exhausted → file fallback below
         path = os.path.join(self.store_dir, object_id.hex())
         tmp = path + ".w"
         with open(tmp, "w+b") as f:
@@ -248,11 +358,34 @@ class StoreClient:
         self._raylet.call("store_seal", (object_id.binary(), total))
         return total
 
+    def _deserialize_arena(self, object_id: ObjectID):
+        """Zero-copy deserialize straight out of the shared arena; the
+        object's shm refcount is held until the value is collected."""
+        from ray_tpu._private import serialization
+
+        view = self.arena.lookup(object_id.binary())
+        if view is None:
+            return None
+        tag, value = serialization.deserialize(view)
+        import weakref
+
+        arena, id_bytes = self.arena, object_id.binary()
+        try:
+            weakref.finalize(value, _arena_release, arena, id_bytes, view)
+        except TypeError:
+            self._unclosable_mmaps.append(view)  # pins refcount for process life
+        return tag, value
+
     def get_serialized(self, object_id: ObjectID, timeout: Optional[float]):
         """Returns (tag, value) or raises GetTimeoutError/ObjectLostError."""
         from ray_tpu import exceptions
         from ray_tpu._private import serialization
 
+        # Fast path: sealed in the local arena → no RPC at all.
+        if self.arena is not None:
+            out = self._deserialize_arena(object_id)
+            if out is not None:
+                return out
         meta = self._raylet.call(
             "store_get", (object_id.binary(), timeout),
             timeout=(timeout + 5) if timeout is not None else None,
@@ -261,6 +394,12 @@ class StoreClient:
             raise exceptions.GetTimeoutError(f"timed out getting {object_id}")
         if "inline" in meta:
             return serialization.deserialize(memoryview(meta["inline"]))
+        if meta.get("arena"):
+            out = self._deserialize_arena(object_id)
+            if out is not None:
+                return out
+            # evicted between the reply and our lookup — treat as lost
+            raise exceptions.ObjectLostError(f"{object_id} evicted during get")
         f = open(meta["path"], "rb")
         try:
             m = mmap.mmap(f.fileno(), meta["size"], prot=mmap.PROT_READ)

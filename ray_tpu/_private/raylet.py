@@ -793,6 +793,11 @@ class Raylet:
             self.store.delete(ObjectID(oid))
         return True
 
+    async def rpc_store_reserve(self, payload, conn):
+        """Client-side arena alloc failed: evict LRU objects to make room
+        (reference: plasma create-request queue + eviction policy)."""
+        return self.store.reserve(int(payload))
+
     async def rpc_store_pin(self, payload, conn):
         for oid in payload:
             self.store.pin(ObjectID(oid))
