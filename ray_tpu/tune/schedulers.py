@@ -55,7 +55,14 @@ class AsyncHyperBandScheduler(TrialScheduler):
     """ASHA (reference: tune/schedulers/async_hyperband.py): rungs at
     grace_period × reduction_factor^k; a trial reaching a rung is stopped
     unless its score is in the top 1/reduction_factor of results recorded
-    at that rung so far."""
+    at that rung (including its own).
+
+    Unlike the reference's stopping-based ASHA, rung membership is
+    re-evaluated on *every* subsequent report: a trial that slipped past
+    a rung early (async first-arrival, ascending-quality arrival order)
+    is still cut once enough peers record at that rung and its frozen
+    rung score falls below the top-1/rf cutoff.  This recovers
+    synchronous successive-halving's savings without rung barriers."""
 
     def __init__(
         self,
@@ -72,12 +79,13 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self.grace_period = grace_period
         self.rf = reduction_factor
         # Each bracket b has rungs grace*rf^(k+b); one bracket by default.
-        self._brackets: List[Dict[float, List[float]]] = []
+        # rung time -> {trial_id: score frozen at rung arrival}
+        self._brackets: List[Dict[float, Dict[str, float]]] = []
         for b in range(brackets):
-            rungs: Dict[float, List[float]] = {}
+            rungs: Dict[float, Dict[str, float]] = {}
             t = grace_period * (self.rf ** b)
             while t < max_t:
-                rungs[t] = []
+                rungs[t] = {}
                 t *= self.rf
             self._brackets.append(rungs)
         self._trial_bracket: Dict[str, int] = {}
@@ -86,33 +94,36 @@ class AsyncHyperBandScheduler(TrialScheduler):
     def on_trial_add(self, trial):
         self._trial_bracket[trial.trial_id] = self._rng.randrange(len(self._brackets))
 
+    def _cutoff(self, recorded: Dict[str, float]) -> Optional[float]:
+        k = int(len(recorded) / self.rf)
+        if k < 1:
+            return None
+        return sorted(recorded.values(), reverse=True)[k - 1]
+
     def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
         t = result.get(self.time_attr)
         score = self._score(result)
         if t is None or score is None:
             return TrialScheduler.CONTINUE
+        rungs = self._brackets[self._trial_bracket.get(trial.trial_id, 0)]
+        # Record at the highest rung reached (score frozen at arrival);
+        # never backfill lower rungs with later scores.
+        for rung_t in sorted(rungs, reverse=True):
+            if t >= rung_t:
+                rungs[rung_t].setdefault(trial.trial_id, score)
+                break
         if t >= self.max_t:
             return TrialScheduler.STOP
-        rungs = self._brackets[self._trial_bracket.get(trial.trial_id, 0)]
-        decision = TrialScheduler.CONTINUE
-        for rung_t in sorted(rungs, reverse=True):
-            if t < rung_t:
+        # Re-evaluate every rung this trial has recorded at: cut if its
+        # frozen score is now below the top-1/rf cutoff among peers.
+        for rung_t, recorded in rungs.items():
+            s = recorded.get(trial.trial_id)
+            if s is None:
                 continue
-            recorded = rungs[rung_t]
-            cutoff = None
-            if recorded:
-                k = max(1, int(len(recorded) / self.rf))
-                cutoff = sorted(recorded, reverse=True)[k - 1]
-            if not getattr(trial, "_rungs_done", None):
-                trial._rungs_done = set()
-            if rung_t in trial._rungs_done:
-                continue
-            trial._rungs_done.add(rung_t)
-            recorded.append(score)
-            if cutoff is not None and score < cutoff:
-                decision = TrialScheduler.STOP
-            break
-        return decision
+            cutoff = self._cutoff(recorded)
+            if cutoff is not None and s < cutoff:
+                return TrialScheduler.STOP
+        return TrialScheduler.CONTINUE
 
 
 class HyperBandScheduler(AsyncHyperBandScheduler):
@@ -234,12 +245,14 @@ class PopulationBasedTraining(TrialScheduler):
         last = self._last_perturb.get(trial.trial_id, 0)
         if t - last < self.perturbation_interval:
             return TrialScheduler.CONTINUE
-        self._last_perturb[trial.trial_id] = t
 
         scores = sorted(self._latest.values())
         n = len(scores)
         if n < 4:
+            # Population not fully reporting yet (staggered actor starts):
+            # do NOT consume the perturbation slot — retry next report.
             return TrialScheduler.CONTINUE
+        self._last_perturb[trial.trial_id] = t
         k = max(1, int(n * self.quantile_fraction))
         lower_cut = scores[k - 1]
         upper_cut = scores[n - k]

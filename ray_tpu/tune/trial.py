@@ -35,7 +35,6 @@ class Trial:
         # runtime-only fields (not persisted)
         self.runner = None  # ActorHandle of _TrialRunner
         self._pbt_exploit = None
-        self._rungs_done = None
 
     @property
     def path(self) -> str:
@@ -72,7 +71,6 @@ class Trial:
         t.local_dir = data["local_dir"]
         t.runner = None
         t._pbt_exploit = None
-        t._rungs_done = None
         return t
 
     def __repr__(self):
