@@ -87,6 +87,8 @@ def load_library():
         lib.arena_num_objects.argtypes = [ctypes.c_void_p]
         lib.arena_num_evictions.restype = ctypes.c_uint64
         lib.arena_num_evictions.argtypes = [ctypes.c_void_p]
+        lib.arena_test_lock_and_abandon.restype = ctypes.c_int
+        lib.arena_test_lock_and_abandon.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -182,6 +184,11 @@ class NativeArena:
         if n < 0:
             return None
         return [out.raw[i * ID_SIZE:(i + 1) * ID_SIZE] for i in range(min(n, max_out))]
+
+    def _test_lock_and_abandon(self):
+        """Test-only: take the arena mutex and never release it, so the
+        process can exit "inside" the critical section (EOWNERDEAD)."""
+        self._lib.arena_test_lock_and_abandon(self._h)
 
     # -- stats -----------------------------------------------------------
     @property

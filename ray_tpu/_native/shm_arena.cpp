@@ -25,6 +25,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -48,6 +49,8 @@ struct Entry {
   uint32_t state;
   uint32_t refcount;
   uint64_t last_access;  // monotonic ns, for LRU eviction
+  uint32_t owner_pid;    // writer while kAllocated (EOWNERDEAD repair)
+  uint32_t _pad;
 };
 
 struct FreeBlock {
@@ -94,13 +97,73 @@ inline uint64_t hash_id(const uint8_t* id) {
   return h;
 }
 
+// Rebuild allocator metadata from the entry table after a client died
+// holding the mutex (EOWNERDEAD): a half-written Entry or half-moved
+// free list cannot be trusted.  Sealed entries are ground truth — their
+// (offset,size) are immutable after seal — so everything else is
+// recomputed from them.  kAllocated entries whose owner process is GONE
+// are dropped (their payload is garbage); kAllocated entries of LIVE
+// writers keep both their entry and their byte range — recycling a
+// range a live client is still memcpy-ing into would corrupt whoever
+// allocates it next.  The free list becomes the gaps between kept
+// blocks, and used/bump/num_objects are recounted.  Refcounts leaked by
+// the dead client are left in place (a live reader may hold them); they
+// only pin objects.
+void repair_after_owner_death(Arena* a) {
+  Header* h = a->hdr;
+  struct Blk {
+    uint64_t off, size;
+  };
+  Blk* blks = new Blk[h->table_cap];
+  uint32_t n = 0;
+  uint32_t live = 0;
+  uint64_t used = 0;
+  for (uint32_t i = 0; i < h->table_cap; i++) {
+    Entry* e = &a->table[i];
+    if (e->state == kAllocated) {
+      bool owner_alive =
+          e->owner_pid != 0 && (kill(pid_t(e->owner_pid), 0) == 0 || errno != ESRCH);
+      if (!owner_alive) {
+        e->state = kTombstone;
+        e->refcount = 0;
+        continue;
+      }
+    }
+    if (e->state == kAllocated || e->state == kSealed) {
+      blks[n++] = {e->offset, (e->size + 63) & ~63ull};
+      used += e->size;
+      live++;
+    }
+  }
+  qsort(blks, n, sizeof(Blk), [](const void* x, const void* y) {
+    uint64_t ox = ((const Blk*)x)->off, oy = ((const Blk*)y)->off;
+    return ox < oy ? -1 : (ox > oy ? 1 : 0);
+  });
+  h->free_count = 0;
+  uint64_t cursor = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    if (blks[i].off > cursor && h->free_count < h->free_cap) {
+      a->freelist[h->free_count].offset = cursor;
+      a->freelist[h->free_count].size = blks[i].off - cursor;
+      h->free_count++;
+    }
+    uint64_t end = blks[i].off + blks[i].size;
+    if (end > cursor) cursor = end;
+  }
+  h->bump = cursor;
+  h->used = used;
+  h->num_objects = live;
+  delete[] blks;
+}
+
 class Lock {
  public:
   explicit Lock(Arena* a) : a_(a) {
     int rc = pthread_mutex_lock(&a_->hdr->mutex);
     if (rc == EOWNERDEAD) {
-      // a client died holding the lock; state is index metadata only and
-      // each mutation below is single-writer — mark consistent and go on
+      // A client died holding the lock: repair the index/allocator from
+      // the sealed entries before trusting any of it.
+      repair_after_owner_death(a_);
       pthread_mutex_consistent(&a_->hdr->mutex);
     }
   }
@@ -321,6 +384,7 @@ int64_t arena_alloc(void* handle, const uint8_t* id, uint64_t size) {
   e->size = size;
   e->state = kAllocated;
   e->refcount = 0;
+  e->owner_pid = uint32_t(getpid());
   e->last_access = now_ns();
   a->hdr->used += size;
   a->hdr->num_objects++;
@@ -448,6 +512,19 @@ int arena_evict_lru(void* handle, uint64_t need, uint8_t* out_ids, int max_out) 
   delete[] cands;
   if (n_evicted == 0 && !can_fit_contiguous(a, need)) return -1;
   return n_evicted;
+}
+
+// Test-only: acquire the arena mutex and return WITHOUT unlocking, so a
+// test can exit the process "inside" the critical section and exercise
+// the EOWNERDEAD repair path in the next locker.
+int arena_test_lock_and_abandon(void* handle) {
+  Arena* a = (Arena*)handle;
+  int rc = pthread_mutex_lock(&a->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    repair_after_owner_death(a);
+    pthread_mutex_consistent(&a->hdr->mutex);
+  }
+  return 0;
 }
 
 uint64_t arena_used(void* handle) { return ((Arena*)handle)->hdr->used; }

@@ -317,10 +317,6 @@ class StoreClient:
     def __init__(self, raylet_client, store_dir: str):
         self._raylet = raylet_client  # rpc.RpcClient to the local raylet
         self.store_dir = store_dir
-        # Mappings that could not be tied to their value's lifetime with a
-        # weakref finalizer; they stay open for the process lifetime (the
-        # mapping, not a copy — same pinning semantics as plasma clients).
-        self._unclosable_mmaps: list = []
         # Attach to the node's native arena if the raylet created one.
         self.arena = _try_native_arena(store_dir, 0, create=False)
 
@@ -367,13 +363,26 @@ class StoreClient:
         if view is None:
             return None
         tag, value = serialization.deserialize(view)
+        arena, id_bytes = self.arena, object_id.binary()
+        if serialization.buffer_count(view) == 0:
+            # No out-of-band buffers → the value holds no aliases into the
+            # arena (the pickle payload was copied): release immediately.
+            _arena_release(arena, id_bytes, view)
+            return tag, value
         import weakref
 
-        arena, id_bytes = self.arena, object_id.binary()
         try:
             weakref.finalize(value, _arena_release, arena, id_bytes, view)
         except TypeError:
-            self._unclosable_mmaps.append(view)  # pins refcount for process life
+            # Non-weakref-able container with aliasing buffers (e.g. a dict
+            # of arrays): re-deserialize from a private copy so nothing
+            # aliases the arena, then release the shm refcount immediately —
+            # pinning it for the process lifetime would block eviction of
+            # the slot forever.
+            data = bytes(view)
+            del value
+            _arena_release(arena, id_bytes, view)
+            tag, value = serialization.deserialize(memoryview(data))
         return tag, value
 
     def get_serialized(self, object_id: ObjectID, timeout: Optional[float]):
@@ -406,15 +415,22 @@ class StoreClient:
         finally:
             f.close()
         tag, value = serialization.deserialize(memoryview(m))
+        if serialization.buffer_count(memoryview(m)) == 0:
+            _close_mmap_quietly(m)
+            return tag, value
         # The mmap must outlive any buffers aliasing it.  Close it when the
         # deserialized value is collected; values that can't carry a weakref
-        # (plain containers) pin the mapping for the process lifetime.
+        # (plain containers) are re-read from a private copy so the mapping
+        # can close now instead of leaking for the process lifetime.
         import weakref
 
         try:
             weakref.finalize(value, _close_mmap_quietly, m)
         except TypeError:
-            self._unclosable_mmaps.append(m)
+            data = bytes(m)
+            del value
+            _close_mmap_quietly(m)
+            tag, value = serialization.deserialize(memoryview(data))
         return tag, value
 
     def contains(self, object_id: ObjectID) -> bool:

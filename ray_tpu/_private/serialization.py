@@ -145,6 +145,14 @@ def deserialize(view: memoryview) -> Tuple[int, Any]:
     return tag, value
 
 
+def buffer_count(view: memoryview) -> int:
+    """Number of out-of-band buffers in a serialized blob (header peek).
+    Zero means a deserialized value holds no aliases into the blob."""
+    view = view.cast("B") if view.format != "B" else view
+    _, n_buffers = _HEADER.unpack_from(view, 0)
+    return n_buffers
+
+
 def dumps_function(fn) -> bytes:
     """Pickle a function/class definition for the GCS function table."""
     return cloudpickle.dumps(fn, protocol=5)

@@ -19,6 +19,13 @@ class ProxyActor:
         self._handles: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment name
         self._started = False
+        # Dedicated pool for routing: pick() can block up to 30s during a
+        # cold start — on the shared default executor a burst of such
+        # requests would starve _await_ref of threads and stall responses
+        # for healthy deployments too.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._route_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="serve-route")
 
     async def _start(self):
         from aiohttp import web
@@ -102,13 +109,24 @@ class ProxyActor:
                 payload = (await request.read()).decode("utf-8", "replace")
         else:
             payload = dict(request.query)
+        loop = asyncio.get_event_loop()
         try:
-            response = handle.remote(payload)
+            # Routing may block (cold start waits for a replica, refresh
+            # does a blocking get) — keep it off the proxy event loop so
+            # /-/healthz and other deployments stay responsive.
+            response = await loop.run_in_executor(self._route_pool, handle.remote, payload)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("proxy routing failed")
+            return web.Response(status=500, text=str(e))
+        try:
             result = await self._await_ref(response.object_ref)
-            response._router.done(response._replica_id)
         except Exception as e:  # noqa: BLE001
             logger.exception("proxy request failed")
             return web.Response(status=500, text=str(e))
+        finally:
+            # Always decrement the in-flight estimate — a failed request
+            # must not permanently bias pow-2 routing and autoscaling.
+            response._router.done(response._replica_id)
         if isinstance(result, (dict, list)):
             return web.json_response(result)
         if isinstance(result, bytes):

@@ -80,9 +80,13 @@ class Router:
         the in-flight estimate stays honest."""
         r = self.pick()
         rid = r["replica_id"]
-        self._queue_estimate[rid] = self._queue_estimate.get(rid, 0) + 1
+        # route()/done() run concurrently from proxy executor threads:
+        # the read-modify-write must be atomic or increments get lost.
+        with self._lock:
+            self._queue_estimate[rid] = self._queue_estimate.get(rid, 0) + 1
         ref = r["actor"].handle_request.remote(method, args, kwargs)
         return ref, rid
 
     def done(self, replica_id: str):
-        self._queue_estimate[replica_id] = max(0, self._queue_estimate.get(replica_id, 1) - 1)
+        with self._lock:
+            self._queue_estimate[replica_id] = max(0, self._queue_estimate.get(replica_id, 1) - 1)

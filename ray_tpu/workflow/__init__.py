@@ -4,6 +4,14 @@ checkpoints, resume via workflow_state_from_storage.py).
 
 Each DAG node's output is checkpointed to storage as it completes; a
 crashed/cancelled workflow resumes from the last completed step.
+
+Checkpoints are keyed by a content hash of the DAG *structure* (each
+node's type, target name, and parent positions); resuming a workflow_id
+whose DAG no longer matches the stored structure raises instead of
+silently mapping old checkpoints onto different steps.  Actor
+(ClassMethodNode) steps are NOT checkpointed — actor state can't be
+captured by pickling a method's return value — so they re-execute on
+resume; keep actor steps idempotent.
 """
 
 from __future__ import annotations
@@ -42,13 +50,39 @@ def _wf_dir(workflow_id: str) -> str:
     return d
 
 
-def _step_key(node: DAGNode, topo_index: int) -> str:
-    """Stable step identity across runs: structure position + node type +
-    target name (uuids differ between processes, so use the topo index)."""
-    name = ""
+def _node_target_name(node: DAGNode) -> str:
     if isinstance(node, FunctionNode):
-        name = getattr(node._remote_fn, "_name", "")
-    return f"step_{topo_index:04d}_{hashlib.md5(name.encode()).hexdigest()[:8]}"
+        return getattr(node._remote_fn, "_name", "")
+    # InputAttributeNode: which input field it reads IS its identity.
+    key = getattr(node, "_key", None)
+    if key is not None:
+        return f"{type(node).__name__}[{key!r}]"
+    return getattr(node, "_method", "") or type(node).__name__
+
+
+def _dag_structure(order: List[DAGNode]) -> List[dict]:
+    """Per-node structural description: type, target, parent positions.
+    uuids differ between processes, so parents are topo indices."""
+    index = {n._stable_uuid: i for i, n in enumerate(order)}
+    return [
+        {
+            "type": type(n).__name__,
+            "target": _node_target_name(n),
+            "parents": [index[c._stable_uuid] for c in n._children()],
+        }
+        for n in order
+    ]
+
+
+def _structure_hash(structure: List[dict]) -> str:
+    return hashlib.sha1(json.dumps(structure, sort_keys=True).encode()).hexdigest()
+
+
+def _step_key(node: DAGNode, topo_index: int, structure: List[dict]) -> str:
+    """Stable step identity across runs: structure position + a hash of
+    the node's own structural entry (type + target + parent positions)."""
+    h = hashlib.sha1(json.dumps(structure[topo_index], sort_keys=True).encode())
+    return f"step_{topo_index:04d}_{h.hexdigest()[:8]}"
 
 
 class _WorkflowRun:
@@ -68,6 +102,25 @@ class _WorkflowRun:
     def execute(self) -> Any:
         import ray_tpu
 
+        # Validate structure BEFORE writing RUNNING status, so a refused
+        # resume doesn't leave the stored status stuck at RUNNING.
+        order = self.dag._topo()
+        structure = _dag_structure(order)
+        struct_path = os.path.join(self.dir, "dag_structure.json")
+        if os.path.exists(struct_path):
+            with open(struct_path) as f:
+                stored = json.load(f)
+            if _structure_hash(stored) != _structure_hash(structure):
+                raise ValueError(
+                    f"workflow {self.workflow_id!r} was stored with a different "
+                    "DAG structure; refusing to resume with mismatched "
+                    "checkpoints. Use a new workflow_id or delete() the old one."
+                )
+        else:
+            with open(struct_path + ".tmp", "w") as f:
+                json.dump(structure, f)
+            os.replace(struct_path + ".tmp", struct_path)
+
         self._write_meta("RUNNING")
         # pickle the dag + input so resume() can rebuild them
         dag_blob_path = os.path.join(self.dir, "dag.pkl")
@@ -76,12 +129,11 @@ class _WorkflowRun:
 
             with open(dag_blob_path, "wb") as f:
                 f.write(serialization.dumps_function((self.dag, self.input_val)))
-        order = self.dag._topo()
         cache: Dict[str, Any] = {}
         ctx: dict = {"actors": {}}
         try:
             for i, node in enumerate(order):
-                key = _step_key(node, i)
+                key = _step_key(node, i, structure)
                 ckpt = os.path.join(self.dir, key + ".pkl")
                 if os.path.exists(ckpt):
                     with open(ckpt, "rb") as f:

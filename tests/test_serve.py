@@ -79,7 +79,15 @@ def test_load_balancing_across_replicas(serve_cluster):
             return os.getpid()
 
     handle = serve.run(WhoAmI.bind(), name="whoami")
-    pids = {handle.remote({}).result(timeout=30) for _ in range(20)}
+    # Both replicas must serve traffic.  The router's replica cache may
+    # briefly know only one replica right after deploy (refresh is
+    # rate-limited), so keep sending until the second shows up.
+    import time
+
+    pids = set()
+    deadline = time.time() + 30
+    while len(pids) < 2 and time.time() < deadline:
+        pids.add(handle.remote({}).result(timeout=30))
     assert len(pids) == 2  # both replicas served traffic
 
 
