@@ -123,7 +123,11 @@ void repair_after_owner_death(Arena* a) {
     if (e->state == kAllocated) {
       bool owner_alive =
           e->owner_pid != 0 && (kill(pid_t(e->owner_pid), 0) == 0 || errno != ESRCH);
-      if (!owner_alive) {
+      // Age bound guards against PID reuse / EPERM false-positives: a
+      // live writer allocs and seals within seconds, so a kAllocated
+      // entry older than 5 minutes is a leak, not an in-flight write.
+      bool stale = now_ns() - e->last_access > 300ull * 1000000000ull;
+      if (!owner_alive || stale) {
         e->state = kTombstone;
         e->refcount = 0;
         continue;

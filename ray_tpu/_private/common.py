@@ -125,6 +125,9 @@ class TaskSpec:
     method_name: Optional[str] = None
     # Attempt counter (filled by raylet on retries)
     attempt_number: int = 0
+    # Owner-side lineage-reconstruction resubmissions of this task
+    # (reference: task_manager.h:212 lineage pinning + retry accounting).
+    reconstructions: int = 0
     detached: bool = False
 
     def return_ids(self) -> List[ObjectID]:

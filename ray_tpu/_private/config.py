@@ -41,6 +41,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "task_retry_delay_ms": 100,
     # Default max retries for normal tasks.
     "task_max_retries": 3,
+    # Lineage reconstruction: rebuild lost objects by resubmitting their
+    # creating task (reference: core_worker/object_recovery_manager.h).
+    "lineage_reconstruction_enabled": True,
+    # Per-get cap on recovery round-trips before giving up.
+    "max_object_recovery_attempts": 10,
     # --- rpc ---
     "rpc_connect_timeout_s": 30,
     "rpc_call_timeout_s": 120,

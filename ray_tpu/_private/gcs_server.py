@@ -61,6 +61,10 @@ class GcsServer:
 
         # --- object directory ---
         self.object_locations: Dict[bytes, Set[NodeID]] = defaultdict(set)
+        # Objects that were sealed at least once: an oid here with no live
+        # location is LOST (eviction or node death), which owners repair by
+        # lineage reconstruction (reference: object_recovery_manager.h).
+        self.sealed_ever: Set[bytes] = set()
 
         # --- placement groups ---
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
@@ -291,6 +295,12 @@ class GcsServer:
                     self.object_locations.pop(oid, None)
             except Exception:
                 pass
+        for oid in list(self.sealed_ever):
+            try:
+                if ObjectID(oid).job_id() == job_id:
+                    self.sealed_ever.discard(oid)
+            except Exception:
+                pass
 
     async def rpc_get_job_config(self, payload, conn):
         job = self.jobs.get(JobID(payload))
@@ -337,6 +347,7 @@ class GcsServer:
     async def rpc_object_location_add(self, payload, conn):
         oid, node_bytes = payload
         self.object_locations[oid].add(NodeID(node_bytes))
+        self.sealed_ever.add(bytes(oid))
         self.publish(f"obj:{oid.hex() if isinstance(oid, ObjectID) else bytes(oid).hex()}", True)
         return True
 
@@ -364,6 +375,33 @@ class GcsServer:
         not in the directory, so the free is broadcast to every node."""
         oids = payload
         for oid in oids:
+            self.object_locations.pop(oid, None)
+            self.sealed_ever.discard(bytes(oid))
+        for client in self.node_clients.values():
+            try:
+                await client.push("store_free", oids)
+            except Exception:
+                pass
+        return True
+
+    async def rpc_object_lost_check(self, payload, conn):
+        """True iff the object was sealed at some point but no live node
+        holds a copy now — i.e. it needs lineage reconstruction."""
+        oid = bytes(payload)
+        if oid not in self.sealed_ever:
+            return False
+        locs = self.object_locations.get(oid) or ()
+        return not any(
+            (info := self.nodes.get(n)) is not None and info.state == "ALIVE" for n in locs
+        )
+
+    async def rpc_objects_resubmitted(self, payload, conn):
+        """Owner is resubmitting the creating task for these objects:
+        clear their lost state and purge any stale copies (incl. sealed
+        error placeholders) so re-execution can seal fresh values."""
+        oids = [bytes(o) for o in payload]
+        for oid in oids:
+            self.sealed_ever.discard(oid)
             self.object_locations.pop(oid, None)
         for client in self.node_clients.values():
             try:

@@ -44,7 +44,7 @@ INLINE = 2
 class ObjectEntry:
     __slots__ = (
         "object_id", "size", "state", "path", "inline_data",
-        "pin_count", "last_access", "sealed_event", "is_error",
+        "pin_count", "last_access", "sealed_event", "is_error", "waiters",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -57,6 +57,7 @@ class ObjectEntry:
         self.last_access = time.monotonic()
         self.sealed_event: Optional[asyncio.Event] = None
         self.is_error = False
+        self.waiters = 0  # live wait_sealed() calls on this entry
 
 
 ARENA_FILENAME = "arena"
@@ -209,9 +210,16 @@ class ObjectStoreCore:
         return {"path": e.path, "size": e.size}
 
     def delete(self, object_id: ObjectID):
-        e = self.objects.pop(object_id, None)
+        e = self.objects.get(object_id)
         if e is None:
             return
+        if not e.state and e.waiters > 0:
+            # Placeholder with live waiters (wait_sealed): there is no
+            # data to delete, and popping it would strand the waiters'
+            # event — a later seal would notify a fresh entry instead.
+            # The last waiter reaps the placeholder itself.
+            return
+        self.objects.pop(object_id, None)
         if e.state:
             self.used -= e.size
         if e.path:
@@ -249,11 +257,18 @@ class ObjectStoreCore:
             self.objects[object_id] = e
         if e.sealed_event is None:
             e.sealed_event = asyncio.Event()
+        e.waiters += 1
         try:
             await asyncio.wait_for(e.sealed_event.wait(), timeout)
             return True
         except asyncio.TimeoutError:
             return False
+        finally:
+            e.waiters -= 1
+            # Reap the placeholder when the last waiter leaves and nothing
+            # was ever stored — otherwise timed-out gets leak entries.
+            if e.waiters <= 0 and not e.state and self.objects.get(object_id) is e:
+                del self.objects[object_id]
 
     def _notify_sealed(self, e: ObjectEntry):
         if e.sealed_event is not None:
@@ -381,7 +396,22 @@ class StoreClient:
             # the slot forever.
             data = bytes(view)
             del value
-            _arena_release(arena, id_bytes, view)
+            try:
+                view.release()
+            except BufferError:
+                # The discarded value sits in a reference cycle still
+                # exporting buffers over the view; collect it before
+                # releasing the slot (decref'ing while the buffers are
+                # alive would allow reuse under live array objects).
+                import gc
+
+                gc.collect()
+                try:
+                    view.release()
+                except BufferError:
+                    view = None  # give up: pin the slot for process life
+            if view is not None:
+                arena.decref(id_bytes)
             tag, value = serialization.deserialize(memoryview(data))
         return tag, value
 
@@ -401,6 +431,12 @@ class StoreClient:
         )
         if meta is None:
             raise exceptions.GetTimeoutError(f"timed out getting {object_id}")
+        if meta.get("lost"):
+            # Every copy is gone (node death/eviction).  Owners repair this
+            # via lineage reconstruction in Worker._get_one.
+            raise exceptions.ObjectLostError(
+                object_id, f"all copies of {object_id} were lost from the cluster"
+            )
         if "inline" in meta:
             return serialization.deserialize(memoryview(meta["inline"]))
         if meta.get("arena"):

@@ -733,18 +733,36 @@ class Raylet:
         return self.store.contains(ObjectID(payload))
 
     async def rpc_store_get(self, payload, conn):
-        """Get meta for one object, pulling from a remote node if needed."""
+        """Get meta for one object, pulling from a remote node if needed.
+
+        Returns {"lost": True} when the object was sealed somewhere once
+        but no live copy exists (node death or eviction) — the owner then
+        repairs it via lineage reconstruction (reference:
+        core_worker/object_recovery_manager.h)."""
         oid_bytes, timeout = payload
         oid = ObjectID(oid_bytes)
         meta = self.store.get_meta(oid)
         if meta is not None:
             return meta
         deadline = time.monotonic() + timeout if timeout is not None else None
-        # Kick off a pull and wait for seal.
-        self.loop.create_task(self._ensure_pulled(oid))
-        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-        ok = await self.store.wait_sealed(oid, remaining)
-        return self.store.get_meta(oid) if ok else None
+        while True:
+            pull_fut = self._start_pull(oid)
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            seal_task = asyncio.ensure_future(self.store.wait_sealed(oid, remaining))
+            await asyncio.wait({seal_task, pull_fut}, return_when=asyncio.FIRST_COMPLETED)
+            if pull_fut.done() and pull_fut.result() == "lost":
+                seal_task.cancel()
+                return {"lost": True}
+            if seal_task.done():
+                meta = self.store.get_meta(oid)
+                if meta is not None:
+                    return meta
+                if not seal_task.result():
+                    return None  # timed out
+                # sealed then evicted between wakeups: retry
+            else:
+                seal_task.cancel()
+            # pull finished (object arrived) or transient: loop re-checks
 
     async def rpc_store_wait(self, payload, conn):
         oid_bytes_list, num_returns, timeout = payload
@@ -753,7 +771,7 @@ class Raylet:
 
         async def wait_one(oid):
             if not self.store.contains(oid):
-                self.loop.create_task(self._ensure_pulled(oid))
+                self._start_pull(oid)
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             await self.store.wait_sealed(oid, remaining)
             return oid
@@ -814,12 +832,22 @@ class Raylet:
     # ------------------------------------------------------------------
     # object manager: pull from peers (reference: pull_manager.h:52)
     # ------------------------------------------------------------------
-    async def _ensure_pulled(self, oid: ObjectID):
+    def _start_pull(self, oid: ObjectID) -> asyncio.Future:
+        """Idempotently start pulling `oid`; the returned future resolves
+        to "lost" (sealed once, no live copy anywhere) or None (arrived /
+        loop retired)."""
         key = oid.binary()
-        if self.store.contains(oid) or key in self.pulls:
-            return
+        fut = self.pulls.get(key)
+        if fut is not None:
+            return fut
         fut = self.loop.create_future()
         self.pulls[key] = fut
+        self.loop.create_task(self._pull_loop(oid, fut))
+        return fut
+
+    async def _pull_loop(self, oid: ObjectID, fut: asyncio.Future):
+        key = oid.binary()
+        delay = 0.05
         try:
             while not self.store.contains(oid):
                 try:
@@ -832,7 +860,7 @@ class Raylet:
                         continue
                     try:
                         client = await self._peer(loc["raylet_address"])
-                        data = await client.call("om_fetch", key, timeout=60)
+                        data = await client.call("om_fetch", key, timeout=120)
                         if data is not None:
                             self.store.create_from_bytes(oid, data)
                             pulled = True
@@ -841,11 +869,20 @@ class Raylet:
                         continue
                 if pulled:
                     break
-                # Object isn't anywhere yet (e.g. task still running) —
-                # retry until it appears or callers give up.
-                await asyncio.sleep(0.1)
-                if not self.pulls.get(key):
-                    break
+                if not locations:
+                    # Nowhere to pull from: either the creating task hasn't
+                    # sealed it yet (keep waiting) or every copy is gone
+                    # (lost → owner must reconstruct).
+                    try:
+                        lost = await self.gcs.call("object_lost_check", key, timeout=10)
+                    except rpc.RpcError:
+                        lost = False
+                    if lost:
+                        if not fut.done():
+                            fut.set_result("lost")
+                        return
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 1.0)
         finally:
             self.pulls.pop(key, None)
             if not fut.done():

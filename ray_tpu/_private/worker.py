@@ -65,8 +65,15 @@ class ReferenceCounter:
             if c <= 1:
                 del self._counts[object_id]
                 if object_id in self._escaped:
+                    # The ref escaped into other tasks/objects: downstream
+                    # objects may still need this one's lineage for
+                    # transitive reconstruction, so keep it (reclaimed by
+                    # per-job GC, like the object itself).
                     self._escaped.discard(object_id)
-                    return  # reclaimed by per-job GC, not eagerly
+                    return
+                # No dependents can exist: drop lineage with the ref
+                # (reference: task_manager.h lineage pinning).
+                self._worker.lineage.pop(object_id.binary(), None)
                 self._to_free.append(object_id.binary())
                 if len(self._to_free) >= 100:
                     self._flush_locked()
@@ -178,6 +185,13 @@ class Worker:
         self._task_event_lock = threading.Lock()
         self._intended_exit = False
         self.runtime_context_info: dict = {}
+        # Lineage for owned task returns: oid bytes -> creating TaskSpec.
+        # Used to resubmit the creating task when every copy of an object
+        # is lost (reference: core_worker/object_recovery_manager.h,
+        # task_manager.h:212).  Entries are dropped when the ref dies.
+        self.lineage: Dict[bytes, TaskSpec] = {}
+        self._recovery_lock = threading.Lock()
+        self._recovery_inflight: Dict[bytes, float] = {}
 
     # ------------------------------------------------------------------
     # connection
@@ -292,19 +306,95 @@ class Worker:
         self._check_connected()
         self._notify_blocked(True)
         try:
-            out = []
             deadline = time.monotonic() + timeout if timeout is not None else None
-            for ref in refs:
-                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-                tag, value = self.store.get_serialized(ref.id, remaining)
-                if tag == serialization.TAG_ERROR:
-                    if isinstance(value, exceptions.RayTaskError):
-                        raise value.as_instanceof_cause()
-                    raise value
-                out.append(value)
-            return out
+            return [self._get_one(ref.id, deadline) for ref in refs]
         finally:
             self._notify_blocked(False)
+
+    def _get_one(self, object_id: ObjectID, deadline: Optional[float]) -> Any:
+        recovery_rounds = 0
+        while True:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                tag, value = self.store.get_serialized(object_id, remaining)
+            except exceptions.ObjectLostError:
+                recovery_rounds += 1
+                if recovery_rounds > CONFIG.max_object_recovery_attempts or not self._recover_object(
+                    object_id
+                ):
+                    raise
+                continue
+            if tag == serialization.TAG_ERROR:
+                # A task that failed because one of ITS args was lost
+                # stored an ObjectLostError-caused error.  The owner (us)
+                # holds the lineage for both the arg and this task:
+                # reconstruct the chain and retry instead of surfacing the
+                # transient error (reference: object_recovery_manager
+                # recovers borrowed args via the owner).
+                cause = value.cause if isinstance(value, exceptions.RayTaskError) else value
+                if isinstance(cause, exceptions.ObjectLostError):
+                    recovery_rounds += 1
+                    if recovery_rounds <= CONFIG.max_object_recovery_attempts and self._recover_object(
+                        object_id
+                    ):
+                        continue
+                if isinstance(value, exceptions.RayTaskError):
+                    raise value.as_instanceof_cause()
+                raise value
+            return value
+
+    def _recover_object(self, object_id: ObjectID, _depth: int = 0) -> bool:
+        """Lineage reconstruction: resubmit the task that created this
+        object, transitively recovering lost arguments first (reference:
+        core_worker/object_recovery_manager.h — RecoverObject resubmits
+        via TaskResubmissionInterface).  Returns True if a resubmission
+        was issued (caller retries the get), False if unrecoverable
+        (ray.put object, foreign ref, or retries exhausted)."""
+        if not CONFIG.lineage_reconstruction_enabled or _depth > 64:
+            return False
+        key = object_id.binary()
+        spec = self.lineage.get(key)
+        if spec is None:
+            return False
+        if spec.max_retries == 0:
+            # Explicitly non-retryable (side-effecting) task: its objects
+            # are unrecoverable, matching the reference's semantics.
+            return False
+        allowed = spec.max_retries if spec.max_retries >= 0 else (1 << 30)
+        with self._recovery_lock:
+            # Another thread's resubmission for this task is still fresh:
+            # don't double-submit, just let the caller retry its get.
+            last = self._recovery_inflight.get(spec.task_id.binary(), 0.0)
+            if time.monotonic() - last < 30.0:
+                return True
+            if spec.reconstructions >= allowed:
+                return False
+        # Recover lost arguments first so the re-executed task can fetch
+        # them (workers wait for in-flight reconstructions).
+        for kind, payload in spec.args:
+            if kind == "ref" and self.gcs_client.call("object_lost_check", payload):
+                if not self._recover_object(ObjectID(payload), _depth + 1):
+                    return False
+        with self._recovery_lock:
+            last = self._recovery_inflight.get(spec.task_id.binary(), 0.0)
+            if time.monotonic() - last < 30.0:
+                return True
+            spec.reconstructions += 1
+            self._recovery_inflight[spec.task_id.binary()] = time.monotonic()
+        logger.info(
+            "lineage reconstruction: resubmitting %s (attempt %d) for lost object %s",
+            spec.name, spec.reconstructions, object_id.hex()[:12],
+        )
+        try:
+            # Clear lost state + purge stale copies (incl. error
+            # placeholders) cluster-wide, then resubmit.
+            self.gcs_client.call(
+                "objects_resubmitted", [o.binary() for o in spec.return_ids()]
+            )
+            self.raylet_client.call("submit_task", {"spec": spec})
+        except rpc.RpcError:
+            return False
+        return True
 
     async def get_async(self, ref: ObjectRef):
         """Used by `await ref` inside async actors."""
@@ -406,6 +496,9 @@ class Worker:
             owner_worker_id=self.worker_id,
             runtime_env=options.get("runtime_env"),
         )
+        if CONFIG.lineage_reconstruction_enabled:
+            for oid in spec.return_ids():
+                self.lineage[oid.binary()] = spec
         self.raylet_client.call("submit_task", {"spec": spec})
         return [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
 
@@ -593,7 +686,23 @@ class Worker:
             if kind == "v":
                 _, value = serialization.deserialize(memoryview(payload))
             elif kind == "ref":
-                tag, value = self.store.get_serialized(ObjectID(payload), None)
+                oid = ObjectID(payload)
+                attempts = 0
+                while True:
+                    try:
+                        tag, value = self.store.get_serialized(oid, None)
+                        break
+                    except exceptions.ObjectLostError:
+                        # This worker may own the arg (nested task) and can
+                        # reconstruct.  Otherwise fail fast: the stored
+                        # ObjectLostError-caused error routes recovery to
+                        # the owner's get (Worker._get_one).
+                        attempts += 1
+                        if self._recover_object(oid):
+                            continue
+                        if attempts >= 2:
+                            raise
+                        time.sleep(1.0)
                 if tag == serialization.TAG_ERROR:
                     raise value if not isinstance(value, exceptions.RayTaskError) else value.as_instanceof_cause()
             values.append(value)

@@ -33,22 +33,25 @@ class Router:
         now = time.monotonic()
         if not force and now - self._last_refresh < self.REFRESH_S:
             return
+        # The blocking controller get happens OUTSIDE the lock — route()/
+        # done() on other proxy threads must never wait on this RPC.
+        replicas = self._ray.get(
+            self.controller.get_replicas.remote(self.deployment_name)
+        )
         with self._lock:
-            replicas = self._ray.get(
-                self.controller.get_replicas.remote(self.deployment_name)
-            )
             by_id = {r["replica_id"]: r for r in self._replicas}
-            new = []
-            for rinfo in replicas:
-                cur = by_id.get(rinfo["replica_id"])
-                if cur is not None:
-                    new.append(cur)
-                else:
-                    try:
-                        actor = self._ray.get_actor(rinfo["actor_name"], "serve")
-                        new.append({"replica_id": rinfo["replica_id"], "actor": actor})
-                    except Exception:
-                        pass
+        new = []
+        for rinfo in replicas:
+            cur = by_id.get(rinfo["replica_id"])
+            if cur is not None:
+                new.append(cur)
+            else:
+                try:
+                    actor = self._ray.get_actor(rinfo["actor_name"], "serve")
+                    new.append({"replica_id": rinfo["replica_id"], "actor": actor})
+                except Exception:
+                    pass
+        with self._lock:
             self._replicas = new
             self._last_refresh = now
         # report average load for autoscaling
