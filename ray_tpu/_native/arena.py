@@ -89,6 +89,10 @@ def load_library():
         lib.arena_num_evictions.argtypes = [ctypes.c_void_p]
         lib.arena_test_lock_and_abandon.restype = ctypes.c_int
         lib.arena_test_lock_and_abandon.argtypes = [ctypes.c_void_p]
+        lib.arena_can_fit.restype = ctypes.c_int
+        lib.arena_can_fit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.arena_release_create.restype = ctypes.c_int
+        lib.arena_release_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         _lib = lib
         return _lib
 
@@ -173,8 +177,18 @@ class NativeArena:
     def decref(self, object_id: bytes):
         self._lib.arena_decref(self._h, _pad_id(object_id))
 
+    def release_create(self, object_id: bytes):
+        """Drop the creator reference held since alloc() — call once the
+        object is registered with the store.  If the creator dies first,
+        eviction/delete reclaims the reference automatically."""
+        self._lib.arena_release_create(self._h, _pad_id(object_id))
+
     def delete(self, object_id: bytes) -> bool:
         return self._lib.arena_delete(self._h, _pad_id(object_id)) == 0
+
+    def can_fit(self, need: int) -> bool:
+        """A contiguous `need`-byte block is currently allocatable."""
+        return self._lib.arena_can_fit(self._h, need) == 1
 
     def evict_lru(self, need: int, max_out: int = 256):
         """Evict until `need` bytes fit; returns list of evicted ids (padded

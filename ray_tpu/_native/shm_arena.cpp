@@ -49,8 +49,10 @@ struct Entry {
   uint32_t state;
   uint32_t refcount;
   uint64_t last_access;  // monotonic ns, for LRU eviction
-  uint32_t owner_pid;    // writer while kAllocated (EOWNERDEAD repair)
-  uint32_t _pad;
+  uint32_t owner_pid;    // creator pid (crash cleanup)
+  // 1 while the creator still holds its alloc-time reference; cleared by
+  // arena_release_create, or reclaimed when owner_pid is dead.
+  uint32_t creator_ref;
 };
 
 struct FreeBlock {
@@ -132,6 +134,12 @@ void repair_after_owner_death(Arena* a) {
         e->refcount = 0;
         continue;
       }
+    }
+    if (e->state == kSealed && e->refcount > 0 && e->creator_ref &&
+        e->owner_pid != 0 && kill(pid_t(e->owner_pid), 0) != 0 && errno == ESRCH) {
+      // Creator died between seal and release: reclaim its reference.
+      e->creator_ref = 0;
+      e->refcount--;
     }
     if (e->state == kAllocated || e->state == kSealed) {
       blks[n++] = {e->offset, (e->size + 63) & ~63ull};
@@ -387,7 +395,12 @@ int64_t arena_alloc(void* handle, const uint8_t* id, uint64_t size) {
   e->offset = uint64_t(off);
   e->size = size;
   e->state = kAllocated;
-  e->refcount = 0;
+  // Creator reference: the writer holds one ref from alloc until its
+  // registration with the store completes (plasma's create semantics).
+  // Without it, LRU eviction can reclaim a just-sealed slot before the
+  // raylet records it, silently dropping the object.
+  e->refcount = 1;
+  e->creator_ref = 1;
   e->owner_pid = uint32_t(getpid());
   e->last_access = now_ns();
   a->hdr->used += size;
@@ -434,12 +447,39 @@ int arena_decref(void* handle, const uint8_t* id) {
   return 0;
 }
 
+// Drop the creator's alloc-time reference (after the raylet registered
+// the object).  Idempotent.
+int arena_release_create(void* handle, const uint8_t* id) {
+  Arena* a = (Arena*)handle;
+  Lock l(a);
+  Entry* e = find_entry(a, id, false);
+  if (e == nullptr || e->state == kEmpty || e->state == kTombstone) return -1;
+  if (e->creator_ref) {
+    e->creator_ref = 0;
+    if (e->refcount > 0) e->refcount--;
+  }
+  return 0;
+}
+
+namespace {
+// A creator that died before arena_release_create leaks one reference;
+// reclaim it so the slot stays evictable/deletable.
+void maybe_reap_dead_creator(Entry* e) {
+  if (e->creator_ref && e->owner_pid != 0 &&
+      kill(pid_t(e->owner_pid), 0) != 0 && errno == ESRCH) {
+    e->creator_ref = 0;
+    if (e->refcount > 0) e->refcount--;
+  }
+}
+}  // namespace
+
 // Delete if refcount == 0. Returns 0 on success, -1 busy/absent.
 int arena_delete(void* handle, const uint8_t* id) {
   Arena* a = (Arena*)handle;
   Lock l(a);
   Entry* e = find_entry(a, id, false);
   if (e == nullptr || e->state == kEmpty || e->state == kTombstone) return -1;
+  if (e->refcount > 0) maybe_reap_dead_creator(e);
   if (e->refcount > 0) return -1;
   delete_entry_locked(a, e);
   return 0;
@@ -489,6 +529,7 @@ int arena_evict_lru(void* handle, uint64_t need, uint8_t* out_ids, int max_out) 
   uint32_t n_cand = 0;
   for (uint32_t i = 0; i < h->table_cap; i++) {
     Entry* e = &a->table[i];
+    if (e->state == kSealed && e->refcount > 0) maybe_reap_dead_creator(e);
     if (e->state == kSealed && e->refcount == 0) {
       cands[n_cand++] = {e->last_access, i};
     }

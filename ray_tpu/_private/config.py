@@ -24,6 +24,13 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "object_store_memory_cap": 8 * 1024**3,
     # Chunk size for node-to-node object transfer.
     "object_manager_chunk_size": 4 * 1024**2,
+    # Parallel in-flight chunks per object pull.
+    "object_manager_max_parallel_chunks": 4,
+    # Spill LRU objects to disk under memory pressure instead of evicting
+    # (reference: external_storage.py + local_object_manager.h).
+    "object_spilling_enabled": True,
+    # Spill directory ("" = <store_dir>/spill).
+    "object_spilling_dir": "",
     # --- scheduling ---
     "worker_lease_timeout_ms": 30_000,
     # Top-k fraction of nodes considered by the hybrid scheduling policy.

@@ -92,11 +92,119 @@ class ObjectStoreCore:
         self.num_evictions = 0
         # Native arena backend (plasma-equivalent); None → file fallback.
         self.arena = _try_native_arena(store_dir, capacity_bytes, create=True)
+        # --- spilling (reference: external_storage.py FileSystemStorage +
+        # raylet/local_object_manager.h SpillObjects) ---
+        # Under memory pressure, LRU sealed objects are written to disk and
+        # dropped from memory; reads serve straight from the spill file
+        # (it is just another file-backed location), so no restore pass is
+        # needed and the GCS directory keeps this node as a valid location.
+        # Per-node subdirectory: a configured shared spill root must not
+        # let one node's shutdown rmtree other nodes' spill files.
+        self.spill_dir = os.path.join(
+            CONFIG.object_spilling_dir or store_dir,
+            "spill_" + os.path.basename(os.path.normpath(store_dir)),
+        )
+        self.spilled: Dict[ObjectID, Tuple[str, int]] = {}  # oid -> (path, size)
+        self.spilled_bytes = 0
+        self.num_spilled = 0
+        self.num_restored = 0
+        # In-progress chunked creates: oid -> ("arena", view) | ("file", mmap, path)
+        self._creates: Dict[ObjectID, tuple] = {}
+
+    # -- spilling ----------------------------------------------------------
+    def _spill_one(self, e: ObjectEntry) -> bool:
+        """Move one sealed in-memory object to the spill directory.
+
+        The copy runs in bounded 8MB slices so peak extra memory stays
+        constant regardless of object size.  The write itself is still
+        synchronous on the raylet loop — local-disk bursts are ms-scale;
+        a dedicated spill-IO thread pool (reference: IO workers driven by
+        local_object_manager.h) is the next step if profiles demand it.
+        """
+        size = e.size
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, e.object_id.hex())
+        tmp = path + ".w"
+        slice_size = 8 * 1024 * 1024
+        try:
+            with open(tmp, "wb") as f:
+                off = 0
+                while off < size:
+                    r = self.read_chunk(e.object_id, off, min(slice_size, size - off))
+                    if r is None:
+                        raise OSError("object vanished mid-spill")
+                    f.write(r[1])
+                    off += len(r[1])
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        # Delete the in-memory copy; a mapped arena slot (refcount > 0)
+        # can't be reclaimed — undo the spill for that one.
+        if not self.delete_in_memory(e.object_id):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        self.spilled[e.object_id] = (path, size)
+        self.spilled_bytes += size
+        self.num_spilled += 1
+        return True
+
+    def _spill_until_fits(self, need: int) -> bool:
+        if need > self.capacity:
+            return False  # can never fit: don't drain the store trying
+        if not CONFIG.object_spilling_enabled:
+            return self.can_fit(need)
+        for e in self.lru_candidates():
+            if self.can_fit(need):
+                return True
+            self._spill_one(e)
+        return self.can_fit(need)
+
+    def lru_candidates(self) -> List[ObjectEntry]:
+        return sorted(
+            (
+                e
+                for e in self.objects.values()
+                if e.state == SEALED and e.pin_count == 0
+            ),
+            key=lambda e: e.last_access,
+        )
+
+    def can_fit(self, need: int) -> bool:
+        if self.arena is not None:
+            return bool(self.arena.can_fit(need))
+        return self.used + need <= self.capacity
+
+    def delete_in_memory(self, object_id: ObjectID) -> bool:
+        """Remove the in-memory copy only (spill keeps serving the data).
+        Returns False if an arena slot is still mapped by a reader."""
+        e = self.objects.get(object_id)
+        if e is None or not e.state:
+            return False
+        if e.state == SEALED and e.path is None and self.arena is not None:
+            if not self.arena.delete(object_id.binary()):
+                return False  # refcount > 0: a client has it mapped
+        elif e.path:
+            try:
+                os.unlink(e.path)
+            except OSError:
+                pass
+        self.objects.pop(object_id, None)
+        self.used -= e.size
+        return True
 
     def reserve(self, need: int) -> bool:
-        """Make room for a `need`-byte allocation in the arena, evicting
-        LRU unreferenced objects and retracting them from the directory
+        """Make room for a `need`-byte allocation: spill LRU objects to
+        disk first (they stay readable), evict outright as a last resort
         (client calls this when arena_alloc reports no space)."""
+        if self._spill_until_fits(need):
+            return True
         if self.arena is None:
             self._ensure_capacity(need)
             return True
@@ -119,7 +227,9 @@ class ObjectStoreCore:
 
     def contains(self, object_id: ObjectID) -> bool:
         e = self.objects.get(object_id)
-        return e is not None and e.state in (SEALED, INLINE)
+        if e is not None and e.state in (SEALED, INLINE):
+            return True
+        return object_id in self.spilled
 
     def put_inline(self, object_id: ObjectID, data: bytes, is_error: bool = False) -> bool:
         if self.contains(object_id):
@@ -168,7 +278,9 @@ class ObjectStoreCore:
                 view[:] = data
                 del view
                 self.arena.seal(object_id.binary())
-                return self.seal_file(object_id, len(data))
+                ok = self.seal_file(object_id, len(data))
+                self.arena.release_create(object_id.binary())
+                return ok
             if code == -2:
                 return False
             # fall through to file path on arena exhaustion
@@ -181,6 +293,13 @@ class ObjectStoreCore:
     def read_bytes(self, object_id: ObjectID) -> Optional[bytes]:
         e = self.objects.get(object_id)
         if e is None or not e.state:
+            sp = self.spilled.get(object_id)
+            if sp is not None:
+                try:
+                    with open(sp[0], "rb") as f:
+                        return f.read()
+                except OSError:
+                    return None
             return None
         e.last_access = time.monotonic()
         if e.state == INLINE:
@@ -200,6 +319,13 @@ class ObjectStoreCore:
     def get_meta(self, object_id: ObjectID):
         e = self.objects.get(object_id)
         if e is None or not e.state:
+            sp = self.spilled.get(object_id)
+            if sp is not None:
+                # Spilled objects serve as plain file-backed objects —
+                # clients mmap the spill file directly, no restore pass.
+                self.num_gets += 1
+                self.num_restored += 1
+                return {"path": sp[0], "size": sp[1]}
             return None
         e.last_access = time.monotonic()
         self.num_gets += 1
@@ -209,7 +335,115 @@ class ObjectStoreCore:
             return {"arena": True, "size": e.size}
         return {"path": e.path, "size": e.size}
 
+    def read_chunk(self, object_id: ObjectID, offset: int, length: int):
+        """(total_size, bytes) for node-to-node chunked transfer, or None
+        (reference: object_manager push/pull chunking, push_manager.h:30)."""
+        e = self.objects.get(object_id)
+        if e is not None and e.state:
+            e.last_access = time.monotonic()
+            if e.state == INLINE:
+                return e.size, e.inline_data[offset : offset + length]
+            if e.path is None and self.arena is not None:
+                view = self.arena.lookup(object_id.binary())
+                if view is None:
+                    return None
+                try:
+                    return e.size, bytes(view[offset : offset + length])
+                finally:
+                    del view
+                    self.arena.decref(object_id.binary())
+            try:
+                with open(e.path, "rb") as f:
+                    f.seek(offset)
+                    return e.size, f.read(length)
+            except OSError:
+                return None
+        sp = self.spilled.get(object_id)
+        if sp is not None:
+            try:
+                with open(sp[0], "rb") as f:
+                    f.seek(offset)
+                    return sp[1], f.read(length)
+            except OSError:
+                return None
+        return None
+
+    # -- chunked creates (pulls from remote nodes) -------------------------
+    def begin_create(self, object_id: ObjectID, size: int) -> Optional[memoryview]:
+        """Allocate a writable buffer for an incoming object; pair with
+        commit_create/abort_create.  None = already stored/in progress or
+        no space."""
+        if self.contains(object_id) or object_id in self._creates:
+            return None
+        if self.arena is not None:
+            code, view = self.arena.alloc_status(object_id.binary(), size)
+            if code == -1 and self.reserve(size):
+                code, view = self.arena.alloc_status(object_id.binary(), size)
+            if code == 0:
+                self._creates[object_id] = ("arena", view)
+                return view
+            if code == -2:
+                return None
+            # fall through to file on arena exhaustion
+        self._ensure_capacity(size)
+        path = self.object_path(object_id) + ".w"
+        try:
+            f = open(path, "w+b")
+            f.truncate(size)
+            m = mmap.mmap(f.fileno(), size)
+            f.close()
+        except OSError:
+            return None
+        self._creates[object_id] = ("file", m, path)
+        return memoryview(m)
+
+    def commit_create(self, object_id: ObjectID, size: int) -> bool:
+        rec = self._creates.pop(object_id, None)
+        if rec is None:
+            return False
+        if rec[0] == "arena":
+            view = rec[1]
+            try:
+                view.release()
+            except BufferError:
+                pass
+            self.arena.seal(object_id.binary())
+            ok = self.seal_file(object_id, size)
+            self.arena.release_create(object_id.binary())
+            return ok
+        m, path = rec[1], rec[2]
+        _close_mmap_quietly(m)
+        os.rename(path, self.object_path(object_id))
+        return self.seal_file(object_id, size)
+
+    def abort_create(self, object_id: ObjectID):
+        rec = self._creates.pop(object_id, None)
+        if rec is None:
+            return
+        if rec[0] == "arena":
+            view = rec[1]
+            try:
+                view.release()
+            except BufferError:
+                pass
+            self.arena.release_create(object_id.binary())
+            self.arena.delete(object_id.binary())
+        else:
+            m, path = rec[1], rec[2]
+            _close_mmap_quietly(m)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
     def delete(self, object_id: ObjectID):
+        sp = self.spilled.pop(object_id, None)
+        if sp is not None:
+            self.spilled_bytes -= sp[1]
+            try:
+                os.unlink(sp[0])
+            except OSError:
+                pass
         e = self.objects.get(object_id)
         if e is None:
             return
@@ -252,6 +486,8 @@ class ObjectStoreCore:
         e = self.objects.get(object_id)
         if e is not None and e.state:
             return True
+        if object_id in self.spilled:
+            return True  # available on disk — no seal event will fire
         if e is None:
             e = ObjectEntry(object_id)
             self.objects[object_id] = e
@@ -282,6 +518,12 @@ class ObjectStoreCore:
     def _ensure_capacity(self, need: int):
         if self.used + need <= self.capacity:
             return
+        # Spill before evicting: spilled objects remain readable.
+        if CONFIG.object_spilling_enabled:
+            for e in self.lru_candidates():
+                if self.used + need <= self.capacity:
+                    return
+                self._spill_one(e)
         candidates = sorted(
             (e for e in self.objects.values() if e.state and e.pin_count == 0),
             key=lambda e: e.last_access,
@@ -302,6 +544,9 @@ class ObjectStoreCore:
             "num_puts": self.num_puts,
             "num_gets": self.num_gets,
             "num_evictions": self.num_evictions,
+            "num_spilled": self.num_spilled,
+            "spilled_bytes": self.spilled_bytes,
+            "num_restored": self.num_restored,
         }
 
 
@@ -354,7 +599,14 @@ class StoreClient:
                 serialization.write_into(view, meta, buffers)
                 del view
                 self.arena.seal(object_id.binary())
-                self._raylet.call("store_seal", (object_id.binary(), total))
+                try:
+                    self._raylet.call("store_seal", (object_id.binary(), total))
+                finally:
+                    # Creator ref held since alloc: only now — after the
+                    # raylet registered the object — may eviction consider
+                    # this slot.  (If this process dies first, eviction
+                    # reclaims the creator ref via its pid.)
+                    self.arena.release_create(object_id.binary())
                 return total
             if code == -2:  # already stored by someone else
                 return total
@@ -425,27 +677,37 @@ class StoreClient:
             out = self._deserialize_arena(object_id)
             if out is not None:
                 return out
-        meta = self._raylet.call(
-            "store_get", (object_id.binary(), timeout),
-            timeout=(timeout + 5) if timeout is not None else None,
-        )
-        if meta is None:
-            raise exceptions.GetTimeoutError(f"timed out getting {object_id}")
-        if meta.get("lost"):
-            # Every copy is gone (node death/eviction).  Owners repair this
-            # via lineage reconstruction in Worker._get_one.
-            raise exceptions.ObjectLostError(
-                object_id, f"all copies of {object_id} were lost from the cluster"
+        for attempt in range(3):
+            meta = self._raylet.call(
+                "store_get", (object_id.binary(), timeout),
+                timeout=(timeout + 5) if timeout is not None else None,
             )
-        if "inline" in meta:
-            return serialization.deserialize(memoryview(meta["inline"]))
-        if meta.get("arena"):
-            out = self._deserialize_arena(object_id)
-            if out is not None:
-                return out
-            # evicted between the reply and our lookup — treat as lost
+            if meta is None:
+                raise exceptions.GetTimeoutError(f"timed out getting {object_id}")
+            if meta.get("lost"):
+                # Every copy is gone (node death/eviction).  Owners repair
+                # this via lineage reconstruction in Worker._get_one.
+                raise exceptions.ObjectLostError(
+                    object_id, f"all copies of {object_id} were lost from the cluster"
+                )
+            if "inline" in meta:
+                return serialization.deserialize(memoryview(meta["inline"]))
+            if meta.get("arena"):
+                out = self._deserialize_arena(object_id)
+                if out is not None:
+                    return out
+                # Spilled or evicted between the reply and our lookup:
+                # refetch the meta (a spilled object resolves to a file).
+                continue
+            try:
+                f = open(meta["path"], "rb")
+            except FileNotFoundError:
+                # The object spilled (original file moved) between the
+                # reply and our open: refetch the meta.
+                continue
+            break
+        else:
             raise exceptions.ObjectLostError(f"{object_id} evicted during get")
-        f = open(meta["path"], "rb")
         try:
             m = mmap.mmap(f.fileno(), meta["size"], prot=mmap.PROT_READ)
         finally:

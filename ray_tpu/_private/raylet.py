@@ -162,6 +162,7 @@ class Raylet:
             c.close()
         import shutil
 
+        shutil.rmtree(self.store.spill_dir, ignore_errors=True)
         shutil.rmtree(self.store.store_dir, ignore_errors=True)
         try:  # remove the per-session parent when the last store leaves
             os.rmdir(os.path.dirname(self.store.store_dir))
@@ -860,9 +861,7 @@ class Raylet:
                         continue
                     try:
                         client = await self._peer(loc["raylet_address"])
-                        data = await client.call("om_fetch", key, timeout=120)
-                        if data is not None:
-                            self.store.create_from_bytes(oid, data)
+                        if await self._fetch_from_peer(client, oid):
                             pulled = True
                             break
                     except rpc.RpcError:
@@ -888,8 +887,54 @@ class Raylet:
             if not fut.done():
                 fut.set_result(None)
 
+    async def _fetch_from_peer(self, client: rpc.AsyncRpcClient, oid: ObjectID) -> bool:
+        """Pull one object in bounded-parallel chunks (reference:
+        push_manager.h:30 chunked parallel transfer).  The first chunk
+        reply carries the total size; large objects are written straight
+        into a store allocation so no full-object frame ever crosses the
+        wire or the event loop."""
+        key = oid.binary()
+        chunk = int(CONFIG.object_manager_chunk_size)
+        first = await client.call("om_fetch_chunk", (key, 0, chunk), timeout=60)
+        if first is None:
+            return False
+        total, data0 = first
+        if total <= len(data0):
+            return bool(self.store.create_from_bytes(oid, data0)) or self.store.contains(oid)
+        writer = self.store.begin_create(oid, total)
+        if writer is None:
+            # Raced with another pull/seal, or no space even after spill.
+            return self.store.contains(oid)
+        try:
+            writer[: len(data0)] = data0
+            sem = asyncio.Semaphore(int(CONFIG.object_manager_max_parallel_chunks))
+
+            async def fetch(off: int):
+                async with sem:
+                    r = await client.call(
+                        "om_fetch_chunk", (key, off, min(chunk, total - off)), timeout=60
+                    )
+                    if r is None:
+                        raise rpc.RpcError(f"peer dropped object {oid.hex()[:12]} mid-pull")
+                    writer[off : off + len(r[1])] = r[1]
+
+            await asyncio.gather(*(fetch(off) for off in range(len(data0), total, chunk)))
+        except Exception:
+            del writer
+            self.store.abort_create(oid)
+            return False
+        del writer
+        self.store.commit_create(oid, total)
+        return True
+
+    async def rpc_om_fetch_chunk(self, payload, conn):
+        """Peer raylet requests an object byte range; reply is
+        (total_size, bytes) so the first chunk also conveys the size."""
+        oid_bytes, offset, length = payload
+        return self.store.read_chunk(ObjectID(oid_bytes), offset, length)
+
     async def rpc_om_fetch(self, payload, conn):
-        """Peer raylet requests object bytes (chunking TODO for >4MB)."""
+        """Whole-object fetch (kept for small objects / compat)."""
         return self.store.read_bytes(ObjectID(payload))
 
     # ------------------------------------------------------------------

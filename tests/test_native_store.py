@@ -30,6 +30,7 @@ def test_alloc_seal_lookup_roundtrip(arena):
     buf[:11] = b"hello arena"
     del buf
     assert arena.seal(b"id1")
+    arena.release_create(b"id1")  # drop creator ref (held from alloc)
     v = arena.lookup(b"id1")
     assert bytes(v[:11]) == b"hello arena" and len(v) == 64
     del v
@@ -54,6 +55,7 @@ def test_refcount_blocks_delete_and_eviction(arena):
     buf = arena.alloc(b"pinned", 500_000)
     del buf
     arena.seal(b"pinned")
+    arena.release_create(b"pinned")
     v = arena.lookup(b"pinned")  # refcount 1
     assert not arena.delete(b"pinned")
     # eviction cannot reclaim it either: a too-big request must fail
@@ -67,6 +69,7 @@ def test_free_space_reuse_and_coalescing(arena):
     for i in range(4):
         arena.alloc(b"b%d" % i, 200_000)
         arena.seal(b"b%d" % i)
+        arena.release_create(b"b%d" % i)
     used_before = arena.used
     # delete middle neighbours -> coalesced 400k hole fits one 390k object
     assert arena.delete(b"b1")
@@ -82,6 +85,7 @@ def test_lru_eviction_order(arena):
     for i in range(5):
         arena.alloc(b"e%d" % i, 150_000)
         arena.seal(b"e%d" % i)
+        arena.release_create(b"e%d" % i)
         time.sleep(0.002)
     # touch e0 so it becomes most-recently-used
     v = arena.lookup(b"e0")
@@ -108,6 +112,7 @@ buf = a.alloc(b"xproc", 32)
 buf[:7] = b"fromsub"
 del buf
 a.seal(b"xproc")
+a.release_create(b"xproc")
 a.close()
 """
     subprocess.run([sys.executable, "-c", code], check=True, timeout=60)
@@ -148,6 +153,7 @@ def test_eownerdead_repair(arena, tmp_path):
     buf[:4] = b"keep"
     del buf
     arena.seal(b"survivor")
+    arena.release_create(b"survivor")
     path = "/dev/shm/test_arena_%d" % os.getpid()
     # Child: allocate WITHOUT sealing (mid-write garbage), grab the arena
     # mutex, and die holding it.
@@ -177,4 +183,5 @@ os._exit(42)
     buf[:2] = b"ok"
     del buf
     assert arena.seal(b"after")
+    arena.release_create(b"after")
     assert arena.contains(b"after")
