@@ -94,11 +94,21 @@ def detect_resources(num_cpus=None, num_tpus=None, resources=None, memory=None) 
 class NodeProcesses:
     """Driver-side handles to the processes this driver started."""
 
-    def __init__(self, session_dir: str, gcs_address: str, raylet_address: str, procs):
+    def __init__(
+        self,
+        session_dir: str,
+        gcs_address: str,
+        raylet_address: str,
+        procs,
+        store_root: Optional[str] = None,
+    ):
         self.session_dir = session_dir
         self.gcs_address = gcs_address
         self.raylet_address = raylet_address
         self.procs = list(procs)
+        # Recorded at startup — default_store_root() re-probes /dev/shm
+        # writability, which can pick a *different* base at teardown.
+        self.store_root = store_root
 
     def terminate(self):
         for p in self.procs:
@@ -123,6 +133,13 @@ class NodeProcesses:
                         os.unlink(CLUSTER_ADDRESS_FILE)
         except OSError:
             pass
+        # Raylets reclaim their own shm arenas on graceful stop, but a
+        # SIGKILL'd raylet can't — sweep this session's store root so
+        # /dev/shm doesn't accumulate arenas across runs.
+        if self.store_root:
+            import shutil
+
+            shutil.rmtree(self.store_root, ignore_errors=True)
 
 
 def start_head(
@@ -160,7 +177,13 @@ def start_head(
         env=child_env(),
     )
     log.close()
-    node = NodeProcesses(session_dir, gcs_address, raylet_address, [proc])
+    node = NodeProcesses(
+        session_dir,
+        gcs_address,
+        raylet_address,
+        [proc],
+        store_root=os.path.dirname(store_dir),
+    )
     if wait:
         _wait_for_node(gcs_address, proc)
         os.makedirs(RAY_TPU_TMP, exist_ok=True)

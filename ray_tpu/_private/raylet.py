@@ -151,15 +151,23 @@ class Raylet:
 
     async def stop(self):
         self._stopping = True
-        for t in self._bg:
-            t.cancel()
-        for w in list(self.workers.values()):
-            self._kill_worker_proc(w)
-        await self.server.stop()
-        if self.gcs:
-            self.gcs.close()
-        for c in self.peer_clients.values():
-            c.close()
+        try:
+            for t in self._bg:
+                t.cancel()
+            for w in list(self.workers.values()):
+                self._kill_worker_proc(w)
+            await self.server.stop()
+            if self.gcs:
+                self.gcs.close()
+            for c in self.peer_clients.values():
+                c.close()
+        finally:
+            # Always reclaim the shm arena, even if the graceful teardown
+            # above raised or was cancelled by raylet_main's stop timeout —
+            # a leaked /dev/shm arena outlives the process.
+            self.cleanup_store_files()
+
+    def cleanup_store_files(self):
         import shutil
 
         shutil.rmtree(self.store.spill_dir, ignore_errors=True)

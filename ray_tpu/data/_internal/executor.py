@@ -234,6 +234,21 @@ class PhysicalOperator:
     def internal_queue_size(self) -> int:
         return 0
 
+    # -- deterministic emission helpers (shared by the map operators) --
+    # Tasks finish in completion order, but bundles are emitted strictly in
+    # submission (task_idx) order via a reorder buffer (reference:
+    # streaming_executor_state.py ordered OpState output queues).
+
+    def _init_reorder_buffer(self) -> None:
+        self._reorder: Dict[int, RefBundle] = {}
+        self._next_emit = 0
+
+    def _emit_in_order(self, task_idx: int, bundle: RefBundle) -> None:
+        self._reorder[task_idx] = bundle
+        while self._next_emit in self._reorder:
+            self._output_queue.append(self._reorder.pop(self._next_emit))
+            self._next_emit += 1
+
 
 class InputDataBuffer(PhysicalOperator):
     """Source op: emits pre-existing bundles."""
@@ -265,6 +280,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         # meta_ref -> (block_ref, task_idx)
         self._active: Dict[Any, Tuple[Any, int]] = {}
         self._task_idx = 0
+        self._init_reorder_buffer()
 
     def add_input(self, bundle: RefBundle, input_index: int) -> None:
         self._pending_inputs.append(bundle)
@@ -273,7 +289,9 @@ class TaskPoolMapOperator(PhysicalOperator):
         while (
             self._pending_inputs
             and len(self._active) < ctx.max_in_flight_tasks_per_op
-            and len(self._output_queue) < ctx.op_output_queue_max_blocks
+            # Reorder-buffered bundles count against the output cap too, or a
+            # single straggler would let dispatch run unboundedly ahead.
+            and len(self._output_queue) + len(self._reorder) < ctx.op_output_queue_max_blocks
         ):
             bundle = self._pending_inputs.pop(0)
             block_ref, meta_ref = self._task_factory(bundle, self._task_idx)
@@ -289,12 +307,11 @@ class TaskPoolMapOperator(PhysicalOperator):
     def process_ready(self, ready_refs: set) -> None:
         done = [r for r in self._active if r in ready_refs]
         for meta_ref in done:
-            block_ref, _ = self._active.pop(meta_ref)
-            meta = ray_tpu.get(meta_ref)
-            self._output_queue.append(RefBundle(block_ref, meta))
+            block_ref, task_idx = self._active.pop(meta_ref)
+            self._emit_in_order(task_idx, RefBundle(block_ref, ray_tpu.get(meta_ref)))
 
     def internal_queue_size(self) -> int:
-        return len(self._pending_inputs)
+        return len(self._pending_inputs) + len(self._reorder)
 
 
 class ActorPoolMapOperator(PhysicalOperator):
@@ -317,7 +334,10 @@ class ActorPoolMapOperator(PhysicalOperator):
         self._actors: List[Any] = []
         self._idle: List[Any] = []
         self._pending_inputs: List[RefBundle] = []
-        self._active: Dict[Any, Tuple[Any, Any]] = {}  # meta_ref -> (block_ref, actor)
+        # meta_ref -> (block_ref, actor, task_idx)
+        self._active: Dict[Any, Tuple[Any, Any, int]] = {}
+        self._task_idx = 0
+        self._init_reorder_buffer()
 
     def start(self, ctx: DataContext) -> None:
         super().start(ctx)
@@ -339,12 +359,13 @@ class ActorPoolMapOperator(PhysicalOperator):
         while (
             self._pending_inputs
             and self._idle
-            and len(self._output_queue) < ctx.op_output_queue_max_blocks
+            and len(self._output_queue) + len(self._reorder) < ctx.op_output_queue_max_blocks
         ):
             bundle = self._pending_inputs.pop(0)
             actor = self._idle.pop(0)
             block_ref, meta_ref = self._submit(actor, bundle)
-            self._active[meta_ref] = (block_ref, actor)
+            self._active[meta_ref] = (block_ref, actor, self._task_idx)
+            self._task_idx += 1
 
     def num_active_tasks(self) -> int:
         return len(self._active)
@@ -355,13 +376,12 @@ class ActorPoolMapOperator(PhysicalOperator):
     def process_ready(self, ready_refs: set) -> None:
         done = [r for r in self._active if r in ready_refs]
         for meta_ref in done:
-            block_ref, actor = self._active.pop(meta_ref)
+            block_ref, actor, task_idx = self._active.pop(meta_ref)
             self._idle.append(actor)
-            meta = ray_tpu.get(meta_ref)
-            self._output_queue.append(RefBundle(block_ref, meta))
+            self._emit_in_order(task_idx, RefBundle(block_ref, ray_tpu.get(meta_ref)))
 
     def internal_queue_size(self) -> int:
-        return len(self._pending_inputs)
+        return len(self._pending_inputs) + len(self._reorder)
 
 
 class LimitOperator(PhysicalOperator):
@@ -405,8 +425,33 @@ class LimitOperator(PhysicalOperator):
 
 
 class UnionOperator(PhysicalOperator):
+    """Ordered concatenation: all of input 0's bundles, then input 1's, etc.
+    Later inputs are buffered until every earlier input has completed, so the
+    output order is deterministic regardless of task completion timing
+    (reference: union preserves dataset order, logical_op Union)."""
+
+    def __init__(self, name: str, input_ops: List["PhysicalOperator"]):
+        super().__init__(name, input_ops)
+        self._buffers: List[List[RefBundle]] = [[] for _ in input_ops]
+        self._emit_idx = 0  # first input not yet fully drained
+
     def add_input(self, bundle: RefBundle, input_index: int) -> None:
-        self._output_queue.append(bundle)
+        if input_index == self._emit_idx:
+            self._output_queue.append(bundle)
+        else:
+            self._buffers[input_index].append(bundle)
+
+    def input_done(self, input_index: int) -> None:
+        super().input_done(input_index)
+        # Advance past every finished input, flushing its buffered bundles.
+        while self._emit_idx < len(self._inputs_done) and self._inputs_done[self._emit_idx]:
+            self._emit_idx += 1
+            if self._emit_idx < len(self._buffers):
+                self._output_queue.extend(self._buffers[self._emit_idx])
+                self._buffers[self._emit_idx] = []
+
+    def internal_queue_size(self) -> int:
+        return sum(len(b) for b in self._buffers)
 
 
 class AllToAllOperator(PhysicalOperator):

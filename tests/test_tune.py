@@ -6,6 +6,7 @@ a real local cluster.
 """
 
 import os
+import time
 
 import pytest
 
@@ -164,7 +165,12 @@ def test_pbt_exploits(ray_cluster, tmp_path):
             self.value = 0.0
 
         def step(self):
-            # lr=good makes fast progress; PBT should propagate it
+            # lr=good makes fast progress; PBT should propagate it.
+            # The sleep keeps the population running concurrently: with
+            # instant steps a trial can finish all 12 iterations before the
+            # other trials report once, and PBT's quantile logic (correctly)
+            # refuses to exploit without a full population.
+            time.sleep(0.1)
             self.value += self.config["lr"]
             return {"value": self.value}
 
