@@ -162,6 +162,9 @@ class ActorInfo:
     creation_spec: Optional[TaskSpec] = None
     detached: bool = False
     pid: int = 0
+    # Direct RPC endpoint of the actor's worker process — callers push
+    # method invocations straight to it (reference: actor_task_submitter.h).
+    worker_address: Optional[str] = None
 
 
 @dataclass

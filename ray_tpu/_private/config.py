@@ -53,6 +53,26 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "lineage_reconstruction_enabled": True,
     # Per-get cap on recovery round-trips before giving up.
     "max_object_recovery_attempts": 10,
+    # --- direct task submission (reference: core_worker/transport/
+    # normal_task_submitter.h:74 — lease workers from the raylet, push task
+    # specs worker-to-worker with the raylet out of the data path) ---
+    "direct_task_submission": True,
+    "direct_actor_calls": True,
+    # A granted lease kept past this idle time is returned to the raylet.
+    "lease_idle_timeout_ms": 1_000,
+    # Max workers leased per scheduling key (resource shape) per submitter.
+    "max_leases_per_scheduling_key": 16,
+    # Task specs pipelined to one leased worker ahead of completion (used
+    # once the lease cap is reached; below it, work spreads 1-per-worker).
+    "lease_pipeline_depth": 32,
+    # Tasks whose EWMA duration exceeds this are "long": lease count grows
+    # toward max_leases_per_scheduling_key for real parallelism.  Shorter
+    # tasks stay on ~cpu_count leases and pipeline instead — more workers
+    # than cores just thrash.
+    "lease_grow_task_ms": 10.0,
+    # How long a recovery resubmission suppresses duplicate resubmits of
+    # the same creating task (seconds); retried with backoff after.
+    "object_recovery_inflight_window_s": 30.0,
     # --- rpc ---
     "rpc_connect_timeout_s": 30,
     "rpc_call_timeout_s": 120,

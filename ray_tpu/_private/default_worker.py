@@ -13,6 +13,14 @@ def main():
     import os
     import sys as _sys
 
+    # Debugging aid: RAY_TPU_WORKER_STACK_DUMP_S=N dumps every thread's
+    # stack to the worker log every N seconds (hung-worker triage).
+    dump_s = os.environ.get("RAY_TPU_WORKER_STACK_DUMP_S")
+    if dump_s:
+        import faulthandler
+
+        faulthandler.dump_traceback_later(float(dump_s), repeat=True, exit=False)
+
     # A sitecustomize may have imported jax and pinned a platform before
     # this runs; the job's JAX_PLATFORMS env must win in workers.
     platforms = os.environ.get("JAX_PLATFORMS")

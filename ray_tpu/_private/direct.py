@@ -1,0 +1,397 @@
+"""Direct worker-to-worker task submission.
+
+The submitter leases workers from the raylet per *scheduling key* (resource
+shape) and pushes task specs straight to the leased worker's RPC endpoint —
+the raylet is out of the per-task data path.  Results small enough to
+inline come back on the task-finished push and land in the owner's
+MemoryStore (reference: src/ray/core_worker/transport/
+normal_task_submitter.h:74 — lease request normal_task_submitter.cc:295,
+direct push :542; lease reuse per SchedulingKey).
+
+Wire protocol (submitter <-> leased worker, framed-pickle rpc.py):
+    -> push "exec_direct"   {"spec": TaskSpec}
+    <- push "task_finished" {"task_id": bytes,
+                             "inline": [(oid_bytes, blob)], # small results
+                             "stored": [oid_bytes]}         # sealed in shm
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import TaskSpec
+from ray_tpu._private.config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+
+class _Lease:
+    __slots__ = (
+        "worker_id", "address", "client", "inflight", "started",
+        "idle_since", "key", "dead",
+    )
+
+    def __init__(self, worker_id: bytes, address: str, client: rpc.RpcClient, key):
+        self.worker_id = worker_id
+        self.address = address
+        self.client = client
+        self.inflight: Dict[bytes, TaskSpec] = {}  # task_id bytes -> spec
+        self.started: Dict[bytes, float] = {}  # task_id bytes -> dispatch time
+        self.idle_since = time.monotonic()
+        self.key = key
+        self.dead = False
+
+
+class _KeyState:
+    __slots__ = (
+        "key", "resources", "pending", "leases", "requests_inflight", "ewma_ms",
+    )
+
+    def __init__(self, key, resources):
+        self.key = key
+        self.resources = resources
+        self.pending: deque = deque()
+        self.leases: Dict[bytes, _Lease] = {}
+        self.requests_inflight = 0
+        # EWMA task duration for this key; None until the first completion.
+        # Long tasks want many workers, short tasks want few + pipelining.
+        self.ewma_ms: Optional[float] = None
+
+
+class DirectTaskSubmitter:
+    """One per Worker process; submits normal (non-actor) tasks directly."""
+
+    def __init__(self, worker):
+        import os
+
+        self._worker = worker
+        self._lock = threading.Lock()
+        self._keys: Dict[Tuple, _KeyState] = {}
+        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="lease-req")
+        self._closed = False
+        # More leased workers than cores just thrash the scheduler; spread
+        # work 1-per-worker up to this cap, then pipeline deeper instead.
+        self._lease_cap = max(
+            1, min(CONFIG.max_leases_per_scheduling_key, os.cpu_count() or 1)
+        )
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True, name="lease-reaper")
+        self._reaper.start()
+
+    # ------------------------------------------------------------------
+    def scheduling_key(self, spec: TaskSpec) -> Tuple:
+        return (tuple(sorted(spec.resources.items())), spec.job_id.binary())
+
+    def submit(self, spec: TaskSpec) -> None:
+        """Queue a spec; dispatches to an idle lease or requests one."""
+        with self._lock:
+            if self._closed:
+                raise rpc.ConnectionLost("submitter closed")
+            key = self.scheduling_key(spec)
+            ks = self._keys.get(key)
+            if ks is None:
+                ks = self._keys[key] = _KeyState(key, spec.resources)
+            ks.pending.append(spec)
+            self._assign_locked(ks)
+            self._maybe_request_leases_locked(ks)
+
+    # ------------------------------------------------------------------
+    def _dynamic_cap(self, ks: _KeyState) -> int:
+        """Lease cap for this key.  Short tasks: ~one lease per core and
+        pipeline (more workers than cores just thrash).  Long tasks (EWMA
+        above lease_grow_task_ms): grow to the configured max — the raylet's
+        resource accounting is the real bound."""
+        if ks.ewma_ms is not None and ks.ewma_ms > CONFIG.lease_grow_task_ms:
+            return CONFIG.max_leases_per_scheduling_key
+        return self._lease_cap
+
+    def _assign_locked(self, ks: _KeyState) -> None:
+        # While more leases can still be granted, keep one task per worker
+        # (parallelism first); once at the cap, pipeline deeper so workers
+        # never sit idle waiting on the submit round trip.  Until the first
+        # completion calibrates the key, stay at depth 1 so long tasks
+        # aren't queued behind each other on one worker.
+        live = sum(1 for l in ks.leases.values() if not l.dead)
+        saturated = live + ks.requests_inflight >= self._dynamic_cap(ks)
+        short_tasks = ks.ewma_ms is not None and ks.ewma_ms <= CONFIG.lease_grow_task_ms
+        depth = CONFIG.lease_pipeline_depth if (saturated and short_tasks) else 1
+        # Round-robin: give each lease one spec per pass for balance.
+        progress = True
+        while ks.pending and progress:
+            progress = False
+            for lease in ks.leases.values():
+                if lease.dead or len(lease.inflight) >= depth or not ks.pending:
+                    continue
+                spec = ks.pending.popleft()
+                tid = spec.task_id.binary()
+                lease.inflight[tid] = spec
+                # (dispatch time, queue position) — the position divides the
+                # observed latency so pipelined queue-wait doesn't read as
+                # long task execution.
+                lease.started[tid] = (time.monotonic(), len(lease.inflight))
+                try:
+                    lease.client.push("exec_direct", {"spec": spec})
+                    progress = True
+                except rpc.RpcError:
+                    # Connection died between checks; on_close requeues.
+                    lease.inflight.pop(tid, None)
+                    lease.started.pop(tid, None)
+                    ks.pending.appendleft(spec)
+
+    def _maybe_request_leases_locked(self, ks: _KeyState) -> None:
+        if self._closed or not ks.pending:
+            return
+        live = sum(1 for l in ks.leases.values() if not l.dead)
+        # One outstanding request per pending task, up to the cap — the
+        # raylet parks requests it can't grant yet, so over-requesting is
+        # cheap and under-requesting serializes the whole queue.
+        want = min(len(ks.pending), self._dynamic_cap(ks) - live - ks.requests_inflight)
+        for _ in range(max(0, want)):
+            ks.requests_inflight += 1
+            self._pool.submit(self._request_lease, ks)
+
+    def _request_lease(self, ks: _KeyState, raylet_client=None, hops: int = 0):
+        reply = None
+        try:
+            client = raylet_client or self._worker.raylet_client
+            reply = client.call(
+                "request_worker_lease",
+                {
+                    "resources": dict(ks.resources),
+                    "job_id": self._worker.job_id.binary(),
+                    "spilled": hops > 0,
+                },
+                timeout=CONFIG.worker_lease_timeout_ms / 1000,
+            )
+        except rpc.RpcError:
+            reply = None
+        if reply and reply.get("spill") and hops < 4:
+            try:
+                peer = self._worker._get_raylet_client(reply["spill"])
+                return self._request_lease(ks, raylet_client=peer, hops=hops + 1)
+            except rpc.RpcError:
+                reply = None
+        self._on_lease_reply(ks, reply)
+
+    def _on_lease_reply(self, ks: _KeyState, reply: Optional[dict]) -> None:
+        lease = None
+        if reply and reply.get("worker_id") and reply.get("address"):
+            try:
+                wid, address = reply["worker_id"], reply["address"]
+                client = rpc.RpcClient(
+                    address,
+                    on_push=lambda m, p: self._on_worker_push(wid, ks, m, p),
+                    on_close=lambda: self._on_lease_lost(wid, ks),
+                )
+                lease = _Lease(wid, address, client, ks.key)
+            except rpc.RpcError:
+                self._return_lease_to_raylet(reply["worker_id"])
+        surplus = None
+        with self._lock:
+            ks.requests_inflight = max(0, ks.requests_inflight - 1)
+            if lease is not None:
+                if self._closed or (not ks.pending and not ks.leases):
+                    # Granted after the queue drained: hand it back rather
+                    # than holding resources we have no work for.
+                    surplus = lease
+                else:
+                    ks.leases[lease.worker_id] = lease
+                    lease.idle_since = time.monotonic()
+                    self._assign_locked(ks)
+            elif ks.pending and not self._closed:
+                # Failed request while work remains: try again.
+                self._maybe_request_leases_locked(ks)
+        if surplus is not None:
+            try:
+                surplus.client.close()
+            except Exception:
+                pass
+            self._return_lease_to_raylet(surplus.worker_id)
+
+    # ------------------------------------------------------------------
+    def _on_worker_push(self, wid: bytes, ks: _KeyState, method: str, payload) -> None:
+        if method != "task_finished":
+            return
+        ms = self._worker.memory_store
+        for oid, blob in payload.get("inline", ()):
+            if ms.put(oid, blob):
+                self._worker.promote_blob(oid, blob)
+        ms.resolve_stored(payload.get("stored", ()))
+        with self._lock:
+            lease = ks.leases.get(wid)
+            if lease is None:
+                return
+            tid = payload["task_id"]
+            lease.inflight.pop(tid, None)
+            started = lease.started.pop(tid, None)
+            if started is not None:
+                t0, qpos = started
+                dt_ms = (time.monotonic() - t0) * 1000 / max(1, qpos)
+                ks.ewma_ms = dt_ms if ks.ewma_ms is None else 0.8 * ks.ewma_ms + 0.2 * dt_ms
+            self._assign_locked(ks)
+            self._maybe_request_leases_locked(ks)
+            if not lease.inflight:
+                lease.idle_since = time.monotonic()
+
+    def _on_lease_lost(self, wid: bytes, ks: _KeyState) -> None:
+        """The leased worker's connection dropped (worker crash or exit)."""
+        with self._lock:
+            lease = ks.leases.pop(wid, None)
+            if lease is None:
+                return
+            lease.dead = True
+            retry, failed = [], []
+            for spec in lease.inflight.values():
+                if spec.attempt_number < spec.max_retries:
+                    spec.attempt_number += 1
+                    retry.append(spec)
+                else:
+                    failed.append(spec)
+            lease.inflight.clear()
+            lease.started.clear()
+            for spec in retry:
+                ks.pending.appendleft(spec)
+            if ks.pending and not self._closed:
+                self._assign_locked(ks)
+                self._maybe_request_leases_locked(ks)
+        for spec in failed:
+            self._fail_spec(spec)
+
+    def _fail_spec(self, spec: TaskSpec) -> None:
+        from ray_tpu import exceptions
+
+        err = exceptions.WorkerCrashedError(
+            f"Task {spec.name} failed: the worker executing it died"
+        )
+        try:
+            self._worker._store_error_returns(spec, err)
+        finally:
+            self._worker.memory_store.resolve_stored(
+                [o.binary() for o in spec.return_ids()]
+            )
+
+    # ------------------------------------------------------------------
+    def _reap_loop(self) -> None:
+        while not self._closed:
+            time.sleep(0.1)
+            timeout = CONFIG.lease_idle_timeout_ms / 1000
+            now = time.monotonic()
+            to_return = []
+            with self._lock:
+                for ks in self._keys.values():
+                    for wid, lease in list(ks.leases.items()):
+                        if not lease.inflight and not ks.pending and now - lease.idle_since > timeout:
+                            ks.leases.pop(wid)
+                            lease.dead = True
+                            to_return.append(lease)
+                    # Kick requests for queues stranded by failed grants.
+                    if ks.pending and not ks.requests_inflight and not ks.leases:
+                        self._maybe_request_leases_locked(ks)
+                    # Growth for long tasks: an in-flight task stuck past
+                    # the threshold recalibrates the key so queued work
+                    # fans out to more workers instead of waiting in line.
+                    elif ks.pending:
+                        # A lease with a SINGLE in-flight task stuck past the
+                        # threshold means the task itself runs long (deep
+                        # pipelines are excluded — there, age is queue wait):
+                        # recalibrate so queued work fans out to more workers.
+                        threshold = CONFIG.lease_grow_task_ms / 1000
+                        oldest = min(
+                            (
+                                t0
+                                for l in ks.leases.values()
+                                if len(l.inflight) == 1
+                                for t0, _ in l.started.values()
+                            ),
+                            default=None,
+                        )
+                        if oldest is not None and now - oldest > max(0.05, threshold):
+                            age_ms = (now - oldest) * 1000
+                            if ks.ewma_ms is None or ks.ewma_ms < age_ms:
+                                ks.ewma_ms = age_ms
+                            self._maybe_request_leases_locked(ks)
+            for lease in to_return:
+                try:
+                    lease.client.close()
+                except Exception:
+                    pass
+                self._return_lease_to_raylet(lease.worker_id)
+
+    def _return_lease_to_raylet(self, worker_id: bytes) -> None:
+        try:
+            self._worker.raylet_client.push("return_worker_lease", {"worker_id": worker_id})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            leases = [l for ks in self._keys.values() for l in ks.leases.values()]
+            self._keys.clear()
+        for lease in leases:
+            try:
+                lease.client.close()
+            except Exception:
+                pass
+            self._return_lease_to_raylet(lease.worker_id)
+        self._pool.shutdown(wait=False)
+
+
+class ActorDirectChannel:
+    """Caller-side direct connection to one actor's worker process.
+
+    Method invocations are pushed in sequence_number order under a send
+    lock (socket FIFO then guarantees in-order delivery); the receiver
+    additionally buffers by sequence number, so ordering survives retries
+    and reconnects (reference: transport/actor_task_submitter.h:75 +
+    sequential_actor_submit_queue.h)."""
+
+    def __init__(self, worker, actor_id, address: str):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.address = address
+        self.inflight: Dict[bytes, TaskSpec] = {}
+        self.send_lock = threading.Lock()
+        self.closed = False
+        self.client = rpc.RpcClient(address, on_push=self._on_push, on_close=self._on_close)
+
+    def send(self, spec: TaskSpec) -> None:
+        with self.send_lock:
+            if self.closed:
+                raise rpc.ConnectionLost(f"channel to actor {self.actor_id.hex()[:8]} closed")
+            self.inflight[spec.task_id.binary()] = spec
+            try:
+                self.client.push("exec_direct", {"spec": spec})
+            except rpc.RpcError:
+                self.inflight.pop(spec.task_id.binary(), None)
+                raise
+
+    def _on_push(self, method: str, payload) -> None:
+        if method != "task_finished":
+            return
+        ms = self.worker.memory_store
+        for oid, blob in payload.get("inline", ()):
+            if ms.put(oid, blob):
+                self.worker.promote_blob(oid, blob)
+        ms.resolve_stored(payload.get("stored", ()))
+        self.inflight.pop(payload["task_id"], None)
+
+    def _on_close(self) -> None:
+        self.closed = True
+        try:
+            self.worker._on_actor_channel_closed(self)
+        except Exception:
+            logger.exception("actor channel close handler failed")
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.client.close()
+        except Exception:
+            pass

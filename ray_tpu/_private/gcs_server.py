@@ -506,6 +506,7 @@ class GcsServer:
             # worker death is reported separately.
             result = await client.call("create_actor", {"spec": spec}, timeout=None)
             info.pid = result.get("pid", 0)
+            info.worker_address = result.get("worker_address")
             info.state = "ALIVE"
             self.publish("actors", self._actor_dict(info))
             self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
@@ -545,6 +546,7 @@ class GcsServer:
             "num_restarts": info.num_restarts,
             "death_cause": info.death_cause,
             "pid": info.pid,
+            "worker_address": info.worker_address,
         }
 
     async def _on_actor_failure(self, info: ActorInfo, reason: str):

@@ -47,7 +47,18 @@ class ObjectRef:
         return f"ObjectRef({self.hex()})"
 
     def __reduce__(self):
-        # Crossing a process boundary always produces a borrowed ref.
+        # Crossing a process boundary always produces a borrowed ref.  The
+        # owner promotes any memory-store-only value to the shm store at
+        # this point so the borrower can fetch it (reference: memory store
+        # → plasma promotion on escape).
+        from ray_tpu._private.worker import global_worker_maybe
+
+        w = global_worker_maybe()
+        if w is not None and w.connected:
+            try:
+                w.on_ref_serialized(self._id)
+            except Exception:
+                pass
         return (_restore_ref, (self._id.binary(),))
 
     def __del__(self):

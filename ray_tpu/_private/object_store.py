@@ -580,6 +580,19 @@ class StoreClient:
         # Attach to the node's native arena if the raylet created one.
         self.arena = _try_native_arena(store_dir, 0, create=False)
 
+    def put_blob(self, object_id: ObjectID, blob: bytes) -> int:
+        """Store an already-flattened serialized blob."""
+        if len(blob) <= CONFIG.max_direct_call_object_size:
+            self._raylet.call("store_put_inline", (object_id.binary(), bytes(blob)))
+            return len(blob)
+        path = os.path.join(self.store_dir, object_id.hex())
+        tmp = path + ".w"
+        with open(tmp, "w+b") as f:
+            f.write(blob)
+        os.rename(tmp, path)
+        self._raylet.call("store_seal", (object_id.binary(), len(blob)))
+        return len(blob)
+
     def put_serialized(self, object_id: ObjectID, meta: bytes, buffers: List[memoryview]) -> int:
         from ray_tpu._private import serialization
 

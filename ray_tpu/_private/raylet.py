@@ -39,6 +39,7 @@ class WorkerHandle:
     __slots__ = (
         "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
         "running", "spawn_time", "idle_since", "resources_held", "bundle_key",
+        "direct_address", "lease_owner", "lease_blocked", "reserved",
     )
 
     def __init__(self, worker_id: WorkerID, proc, job_id: JobID):
@@ -47,7 +48,7 @@ class WorkerHandle:
         self.pid = proc.pid if proc else 0
         self.conn: Optional[rpc.ClientConn] = None
         self.job_id = job_id
-        self.state = "STARTING"  # STARTING | IDLE | BUSY | ACTOR | DEAD
+        self.state = "STARTING"  # STARTING | IDLE | BUSY | ACTOR | LEASED | DEAD
         self.actor_id: Optional[ActorID] = None
         self.running: Dict[bytes, TaskSpec] = {}  # task_id bytes -> spec
         self.spawn_time = time.monotonic()
@@ -56,6 +57,15 @@ class WorkerHandle:
         # Set for actors placed inside a placement-group bundle: resources
         # must be returned to the bundle, not the node pool.
         self.bundle_key: Optional[Tuple[bytes, int]] = None
+        # Direct RPC endpoint of the worker (submitters push tasks here).
+        self.direct_address: Optional[str] = None
+        # Connection of the submitter holding this worker's lease; leases
+        # are swept when the holder disconnects.
+        self.lease_owner = None
+        self.lease_blocked = False
+        # Claimed by an in-progress lease grant (worker still starting):
+        # keeps the dispatch loop and other grants off it.
+        self.reserved = False
 
 
 class Raylet:
@@ -114,6 +124,11 @@ class Raylet:
 
         # Objects being pulled: oid bytes -> future
         self.pulls: Dict[bytes, asyncio.Future] = {}
+
+        # Parked worker-lease requests: FIFO of (ResourceSet, future),
+        # granted as resources free up (reference: lease request queue in
+        # cluster_task_manager).
+        self.lease_waiters: deque = deque()
 
         # Metrics
         self.num_tasks_dispatched = 0
@@ -312,9 +327,10 @@ class Raylet:
                 pass
         self.num_starting = max(0, self.num_starting - 1)
         w.conn = conn
+        w.direct_address = payload.get("address")
         w.state = "IDLE"
         conn.meta["worker_id"] = worker_id
-        if w.actor_id is None:
+        if w.actor_id is None and not w.reserved:
             self.idle_workers[w.job_id].append(w)
         self._schedule_dispatch()
         return {"ok": True, "job_config": self.job_configs.get(w.job_id, {})}
@@ -339,6 +355,15 @@ class Raylet:
         w = self.workers.get(worker_id) if worker_id else None
         if w is None:
             return
+        if w.state == "LEASED":
+            # A leased worker blocked in ray.get: release the lease's
+            # resources so nested work can run (re-acquired on unblock).
+            if not w.lease_blocked and w.resources_held:
+                w.lease_blocked = True
+                self.resources_available.add(w.resources_held)
+                self._grant_lease_waiters()
+                self._schedule_dispatch()
+            return
         spec = w.running.get(payload["task_id"])
         if spec is not None and not spec.is_actor_task:
             self._release_task_resources(spec)
@@ -349,6 +374,12 @@ class Raylet:
         worker_id = conn.meta.get("worker_id")
         w = self.workers.get(worker_id) if worker_id else None
         if w is None:
+            return
+        if w.state == "LEASED":
+            if w.lease_blocked:
+                w.lease_blocked = False
+                # May transiently oversubscribe, like the reference.
+                self.resources_available.subtract(w.resources_held)
             return
         spec = w.running.get(payload["task_id"])
         if spec is not None and not spec.is_actor_task:
@@ -368,6 +399,12 @@ class Raylet:
             w = self.workers.get(worker_id)
             if w is not None and w.state != "DEAD":
                 await self._on_worker_death(w)
+        # Sweep leases held by a vanished submitter (driver or worker).
+        for w in list(self.workers.values()):
+            if w.state == "LEASED" and w.lease_owner is conn:
+                await self.push_return_worker_lease(
+                    {"worker_id": w.worker_id.binary()}, conn
+                )
 
     async def _on_worker_death(self, w: WorkerHandle):
         w.state = "DEAD"
@@ -525,6 +562,12 @@ class Raylet:
         self.resources_available.add(res)
 
     def _release_resources(self, w: WorkerHandle):
+        if w.lease_blocked:
+            # The lease's resources were already returned to the pool when
+            # the worker reported blocked — don't double-release.
+            w.resources_held = ResourceSet()
+            w.lease_blocked = False
+            return
         if not w.resources_held:
             return
         if w.bundle_key is not None:
@@ -540,6 +583,7 @@ class Raylet:
         self._dispatch_scheduled = False
         if self._stopping:
             return
+        self._grant_lease_waiters()
         remaining = deque()
         while self.queue:
             spec = self.queue.popleft()
@@ -605,6 +649,138 @@ class Raylet:
         return True
 
     # ------------------------------------------------------------------
+    # worker leases — direct task submission (reference:
+    # normal_task_submitter.cc:295 RequestNewWorkerIfNeeded → raylet
+    # HandleRequestWorkerLease; the submitter then pushes task specs
+    # straight to the leased worker)
+    # ------------------------------------------------------------------
+    async def rpc_request_worker_lease(self, payload, conn):
+        res = ResourceSet.of(payload["resources"])
+        job_id = JobID(payload["job_id"])
+        allow_spill = not payload.get("spilled", False)
+        if not res.fits_in(self.resources_total):
+            target = self._spill_target(res) if allow_spill else None
+            return {"spill": target} if target else None
+        # FIFO fairness: an incoming request may not jump ahead of parked
+        # waiters even if it happens to fit right now — a stream of small
+        # requests would starve a parked large one forever otherwise.
+        if self.lease_waiters or not res.fits_in(self.resources_available):
+            if allow_spill and not res.fits_in(self.resources_available):
+                target = self._spill_target(res)
+                if target is not None:
+                    return {"spill": target}
+            # Park until resources free up (event-driven, FIFO).
+            fut = self.loop.create_future()
+            self.lease_waiters.append((res, fut))
+            self._grant_lease_waiters()  # may grant immediately (empty queue ahead)
+            try:
+                await asyncio.wait_for(
+                    fut, max(1.0, CONFIG.worker_lease_timeout_ms / 1000 - 2)
+                )
+            except asyncio.TimeoutError:
+                # wait_for cancelled the future, so it can never have been
+                # granted (a granted future makes wait_for return instead):
+                # no resources were debited for it; just drop the entry.
+                try:
+                    self.lease_waiters.remove((res, fut))
+                except ValueError:
+                    pass  # already swept by _grant_lease_waiters' done-check
+                return None
+        else:
+            self.resources_available.subtract(res)
+        # Resources acquired; find or spawn a worker with a direct endpoint.
+        w = self._pop_idle_worker_for_lease(job_id)
+        if w is None:
+            w = self._spawn_worker(job_id)
+        w.reserved = True  # keep dispatch + concurrent grants off it
+        try:
+            ok = await self._wait_worker_ready(w)
+        finally:
+            w.reserved = False
+        if not ok or conn.closed:
+            if ok:  # requester vanished: put the worker back
+                w.state = "IDLE"
+                w.idle_since = time.monotonic()
+                self.idle_workers[w.job_id].append(w)
+            self.resources_available.add(res)
+            self._grant_lease_waiters()
+            self._schedule_dispatch()
+            return None
+        w.state = "LEASED"
+        w.resources_held = res.copy()
+        w.lease_owner = conn
+        w.lease_blocked = False
+        return {"worker_id": w.worker_id.binary(), "address": w.direct_address}
+
+    def _spill_target(self, res: ResourceSet) -> Optional[str]:
+        best, best_avail = None, -1.0
+        for nb, view in self.cluster_view.items():
+            avail = view.get("available", {})
+            if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                score = sum(avail.values())
+                if score > best_avail:
+                    best_avail = score
+                    best = view["raylet_address"]
+        return best
+
+    def _pop_idle_worker_for_lease(self, job_id: JobID) -> Optional["WorkerHandle"]:
+        dq = self.idle_workers.get(job_id)
+        while dq:
+            w = dq.popleft()
+            if (
+                w.state == "IDLE"
+                and w.conn is not None
+                and not w.conn.closed
+                and w.direct_address
+            ):
+                return w
+        return None
+
+    async def _wait_worker_ready(self, w: "WorkerHandle") -> bool:
+        deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
+        while w.conn is None or w.direct_address is None:
+            if w.state == "DEAD" or time.monotonic() > deadline or (
+                w.proc is not None and w.proc.poll() is not None
+            ):
+                self._kill_worker_proc(w)
+                return False
+            await asyncio.sleep(0.005)
+        # The pool may have routed the freshly-registered worker to the
+        # idle queue; claim it.
+        for dq in self.idle_workers.values():
+            if w in dq:
+                dq.remove(w)
+        return True
+
+    def _grant_lease_waiters(self):
+        while self.lease_waiters:
+            res, fut = self.lease_waiters[0]
+            if fut.done():
+                self.lease_waiters.popleft()
+                continue
+            if not res.fits_in(self.resources_available):
+                break  # FIFO: no queue-jumping
+            self.lease_waiters.popleft()
+            self.resources_available.subtract(res)
+            fut.set_result(True)
+
+    async def push_return_worker_lease(self, payload, conn):
+        w = self.workers.get(WorkerID(payload["worker_id"]))
+        if w is None or w.state != "LEASED":
+            return
+        w.lease_owner = None
+        if not w.lease_blocked:
+            self._release_resources(w)
+        else:
+            w.resources_held = ResourceSet()
+            w.lease_blocked = False
+        w.state = "IDLE"
+        w.idle_since = time.monotonic()
+        self.idle_workers[w.job_id].append(w)
+        self._grant_lease_waiters()
+        self._schedule_dispatch()
+
+    # ------------------------------------------------------------------
     # actors
     # ------------------------------------------------------------------
     async def rpc_create_actor(self, payload, conn):
@@ -645,7 +821,7 @@ class Raylet:
             data = self.store.read_bytes(ret)
             if data is not None and data[0] == serialization.TAG_ERROR:
                 raise RuntimeError("actor __init__ raised; see creation task return")
-        return {"pid": w.pid}
+        return {"pid": w.pid, "worker_address": w.direct_address}
 
     def _submit_actor_task(self, spec: TaskSpec):
         w = self.actor_workers.get(spec.actor_id)
@@ -733,6 +909,11 @@ class Raylet:
     async def rpc_store_put_inline(self, payload, conn):
         oid_bytes, data = payload
         return self.store.put_inline(ObjectID(oid_bytes), data)
+
+    async def push_store_put_inline(self, payload, conn):
+        """Fire-and-forget variant used by memory-store → shm promotion."""
+        oid_bytes, data = payload
+        self.store.put_inline(ObjectID(oid_bytes), data)
 
     async def rpc_store_seal(self, payload, conn):
         oid_bytes, size = payload

@@ -92,6 +92,10 @@ def test_class_trainable_with_stop_and_checkpoint(ray_cluster, tmp_path):
 def test_asha_stops_bad_trials(ray_cluster, tmp_path):
     def slow_quad(config):
         for i in range(16):
+            # Keep the population running concurrently: with instant steps a
+            # trial can reach max_t before later trials hit their first rung,
+            # and async ASHA's first-arrival-survives rule then cuts nothing.
+            time.sleep(0.05)
             tune.report({"score": -((config["x"] - 3.0) ** 2) + 0.05 * i})
 
     scheduler = tune.ASHAScheduler(max_t=16, grace_period=2, reduction_factor=2)
