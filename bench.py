@@ -9,67 +9,122 @@ TFLOPs bf16).  A v5e chip (197 TFLOPs bf16) at the same MFU would be
 ~0.63 of that; vs_baseline > 0.63 therefore means better MFU than the
 reference stack.
 
-Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Two measurements, each in its own subprocess so exactly one process owns
+the chip at a time:
+  raw       — the jitted train step driven directly (no framework).
+  framework — the SAME step inside JaxTrainer.fit() (1-worker group on
+              the chip), proving the runtime adds <~3% overhead
+              (VERDICT r2 ask #3; reference: train/base_trainer.py fit).
+
+Prints exactly one JSON line; `value` is the in-framework number (the
+honest "what a user gets" figure), with the raw number and overhead
+attached.  See PERF_ANALYSIS.md for the shape-limited roofline study.
 """
 
 from __future__ import annotations
 
 import json
-import time
+import os
+import subprocess
+import sys
 
 GPU_BASELINE_TOKENS_PER_SEC = 51000.0
 
+# Shared measurement body: build the sharded GPT-2 train state, warm up,
+# time `steps` steps.  Defines tok_s_chip + on_tpu.  Used verbatim by both
+# the raw and the in-framework runs so the overhead comparison compares
+# exactly the same work.
+_MEASURE_BODY = """
+import time
+import jax
+try:
+    jax.devices()
+except RuntimeError:
+    jax.config.update("jax_platforms", "")
+import jax.numpy as jnp
+import numpy as np
+from ray_tpu.models import gpt2
+from ray_tpu.parallel import create_mesh
+
+on_tpu = jax.default_backend() == "tpu"
+n_dev = len(jax.devices())
+if on_tpu:
+    cfg = gpt2.GPT2Config(max_seq_len=1024, remat=False)  # fits HBM at 124M/B16/T1024
+    B, T, steps = 16, 1024, 30
+else:
+    cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
+    B, T, steps = 4, 64, 3
+
+mesh = create_mesh({"dp": n_dev}, jax.devices())
+opt = gpt2.make_adamw(lr=3e-4)
+params, opt_state, specs = gpt2.make_sharded_train_state(cfg, mesh, opt)
+step = gpt2.make_sharded_train_step(cfg, mesh, opt)
+rng = np.random.default_rng(0)
+toks = rng.integers(0, cfg.vocab_size, (B, T + 1), dtype=np.int32)
+tokens, targets = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+for _ in range(3):
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+float(jax.device_get(loss))  # sync: block_until_ready is unreliable on tunneled backends
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+float(jax.device_get(loss))
+dt = time.perf_counter() - t0
+tok_s_chip = B * T * steps / dt / n_dev
+"""
+
+_RAW_SNIPPET = f"""
+import json
+{_MEASURE_BODY}
+print("BENCH_RESULT " + json.dumps({{"tok_s_chip": tok_s_chip, "on_tpu": on_tpu}}))
+"""
+
+_FRAMEWORK_SNIPPET = f"""
+import json
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import JaxTrainer, ScalingConfig
+
+_BODY = {_MEASURE_BODY!r}
+
+def train_loop(config):
+    ns = {{}}
+    exec(_BODY, ns)
+    train.report({{"tok_s_chip": ns["tok_s_chip"], "on_tpu": ns["on_tpu"]}})
+
+ray_tpu.init(num_cpus=4)
+result = JaxTrainer(
+    train_loop, scaling_config=ScalingConfig(num_workers=1)
+).fit()
+print("BENCH_RESULT " + json.dumps({{
+    "tok_s_chip": result.metrics["tok_s_chip"], "on_tpu": result.metrics["on_tpu"],
+}}))
+ray_tpu.shutdown()
+"""
+
+
+def _run(snippet: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-c", snippet],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=1200,
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_RESULT "):
+            return json.loads(line[len("BENCH_RESULT "):])
+    raise RuntimeError(
+        f"bench subprocess produced no result (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+
 
 def main():
-    import jax
-
-    try:
-        jax.devices()
-    except RuntimeError:
-        # Env names a backend whose plugin isn't registered (e.g. a
-        # stripped PYTHONPATH): let jax pick whatever is available.
-        jax.config.update("jax_platforms", "")
-    import jax.numpy as jnp
-    import numpy as np
-
-    from ray_tpu.models import gpt2
-    from ray_tpu.parallel import create_mesh
-
-    on_tpu = jax.default_backend() == "tpu"
-    n_dev = len(jax.devices())
-    if on_tpu:
-        cfg = gpt2.GPT2Config(max_seq_len=1024)  # GPT-2 small, 124M, bf16
-        B, T, steps = 16, 1024, 10
-    else:  # CI fallback: tiny model so the line still prints quickly
-        cfg = gpt2.GPT2Config.tiny(dtype=jnp.float32)
-        B, T, steps = 4, 64, 3
-
-    mesh = create_mesh({"dp": n_dev}, jax.devices())
-    opt = gpt2.make_adamw(lr=3e-4)
-    params, opt_state, specs = gpt2.make_sharded_train_state(cfg, mesh, opt)
-    step = gpt2.make_sharded_train_step(cfg, mesh, opt)
-
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, cfg.vocab_size, (B, T + 1), dtype=np.int32)
-    tokens, targets = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
-
-    # Warmup / compile.  Sync via device_get: block_until_ready is not a
-    # reliable barrier on tunneled backends.
-    for _ in range(3):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    float(jax.device_get(loss))
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    # The final loss depends on the whole step chain, so fetching it
-    # synchronizes every timed step.
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = B * T * steps / dt
-    per_chip = tokens_per_sec / n_dev
+    fw = _run(_FRAMEWORK_SNIPPET)
+    raw = _run(_RAW_SNIPPET)
+    overhead = 1.0 - fw["tok_s_chip"] / raw["tok_s_chip"] if raw["tok_s_chip"] else 0.0
+    per_chip = fw["tok_s_chip"]
     print(
         json.dumps(
             {
@@ -77,6 +132,9 @@ def main():
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(per_chip / GPU_BASELINE_TOKENS_PER_SEC, 4),
+                "raw_tokens_per_sec_per_chip": round(raw["tok_s_chip"], 1),
+                "framework_overhead_pct": round(100 * overhead, 2),
+                "on_tpu": fw["on_tpu"],
             }
         )
     )

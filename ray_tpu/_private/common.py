@@ -107,8 +107,11 @@ class TaskSpec:
     is_actor_creation: bool = False
     is_actor_task: bool = False
     actor_id: Optional[ActorID] = None
-    # Ordering for actor tasks (per caller,handle)
+    # Ordering for actor tasks: sequence numbers start at 1 per
+    # (caller, actor incarnation); the receiver admits contiguously from 1.
+    # Callers reset + renumber queued specs when the actor restarts.
     sequence_number: int = 0
+    actor_incarnation: int = 0
     max_retries: int = 0
     retry_exceptions: bool = False
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)

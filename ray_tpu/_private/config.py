@@ -85,7 +85,17 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "task_events_buffer_size": 10_000,
     "metrics_report_interval_ms": 5_000,
     # --- gcs ---
-    "gcs_storage": "memory",  # or "file"
+    # "file": periodically snapshot GCS state (actors/PGs/KV/jobs) to the
+    # session dir so a restarted GCS resumes the cluster (reference: redis
+    # persistence, redis_store_client.h:106).  "memory": no persistence.
+    "gcs_storage": "file",
+    "gcs_snapshot_interval_ms": 500,
+    # How long raylets/drivers/workers retry reconnecting to a down GCS
+    # before declaring it fatal (reference: gcs_rpc_server_reconnect_timeout_s).
+    "gcs_reconnect_timeout_s": 60,
+    # Jobs restored from a snapshot whose driver doesn't reattach within
+    # this window are cleaned up.
+    "gcs_job_reattach_grace_s": 60,
     "maximum_gcs_dead_node_cache": 100,
     # --- collectives ---
     "collective_chunk_bytes": 16 * 1024**2,

@@ -34,10 +34,10 @@ logger = logging.getLogger(__name__)
 class _Lease:
     __slots__ = (
         "worker_id", "address", "client", "inflight", "started",
-        "idle_since", "key", "dead",
+        "idle_since", "key", "dead", "raylet",
     )
 
-    def __init__(self, worker_id: bytes, address: str, client: rpc.RpcClient, key):
+    def __init__(self, worker_id: bytes, address: str, client: rpc.RpcClient, key, raylet):
         self.worker_id = worker_id
         self.address = address
         self.client = client
@@ -46,6 +46,9 @@ class _Lease:
         self.idle_since = time.monotonic()
         self.key = key
         self.dead = False
+        # The raylet client that granted this lease — returns must go back
+        # to it (a spilled lease belongs to the REMOTE node's raylet).
+        self.raylet = raylet
 
 
 class _KeyState:
@@ -157,8 +160,8 @@ class DirectTaskSubmitter:
 
     def _request_lease(self, ks: _KeyState, raylet_client=None, hops: int = 0):
         reply = None
+        client = raylet_client or self._worker.raylet_client
         try:
-            client = raylet_client or self._worker.raylet_client
             reply = client.call(
                 "request_worker_lease",
                 {
@@ -168,7 +171,11 @@ class DirectTaskSubmitter:
                 },
                 timeout=CONFIG.worker_lease_timeout_ms / 1000,
             )
-        except rpc.RpcError:
+        except Exception:
+            # Raylet-side errors cross the wire as their original type
+            # (e.g. OSError from a failed worker spawn) — any failure here
+            # must still decrement requests_inflight via _on_lease_reply
+            # or the scheduling key wedges permanently.
             reply = None
         if reply and reply.get("spill") and hops < 4:
             try:
@@ -176,9 +183,9 @@ class DirectTaskSubmitter:
                 return self._request_lease(ks, raylet_client=peer, hops=hops + 1)
             except rpc.RpcError:
                 reply = None
-        self._on_lease_reply(ks, reply)
+        self._on_lease_reply(ks, reply, client)
 
-    def _on_lease_reply(self, ks: _KeyState, reply: Optional[dict]) -> None:
+    def _on_lease_reply(self, ks: _KeyState, reply: Optional[dict], raylet_client) -> None:
         lease = None
         if reply and reply.get("worker_id") and reply.get("address"):
             try:
@@ -188,9 +195,9 @@ class DirectTaskSubmitter:
                     on_push=lambda m, p: self._on_worker_push(wid, ks, m, p),
                     on_close=lambda: self._on_lease_lost(wid, ks),
                 )
-                lease = _Lease(wid, address, client, ks.key)
+                lease = _Lease(wid, address, client, ks.key, raylet_client)
             except rpc.RpcError:
-                self._return_lease_to_raylet(reply["worker_id"])
+                self._return_lease_to_raylet(reply["worker_id"], raylet_client)
         surplus = None
         with self._lock:
             ks.requests_inflight = max(0, ks.requests_inflight - 1)
@@ -203,15 +210,16 @@ class DirectTaskSubmitter:
                     ks.leases[lease.worker_id] = lease
                     lease.idle_since = time.monotonic()
                     self._assign_locked(ks)
-            elif ks.pending and not self._closed:
-                # Failed request while work remains: try again.
-                self._maybe_request_leases_locked(ks)
+            # On failure with work remaining, do NOT re-request inline —
+            # an unsatisfiable shape (too big for every node) would turn
+            # that into a hot submitter<->raylet RPC loop.  The reaper
+            # re-kicks stranded queues on its 100 ms tick instead.
         if surplus is not None:
             try:
                 surplus.client.close()
             except Exception:
                 pass
-            self._return_lease_to_raylet(surplus.worker_id)
+            self._return_lease_to_raylet(surplus.worker_id, surplus.raylet)
 
     # ------------------------------------------------------------------
     def _on_worker_push(self, wid: bytes, ks: _KeyState, method: str, payload) -> None:
@@ -247,7 +255,7 @@ class DirectTaskSubmitter:
             lease.dead = True
             retry, failed = [], []
             for spec in lease.inflight.values():
-                if spec.attempt_number < spec.max_retries:
+                if spec.max_retries < 0 or spec.attempt_number < spec.max_retries:
                     spec.attempt_number += 1
                     retry.append(spec)
                 else:
@@ -320,11 +328,13 @@ class DirectTaskSubmitter:
                     lease.client.close()
                 except Exception:
                     pass
-                self._return_lease_to_raylet(lease.worker_id)
+                self._return_lease_to_raylet(lease.worker_id, lease.raylet)
 
-    def _return_lease_to_raylet(self, worker_id: bytes) -> None:
+    def _return_lease_to_raylet(self, worker_id: bytes, raylet_client=None) -> None:
         try:
-            self._worker.raylet_client.push("return_worker_lease", {"worker_id": worker_id})
+            (raylet_client or self._worker.raylet_client).push(
+                "return_worker_lease", {"worker_id": worker_id}
+            )
         except Exception:
             pass
 
@@ -339,7 +349,7 @@ class DirectTaskSubmitter:
                 lease.client.close()
             except Exception:
                 pass
-            self._return_lease_to_raylet(lease.worker_id)
+            self._return_lease_to_raylet(lease.worker_id, lease.raylet)
         self._pool.shutdown(wait=False)
 
 

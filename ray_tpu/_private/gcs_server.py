@@ -10,8 +10,12 @@ object directory (the reference uses owner-based location tracking;
 here the GCS tracks locations reported by raylets on seal/evict), and
 job management.
 
-One asyncio process.  All state in memory; an optional file-backed
-snapshot provides GCS restart tolerance (reference: redis persistence).
+One asyncio process.  All state in memory; with gcs_storage="file" (the
+default) a periodic-on-mutation snapshot of the durable tables (actors,
+placement groups, KV, jobs) is written to the session dir, and a
+restarted GCS reloads it — raylets, drivers and workers reconnect with
+backoff and resync (reference: redis persistence,
+gcs/store_client/redis_store_client.h:106, gcs_redis_failure_detector.cc).
 """
 
 from __future__ import annotations
@@ -88,14 +92,115 @@ class GcsServer:
         self._bg_tasks: List[asyncio.Task] = []
         self.start_time = time.time()
 
+        # --- persistence (reference: redis_store_client.h:106) ---
+        self._snapshot_dirty = False
+        # Jobs restored from a snapshot wait for their driver to reattach;
+        # job_id -> deadline for cleanup.
+        self._job_reattach_deadline: Dict[JobID, float] = {}
+        # Restored ALIVE actors wait for their node to re-register; actors
+        # whose node never returns are failed (restart elsewhere or DEAD).
+        self._actor_node_deadline: Dict[ActorID, float] = {}
+
     async def start(self):
+        if CONFIG.gcs_storage == "file":
+            self._load_snapshot()
         await self.server.start()
         self._bg_tasks.append(self.loop.create_task(self._health_loop()))
+        if CONFIG.gcs_storage == "file":
+            self._bg_tasks.append(self.loop.create_task(self._snapshot_loop()))
         logger.info("GCS listening on %s", self.address)
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+    def _snapshot_path(self) -> Optional[str]:
+        import os
+
+        sd = self.session_info.get("session_dir")
+        return os.path.join(sd, "gcs_snapshot.pkl") if sd else None
+
+    def _dirty(self):
+        self._snapshot_dirty = True
+
+    async def _snapshot_loop(self):
+        interval = CONFIG.gcs_snapshot_interval_ms / 1000
+        while True:
+            await asyncio.sleep(interval)
+            if self._snapshot_dirty:
+                self._snapshot_dirty = False
+                try:
+                    self._write_snapshot()
+                except Exception:
+                    logger.exception("GCS snapshot write failed")
+
+    def _write_snapshot(self):
+        import os
+        import pickle
+
+        path = self._snapshot_path()
+        if path is None:
+            return
+        state = {
+            "actors": self.actors,
+            "named_actors": self.named_actors,
+            "placement_groups": self.placement_groups,
+            "named_pgs": self.named_pgs,
+            "kv": dict(self.kv),
+            "jobs": self.jobs,
+            "next_job_int": self.next_job_int,
+        }
+        tmp = path + ".w"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=5)
+        os.replace(tmp, path)
+
+    def _load_snapshot(self):
+        import os
+        import pickle
+
+        path = self._snapshot_path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:
+            logger.exception("GCS snapshot load failed; starting fresh")
+            return
+        self.actors = state.get("actors", {})
+        self.named_actors = state.get("named_actors", {})
+        self.placement_groups = state.get("placement_groups", {})
+        self.named_pgs = state.get("named_pgs", {})
+        self.kv = defaultdict(dict, state.get("kv", {}))
+        self.jobs = state.get("jobs", {})
+        self.next_job_int = state.get("next_job_int", 1)
+        grace = time.monotonic() + CONFIG.gcs_job_reattach_grace_s
+        for job_id in self.jobs:
+            self._job_reattach_deadline[job_id] = grace
+        # Actors caught mid-scheduling are re-queued; they dispatch once
+        # their nodes re-register.  ALIVE actors wait bounded time for
+        # their node to come back — nodes aren't persisted, so without a
+        # deadline an actor on a node that died with the GCS would stay
+        # "ALIVE" forever and its callers would hang.
+        node_grace = time.monotonic() + CONFIG.health_check_timeout_ms / 1000 + 10
+        for actor_id, info in self.actors.items():
+            if info.state in ("PENDING_CREATION", "RESTARTING"):
+                self.pending_actors.append(actor_id)
+            elif info.state == "ALIVE":
+                self._actor_node_deadline[actor_id] = node_grace
+        logger.info(
+            "GCS restored snapshot: %d actors, %d pgs, %d jobs",
+            len(self.actors), len(self.placement_groups), len(self.jobs),
+        )
 
     async def stop(self):
         for t in self._bg_tasks:
             t.cancel()
+        if CONFIG.gcs_storage == "file" and self._snapshot_dirty:
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass
         await self.server.stop()
         for c in self.node_clients.values():
             c.close()
@@ -104,6 +209,10 @@ class GcsServer:
     # pubsub
     # ------------------------------------------------------------------
     def publish(self, channel: str, message: Any):
+        # Every actor/PG state transition is published; piggyback snapshot
+        # dirtying here so persistence can't drift from visible state.
+        if channel == "actors" or channel.startswith("actor:") or channel == "placement_groups":
+            self._dirty()
         dead = []
         for conn in self.subs.get(channel, ()):
             if conn.closed:
@@ -170,6 +279,20 @@ class GcsServer:
         self.node_clients[info.node_id] = client
         self.publish("nodes", ("ALIVE", self._node_dict(info)))
         logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
+        # Reconciliation for re-registration after a GCS restart: the
+        # raylet reports which actors it still hosts and which objects it
+        # holds; actors this GCS believes live on that node but the raylet
+        # no longer hosts have died during the outage.
+        live_actors = {bytes(a) for a in payload.get("live_actors", ())}
+        for actor in list(self.actors.values()):
+            if actor.node_id != info.node_id or actor.state != "ALIVE":
+                continue
+            self._actor_node_deadline.pop(actor.actor_id, None)
+            if actor.actor_id.binary() not in live_actors:
+                await self._on_actor_failure(actor, "actor lost during GCS outage")
+        for oid in payload.get("sealed_objects", ()):
+            self.object_locations[bytes(oid)].add(info.node_id)
+            self.sealed_ever.add(bytes(oid))
         # Re-schedule anything that was waiting for resources.
         self._kick_pending()
         return {"session_info": self.session_info}
@@ -206,6 +329,20 @@ class GcsServer:
                 conn = self.node_conns.get(node_id)
                 if (conn is None or conn.closed) and now - self.last_heartbeat.get(node_id, now) > threshold:
                     await self._mark_node_dead(node_id, "health check: heartbeat timeout")
+            # Jobs restored from a snapshot whose driver never reattached.
+            for job_id, deadline in list(self._job_reattach_deadline.items()):
+                if now > deadline:
+                    self._job_reattach_deadline.pop(job_id, None)
+                    await self._on_driver_exit(job_id)
+            # Restored ALIVE actors whose node never re-registered.
+            for actor_id, deadline in list(self._actor_node_deadline.items()):
+                if now > deadline:
+                    self._actor_node_deadline.pop(actor_id, None)
+                    actor = self.actors.get(actor_id)
+                    if actor is not None and actor.state == "ALIVE":
+                        await self._on_actor_failure(
+                            actor, "actor's node never returned after GCS restart"
+                        )
 
     async def _on_disconnect(self, conn):
         node_id = conn.meta.get("node_id")
@@ -258,12 +395,25 @@ class GcsServer:
         }
         conn.meta["job_id"] = job_id
         self.driver_conns[job_id] = conn
+        self._dirty()
         self.publish("jobs", ("RUNNING", job_id.binary()))
         return {
             "job_id": job_id.binary(),
             "namespace": self.jobs[job_id]["namespace"],
             "session_info": self.session_info,
         }
+
+    async def rpc_reattach_driver(self, payload, conn):
+        """A driver's reconnecting GCS client re-binds its job after a GCS
+        restart so disconnect-driven job cleanup keeps working."""
+        job_id = JobID(payload["job_id"])
+        job = self.jobs.get(job_id)
+        if job is None or job["state"] == "FINISHED":
+            return False
+        conn.meta["job_id"] = job_id
+        self.driver_conns[job_id] = conn
+        self._job_reattach_deadline.pop(job_id, None)
+        return True
 
     async def _on_driver_exit(self, job_id: JobID):
         job = self.jobs.get(job_id)
@@ -272,6 +422,8 @@ class GcsServer:
         job["state"] = "FINISHED"
         job["end_time"] = time.time()
         self.driver_conns.pop(job_id, None)
+        self._job_reattach_deadline.pop(job_id, None)
+        self._dirty()
         self.publish("jobs", ("FINISHED", job_id.binary()))
         # Kill this job's non-detached actors.
         for actor in list(self.actors.values()):
@@ -318,6 +470,7 @@ class GcsServer:
         if not overwrite and key in table:
             return False
         table[key] = value
+        self._dirty()
         return True
 
     async def rpc_kv_get(self, payload, conn):
@@ -331,6 +484,7 @@ class GcsServer:
 
     async def rpc_kv_del(self, payload, conn):
         ns, key = payload
+        self._dirty()
         return self.kv.get(ns, {}).pop(key, None) is not None
 
     async def rpc_kv_keys(self, payload, conn):
@@ -547,6 +701,9 @@ class GcsServer:
             "death_cause": info.death_cause,
             "pid": info.pid,
             "worker_address": info.worker_address,
+            "max_task_retries": (
+                info.creation_spec.max_task_retries if info.creation_spec else 0
+            ),
         }
 
     async def _on_actor_failure(self, info: ActorInfo, reason: str):

@@ -25,6 +25,10 @@ class MemoryStore:
         # Pending oids whose value must be copied to the shm store on
         # arrival (their ref escaped while the task was in flight).
         self._promote: set = set()
+        # Pending oids whose owner ref died: the arriving blob is promoted
+        # (if flagged) but NOT retained — retaining results nobody can get
+        # leaks owner memory on fire-and-forget workloads.
+        self._drop: set = set()
         self._cond = threading.Condition()
 
     # -- owner bookkeeping -------------------------------------------------
@@ -34,12 +38,18 @@ class MemoryStore:
 
     def put(self, oid: bytes, blob: bytes) -> bool:
         """Returns True if the caller must promote the blob to the shm
-        store (a consumer was promised it there while it was in flight)."""
+        store (a consumer was promised it there while it was in flight).
+        Results whose ref already died arrive, get promoted if promised,
+        and are not retained."""
         with self._cond:
-            self._data[oid] = blob
+            was_pending = oid in self._pending
             self._pending.discard(oid)
             needs_promote = oid in self._promote
             self._promote.discard(oid)
+            dropped = oid in self._drop or not was_pending
+            self._drop.discard(oid)
+            if not dropped:
+                self._data[oid] = blob
             self._cond.notify_all()
         return needs_promote
 
@@ -67,12 +77,16 @@ class MemoryStore:
             self._data.pop(oid, None)
             self._pending.discard(oid)
             self._promote.discard(oid)
+            self._drop.discard(oid)
 
     def free_if_settled(self, oid: bytes) -> None:
-        """Drop the blob only if the result already arrived (pending
-        in-flight state must survive so arrival still runs promotion)."""
+        """Drop the blob if the result already arrived; an in-flight one
+        keeps its pending/promote state so arrival still runs promotion,
+        but the arriving blob itself is not retained (no refs remain)."""
         with self._cond:
-            if oid not in self._pending:
+            if oid in self._pending:
+                self._drop.add(oid)
+            else:
                 self._data.pop(oid, None)
 
     # -- read side ---------------------------------------------------------

@@ -142,27 +142,58 @@ class Raylet:
     # ------------------------------------------------------------------
     async def start(self):
         await self.server.start()
-        self.gcs = rpc.AsyncRpcClient(self.gcs_address)
-        self.gcs.on_push = self._on_gcs_push
-        self.gcs.on_close = lambda: self.on_fatal() if self.on_fatal else None
-        await self.gcs.connect()
-        await self.gcs.call(
-            "register_node",
-            {
-                "node_id": self.node_id.binary(),
-                "raylet_address": self.address,
-                "object_store_dir": self.store.store_dir,
-                "resources_total": dict(self.resources_total),
-                "labels": self.labels,
-                "is_head": self.is_head,
-                "hostname": os.uname().nodename,
-            },
-        )
-        await self.gcs.call("subscribe", "resources")
-        await self.gcs.call("subscribe", "nodes")
+        await self._connect_gcs(first=True)
         self._bg.append(self.loop.create_task(self._report_loop()))
         self._bg.append(self.loop.create_task(self._idle_reaper_loop()))
         logger.info("raylet %s listening on %s", self.node_id.hex()[:8], self.address)
+
+    def _register_payload(self) -> dict:
+        return {
+            "node_id": self.node_id.binary(),
+            "raylet_address": self.address,
+            "object_store_dir": self.store.store_dir,
+            "resources_total": dict(self.resources_total),
+            "labels": self.labels,
+            "is_head": self.is_head,
+            "hostname": os.uname().nodename,
+            # Resync state for (re-)registration after a GCS restart.
+            "live_actors": [a.binary() for a in self.actor_workers],
+            "sealed_objects": [o.binary() for o in self.store.objects],
+        }
+
+    async def _connect_gcs(self, first: bool = False):
+        client = rpc.AsyncRpcClient(self.gcs_address)
+        client.on_push = self._on_gcs_push
+        client.on_close = self._on_gcs_lost
+        await client.connect()
+        await client.call("register_node", self._register_payload())
+        await client.call("subscribe", "resources")
+        await client.call("subscribe", "nodes")
+        self.gcs = client
+
+    def _on_gcs_lost(self):
+        """GCS connection dropped: retry with backoff — the GCS restarts
+        against its snapshot (reference: clients retry against a
+        redis-backed GCS, gcs_redis_failure_detector.cc).  Only after the
+        reconnect window expires is this fatal."""
+        if self._stopping:
+            return
+        self.loop.create_task(self._gcs_reconnect_loop())
+
+    async def _gcs_reconnect_loop(self):
+        deadline = time.monotonic() + CONFIG.gcs_reconnect_timeout_s
+        delay = 0.5
+        logger.warning("GCS connection lost; reconnecting")
+        while not self._stopping and time.monotonic() < deadline:
+            try:
+                await self._connect_gcs()
+                logger.info("GCS reconnected")
+                return
+            except Exception:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 5.0)
+        if not self._stopping and self.on_fatal:
+            self.on_fatal()
 
     async def stop(self):
         self._stopping = True
@@ -431,7 +462,7 @@ class Raylet:
     def _handle_failed_execution(self, spec: TaskSpec, reason: str):
         from ray_tpu import exceptions
 
-        if spec.attempt_number < spec.max_retries:
+        if spec.max_retries < 0 or spec.attempt_number < spec.max_retries:
             spec.attempt_number += 1
             logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt_number, reason)
             self.loop.call_later(
@@ -661,6 +692,10 @@ class Raylet:
         if not res.fits_in(self.resources_total):
             target = self._spill_target(res) if allow_spill else None
             return {"spill": target} if target else None
+        # The whole grant (park + spawn) must finish inside the client's
+        # call timeout, or the reply lands on a request the client already
+        # abandoned and the LEASED worker leaks until its conn closes.
+        deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000 - 5
         # FIFO fairness: an incoming request may not jump ahead of parked
         # waiters even if it happens to fit right now — a stream of small
         # requests would starve a parked large one forever otherwise.
@@ -675,7 +710,7 @@ class Raylet:
             self._grant_lease_waiters()  # may grant immediately (empty queue ahead)
             try:
                 await asyncio.wait_for(
-                    fut, max(1.0, CONFIG.worker_lease_timeout_ms / 1000 - 2)
+                    fut, max(1.0, deadline - time.monotonic())
                 )
             except asyncio.TimeoutError:
                 # wait_for cancelled the future, so it can never have been
@@ -688,29 +723,36 @@ class Raylet:
                 return None
         else:
             self.resources_available.subtract(res)
-        # Resources acquired; find or spawn a worker with a direct endpoint.
-        w = self._pop_idle_worker_for_lease(job_id)
-        if w is None:
-            w = self._spawn_worker(job_id)
-        w.reserved = True  # keep dispatch + concurrent grants off it
+        # Resources are debited from here on: ANY exit that doesn't grant
+        # must re-credit them or the node's capacity leaks.
+        granted = False
         try:
-            ok = await self._wait_worker_ready(w)
+            # Find or spawn a worker with a direct endpoint.
+            w = self._pop_idle_worker_for_lease(job_id)
+            if w is None:
+                w = self._spawn_worker(job_id)
+            w.reserved = True  # keep dispatch + concurrent grants off it
+            try:
+                ok = await self._wait_worker_ready(w, deadline)
+            finally:
+                w.reserved = False
+            if not ok or conn.closed:
+                if ok:  # requester vanished: put the worker back
+                    w.state = "IDLE"
+                    w.idle_since = time.monotonic()
+                    self.idle_workers[w.job_id].append(w)
+                return None
+            w.state = "LEASED"
+            w.resources_held = res.copy()
+            w.lease_owner = conn
+            w.lease_blocked = False
+            granted = True
+            return {"worker_id": w.worker_id.binary(), "address": w.direct_address}
         finally:
-            w.reserved = False
-        if not ok or conn.closed:
-            if ok:  # requester vanished: put the worker back
-                w.state = "IDLE"
-                w.idle_since = time.monotonic()
-                self.idle_workers[w.job_id].append(w)
-            self.resources_available.add(res)
-            self._grant_lease_waiters()
-            self._schedule_dispatch()
-            return None
-        w.state = "LEASED"
-        w.resources_held = res.copy()
-        w.lease_owner = conn
-        w.lease_blocked = False
-        return {"worker_id": w.worker_id.binary(), "address": w.direct_address}
+            if not granted:
+                self.resources_available.add(res)
+                self._grant_lease_waiters()
+                self._schedule_dispatch()
 
     def _spill_target(self, res: ResourceSet) -> Optional[str]:
         best, best_avail = None, -1.0
@@ -725,19 +767,25 @@ class Raylet:
 
     def _pop_idle_worker_for_lease(self, job_id: JobID) -> Optional["WorkerHandle"]:
         dq = self.idle_workers.get(job_id)
+        found = None
+        rejected = []
         while dq:
             w = dq.popleft()
-            if (
-                w.state == "IDLE"
-                and w.conn is not None
-                and not w.conn.closed
-                and w.direct_address
-            ):
-                return w
-        return None
+            if w.state != "IDLE" or w.conn is None or w.conn.closed:
+                continue  # dead entry, drop
+            if w.direct_address:
+                found = w
+                break
+            # Live worker without a direct endpoint: unusable for leases
+            # but still fine for raylet-mediated dispatch — keep it.
+            rejected.append(w)
+        for w in rejected:
+            dq.append(w)
+        return found
 
-    async def _wait_worker_ready(self, w: "WorkerHandle") -> bool:
-        deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
+    async def _wait_worker_ready(self, w: "WorkerHandle", deadline: float = None) -> bool:
+        if deadline is None:
+            deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
         while w.conn is None or w.direct_address is None:
             if w.state == "DEAD" or time.monotonic() > deadline or (
                 w.proc is not None and w.proc.poll() is not None
@@ -769,11 +817,7 @@ class Raylet:
         if w is None or w.state != "LEASED":
             return
         w.lease_owner = None
-        if not w.lease_blocked:
-            self._release_resources(w)
-        else:
-            w.resources_held = ResourceSet()
-            w.lease_blocked = False
+        self._release_resources(w)  # handles the lease_blocked case itself
         w.state = "IDLE"
         w.idle_since = time.monotonic()
         self.idle_workers[w.job_id].append(w)

@@ -423,8 +423,13 @@ class RpcClient:
             ev = threading.Event()
             self._pending[req_id] = ev
         data = pickle.dumps(("req", req_id, method, payload), protocol=5)
-        with self._lock:
-            self._sock.sendall(_LEN.pack(len(data)) + data)
+        try:
+            with self._lock:
+                self._sock.sendall(_LEN.pack(len(data)) + data)
+        except OSError as e:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise ConnectionLost(f"send to {self.address} failed: {e}") from e
         if timeout is _UNSET_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
         if not ev.wait(timeout):
@@ -440,8 +445,13 @@ class RpcClient:
         if self._closed:
             raise ConnectionLost(f"not connected to {self.address}")
         data = pickle.dumps(("push", method, payload), protocol=5)
-        with self._lock:
-            self._sock.sendall(_LEN.pack(len(data)) + data)
+        try:
+            with self._lock:
+                self._sock.sendall(_LEN.pack(len(data)) + data)
+        except OSError as e:
+            # Surface dead sockets as RpcError so callers' fallback paths
+            # fire instead of a raw BrokenPipeError escaping to user code.
+            raise ConnectionLost(f"send to {self.address} failed: {e}") from e
 
     def close(self):
         self._closed = True
@@ -451,6 +461,100 @@ class RpcClient:
             pass
         try:
             self._sock.close()
+        except Exception:
+            pass
+
+    @property
+    def closed(self):
+        return self._closed
+
+
+# --------------------------------------------------------------------------
+# Reconnecting sync client (drivers/workers -> GCS).  The reference keeps
+# GCS clients in retry loops against a Redis-backed GCS that may restart
+# (reference: gcs_redis_failure_detector.cc, retryable_grpc_client.cc);
+# here calls block until the GCS is back (bounded) and then retry.
+# --------------------------------------------------------------------------
+class ReconnectingRpcClient:
+    def __init__(self, address: str, on_push: Callable[[str, Any], None] = None,
+                 on_reconnect: Callable[[], None] = None,
+                 on_giveup: Callable[[], None] = None):
+        self.address = address
+        self.on_push = on_push
+        self.on_reconnect = on_reconnect
+        self.on_giveup = on_giveup
+        self._closed = False
+        self._ready = threading.Event()
+        self._lock = threading.Lock()
+        self._inner = RpcClient(address, on_push=on_push, on_close=self._on_inner_close)
+        self._ready.set()
+
+    def _on_inner_close(self):
+        if self._closed:
+            return
+        self._ready.clear()
+        threading.Thread(target=self._reconnect_loop, daemon=True,
+                         name=f"rpc-reconnect-{self.address[-16:]}").start()
+
+    def _reconnect_loop(self):
+        deadline = time.monotonic() + CONFIG.gcs_reconnect_timeout_s
+        while not self._closed and time.monotonic() < deadline:
+            try:
+                inner = RpcClient(self.address, on_push=self.on_push,
+                                  on_close=self._on_inner_close)
+            except RpcError:
+                time.sleep(0.5)
+                continue
+            with self._lock:
+                self._inner = inner
+            self._ready.set()  # before on_reconnect: its calls go via _client()
+            if self.on_reconnect is not None:
+                try:
+                    self.on_reconnect()
+                except Exception:
+                    pass
+            return
+        self._closed = True
+        self._ready.set()  # unblock waiters; calls will raise
+        if self.on_giveup is not None:
+            try:
+                self.on_giveup()
+            except Exception:
+                pass
+
+    def _client(self) -> RpcClient:
+        # Block while a reconnect is in progress (bounded by the loop).
+        self._ready.wait(CONFIG.gcs_reconnect_timeout_s + 5)
+        if self._closed:
+            raise ConnectionLost(f"gave up reconnecting to {self.address}")
+        with self._lock:
+            return self._inner
+
+    def call(self, method: str, payload: Any = None, timeout: float = _UNSET_TIMEOUT):
+        for _ in range(2):
+            try:
+                return self._client().call(method, payload, timeout)
+            except ConnectionLost:
+                if self._closed:
+                    raise
+                continue  # wait for reconnect, retry once
+        raise ConnectionLost(f"connection to {self.address} lost")
+
+    def push(self, method: str, payload: Any = None):
+        for _ in range(2):
+            try:
+                return self._client().push(method, payload)
+            except ConnectionLost:
+                if self._closed:
+                    raise
+                continue
+        raise ConnectionLost(f"connection to {self.address} lost")
+
+    def close(self):
+        self._closed = True
+        self._ready.set()
+        try:
+            self._inner.close()
         except Exception:
             pass
 

@@ -111,6 +111,10 @@ class ActorStateCache:
         self._worker = worker
         self._info: Dict[ActorID, dict] = {}
         self._pending: Dict[ActorID, List[TaskSpec]] = defaultdict(list)
+        # Actors whose queued specs are mid-flush: new submissions must
+        # queue BEHIND the flush or they'd take send-time sequence numbers
+        # ahead of earlier-submitted specs.
+        self._flushing: set = set()
         self._lock = threading.Lock()
 
     def on_update(self, info: dict):
@@ -118,19 +122,29 @@ class ActorStateCache:
         with self._lock:
             self._info[actor_id] = info
             pending = None
-            if info["state"] == "ALIVE":
+            if info["state"] in ("ALIVE", "DEAD"):
                 pending = self._pending.pop(actor_id, None)
-            elif info["state"] == "DEAD":
-                pending = self._pending.pop(actor_id, None)
-        if pending:
-            if info["state"] == "ALIVE":
-                for spec in pending:
-                    self._worker._send_actor_task(spec, info)
-            else:
-                for spec in pending:
-                    self._worker._store_error_returns(
-                        spec, exceptions.ActorDiedError(f"Actor died: {info.get('death_cause')}")
-                    )
+                if info["state"] == "ALIVE" and pending:
+                    self._flushing.add(actor_id)
+        if not pending:
+            return
+        if info["state"] == "ALIVE":
+            try:
+                while pending:
+                    for spec in pending:
+                        self._worker._send_actor_task(spec, info)
+                    with self._lock:
+                        pending = self._pending.pop(actor_id, None)
+                        if not pending:
+                            self._flushing.discard(actor_id)
+            finally:
+                with self._lock:
+                    self._flushing.discard(actor_id)
+        else:
+            for spec in pending:
+                self._worker._store_error_returns(
+                    spec, exceptions.ActorDiedError(f"Actor died: {info.get('death_cause')}")
+                )
 
     def get(self, actor_id: ActorID) -> Optional[dict]:
         with self._lock:
@@ -154,10 +168,16 @@ class ActorStateCache:
     def submit_or_queue(self, actor_id: ActorID, spec: TaskSpec) -> Optional[dict]:
         """Atomically: if the actor is in a terminal-ish state return its
         info (caller sends or errors); otherwise queue the spec for the
-        flush in on_update.  Closes the read-then-queue race with pubsub."""
+        flush in on_update.  Closes the read-then-queue race with pubsub.
+        While a flush is draining, new specs queue behind it so send-order
+        (and thus sequence numbers) matches submission order."""
         with self._lock:
             info = self._info.get(actor_id)
-            if info is not None and info["state"] in ("ALIVE", "DEAD"):
+            if (
+                info is not None
+                and info["state"] in ("ALIVE", "DEAD")
+                and actor_id not in self._flushing
+            ):
                 return info
             self._pending[actor_id].append(spec)
             return None
@@ -182,6 +202,7 @@ class Worker:
         self._raylet_clients: Dict[str, rpc.RpcClient] = {}
         self._task_counter = 0
         self._actor_seq: Dict[ActorID, int] = defaultdict(int)
+        self._actor_send_inc: Dict[ActorID, int] = {}
         self._lock = threading.RLock()
         self._pushed_functions: set = set()
         # Worker-mode execution state
@@ -218,6 +239,7 @@ class Worker:
         self._admit_lock = threading.Lock()
         self._actor_expected: Dict[bytes, int] = {}
         self._actor_buffer: Dict[bytes, Dict[int, tuple]] = {}
+        self._actor_caller_inc: Dict[bytes, int] = {}
         # Direct channels to actor workers: actor_id -> _ActorChannel.
         self._actor_channels: Dict[ActorID, Any] = {}
 
@@ -229,7 +251,9 @@ class Worker:
         import sys as _sys
 
         job_config = dict(job_config, driver_sys_path=[p for p in _sys.path if p])
-        self.gcs_client = rpc.RpcClient(gcs_address, on_push=self._on_gcs_push)
+        self.gcs_client = rpc.ReconnectingRpcClient(
+            gcs_address, on_push=self._on_gcs_push, on_reconnect=self._on_gcs_reconnected
+        )
         reply = self.gcs_client.call(
             "register_driver",
             {"namespace": namespace, "entrypoint": " ".join(os.sys.argv), "config": job_config},
@@ -262,7 +286,11 @@ class Worker:
         self.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
         self.job_id = JobID.from_hex(os.environ["RAY_TPU_JOB_ID"])
         self.node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
-        self.gcs_client = rpc.RpcClient(os.environ["RAY_TPU_GCS_ADDRESS"], on_push=self._on_gcs_push)
+        self.gcs_client = rpc.ReconnectingRpcClient(
+            os.environ["RAY_TPU_GCS_ADDRESS"],
+            on_push=self._on_gcs_push,
+            on_reconnect=self._on_gcs_reconnected,
+        )
         self.gcs_client.call("subscribe", "actors")
         # The raylet owns this worker's lifetime: if it dies, exit
         # (reference: workers suicide when their raylet disappears).
@@ -325,13 +353,20 @@ class Worker:
 
     def _admit_actor_task(self, spec: TaskSpec, conn):
         """Admit actor tasks per caller strictly in sequence_number order,
-        buffering early arrivals and dropping duplicate redeliveries
+        starting from 1 per (caller, actor incarnation): early arrivals
+        buffer, duplicate redeliveries and stale-incarnation specs drop
         (reference: transport/sequential_actor_submit_queue.h)."""
         with self._admit_lock:
             caller = spec.owner_worker_id.binary() if spec.owner_worker_id else b""
-            exp = self._actor_expected.get(caller)
-            if exp is None:
-                exp = spec.sequence_number  # first contact from this caller
+            inc = spec.actor_incarnation
+            cur_inc = self._actor_caller_inc.get(caller, 0)
+            if inc < cur_inc:
+                return  # stale delivery from before a restart the caller saw
+            if inc > cur_inc:
+                self._actor_caller_inc[caller] = inc
+                self._actor_expected[caller] = 1
+                self._actor_buffer.pop(caller, None)
+            exp = self._actor_expected.get(caller, 1)
             if spec.sequence_number < exp:
                 return  # duplicate (resend after a reconnect)
             buf = self._actor_buffer.setdefault(caller, {})
@@ -382,6 +417,16 @@ class Worker:
             channel, msg = payload
             if channel == "actors":
                 self.actor_cache.on_update(msg)
+
+    def _on_gcs_reconnected(self):
+        """The GCS restarted: re-subscribe and re-bind this driver's job so
+        disconnect-driven cleanup keeps working."""
+        try:
+            self.gcs_client.call("subscribe", "actors")
+            if self.mode == "driver" and self.job_id is not None:
+                self.gcs_client.call("reattach_driver", {"job_id": self.job_id.binary()})
+        except Exception:
+            pass
 
     def _on_raylet_push(self, method: str, payload):
         if method == "execute_task":
@@ -551,20 +596,16 @@ class Worker:
         if len(set(refs)) != len(refs):
             raise ValueError("ray.wait requires a list of unique object refs.")
         ms = self.memory_store
-        if any(ms.is_tracked(r.id.binary()) for r in refs):
-            self._notify_blocked(True)
-            try:
+        self._notify_blocked(True)
+        try:
+            if any(ms.is_tracked(r.id.binary()) for r in refs):
                 ready_ids = self._wait_hybrid(refs, num_returns, timeout)
-            finally:
-                self._notify_blocked(False)
-        else:
-            self._notify_blocked(True)
-            try:
+            else:
                 ready_ids, _ = self.store.wait(
                     [r.id for r in refs], num_returns, timeout if timeout is not None else None
                 )
-            finally:
-                self._notify_blocked(False)
+        finally:
+            self._notify_blocked(False)
         ready = [r for r in refs if r.id in ready_ids][:num_returns]
         ready_set = set(ready)
         not_ready = [r for r in refs if r not in ready_set]
@@ -586,13 +627,17 @@ class Worker:
                 elif not ms.is_pending(key):
                     store_ids.append(r.id)
             if store_ids:
-                got, _ = self.store.wait(store_ids, len(store_ids), 0)
+                # Let the raylet block briefly instead of zero-timeout
+                # polling — same RPC cadence, but the server wakes us the
+                # moment something seals.
+                got, _ = self.store.wait(store_ids, len(store_ids), 0.05)
                 ready.update(got)
             if len(ready) >= num_returns:
                 return ready
             if deadline is not None and time.monotonic() >= deadline:
                 return ready
-            ms.wait_any(0.05)
+            if not store_ids:
+                ms.wait_any(0.1)
 
     def _notify_blocked(self, blocked: bool):
         """Release/reacquire this task's resources during blocking calls
@@ -635,6 +680,12 @@ class Worker:
             if isinstance(a, ObjectRef):
                 key = a.id.binary()
                 blob = self.memory_store.get(key)
+                if blob is None:
+                    # In-flight direct result: atomically either flag it for
+                    # promotion on arrival or learn it just arrived (racing
+                    # here without the atomic op would skip both paths and
+                    # strand the consumer).
+                    blob = self.memory_store.mark_promote(key)
                 if blob is not None and blob[0] == serialization.TAG_NORMAL:
                     # Owned small result living in our memory store: inline
                     # the value into the spec — the executor never touches
@@ -646,13 +697,6 @@ class Worker:
                     # Error result (TAG_ERROR): can't inline as a value —
                     # promote so the consumer's fetch finds (and raises) it.
                     self.promote_blob(key, blob)
-                if self.memory_store.is_pending(key):
-                    # In-flight direct result: have the submitter promote it
-                    # to the shm store the moment it arrives so the
-                    # consumer's fetch can find it.
-                    ready = self.memory_store.mark_promote(key)
-                    if ready is not None:
-                        self.promote_blob(key, ready)
                 # The ref escapes this process: exempt it from eager free so
                 # the in-flight task can't lose its argument.
                 self.reference_counter.mark_escaped(a.id)
@@ -767,9 +811,8 @@ class Worker:
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict) -> List[ObjectRef]:
         self._check_connected()
         num_returns = options.get("num_returns", 1)
-        with self._lock:
-            self._actor_seq[actor_id] += 1
-            seq = self._actor_seq[actor_id]
+        # sequence_number is assigned at SEND time (_send_actor_task), per
+        # actor incarnation, so queued/retried specs renumber consistently.
         spec = TaskSpec(
             task_id=TaskID.of(actor_id),
             job_id=self.job_id,
@@ -780,7 +823,6 @@ class Worker:
             resources=ResourceSet(),
             is_actor_task=True,
             actor_id=actor_id,
-            sequence_number=seq,
             method_name=method_name,
             owner_worker_id=self.worker_id,
         )
@@ -807,6 +849,7 @@ class Worker:
 
     def _send_actor_task(self, spec: TaskSpec, info: dict):
         oids = [o.binary() for o in spec.return_ids()]
+        self._assign_actor_seq(spec, info)
         worker_address = info.get("worker_address")
         if CONFIG.direct_actor_calls and worker_address:
             ch = self._get_actor_channel(spec.actor_id, worker_address)
@@ -828,6 +871,24 @@ class Worker:
             self._store_error_returns(
                 spec, exceptions.ActorUnavailableError("Could not reach the actor's node")
             )
+
+    def _assign_actor_seq(self, spec: TaskSpec, info: dict):
+        """Assign (incarnation, sequence_number) atomically at send time.
+        The per-actor counter resets when a newer incarnation is first
+        seen, so the restarted actor's fresh receiver state sees sequences
+        starting at 1 again; a spec resent on the SAME incarnation keeps
+        its number (the receiver dedupes redeliveries)."""
+        actor_id = spec.actor_id
+        with self._lock:
+            inc = max(info.get("num_restarts", 0), self._actor_send_inc.get(actor_id, 0))
+            if inc > self._actor_send_inc.get(actor_id, 0) or actor_id not in self._actor_send_inc:
+                self._actor_send_inc[actor_id] = inc
+                if inc > 0:
+                    self._actor_seq[actor_id] = 0
+            if spec.sequence_number == 0 or spec.actor_incarnation != inc:
+                self._actor_seq[actor_id] += 1
+                spec.sequence_number = self._actor_seq[actor_id]
+                spec.actor_incarnation = inc
 
     def _get_actor_channel(self, actor_id: ActorID, address: str):
         from ray_tpu._private.direct import ActorDirectChannel
@@ -851,8 +912,12 @@ class Worker:
 
     def _on_actor_channel_closed(self, ch):
         """Direct channel to an actor dropped (its worker died or is
-        restarting): reroute in-flight specs through the actor state cache
-        so pubsub decides — resend on ALIVE, error on DEAD."""
+        restarting).  In-flight specs may have executed before the drop, so
+        they are retried only when the actor's max_task_retries allows it
+        (reference: max_task_retries semantics — actor methods are NOT
+        retried by default); otherwise their returns get a RayActorError.
+        Retriable specs reroute through the actor state cache so pubsub
+        decides — resend on ALIVE, error on DEAD."""
         with self._lock:
             if self._actor_channels.get(ch.actor_id) is ch:
                 del self._actor_channels[ch.actor_id]
@@ -860,8 +925,24 @@ class Worker:
         ch.inflight.clear()
         if not inflight:
             return
-        self.actor_cache.mark_unavailable(ch.actor_id)
+        cached = self.actor_cache.get(ch.actor_id) or {}
+        allowed_retries = cached.get("max_task_retries", 0)
+        retriable = []
         for spec in inflight:
+            if allowed_retries == -1 or spec.attempt_number < allowed_retries:
+                spec.attempt_number += 1
+                retriable.append(spec)
+            else:
+                self._store_error_returns(
+                    spec,
+                    exceptions.RayActorError(
+                        f"The actor died while {spec.name}.{spec.method_name} was in flight"
+                    ),
+                )
+        if not retriable:
+            return
+        self.actor_cache.mark_unavailable(ch.actor_id)
+        for spec in retriable:
             info = self.actor_cache.submit_or_queue(ch.actor_id, spec)
             if info is None:
                 continue  # queued; pubsub flush will resend or error

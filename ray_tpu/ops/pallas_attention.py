@@ -310,7 +310,7 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_fwd, _bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256, block_k: int = 256,
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 1024, block_k: int = 1024,
                     interpret: bool = False):
     """[B, T, H, D] flash attention (differentiable, Pallas fwd+bwd)."""
     if not HAVE_PALLAS:
@@ -318,8 +318,16 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256, block_k
 
         return reference_causal_attention(q, k, v)
     B, T, H, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
+    # Shrink blocks to the largest power-of-two divisor of T at or under
+    # the requested size, so any T that is a multiple of 128 works with
+    # the (large, faster) defaults.
+    def fit(block: int) -> int:
+        b = min(block, T)
+        while b > 128 and T % b:
+            b //= 2
+        return b
+
+    block_q, block_k = fit(block_q), fit(block_k)
     if T % block_q or T % block_k:
         raise ValueError(f"seq len {T} must divide block sizes ({block_q}, {block_k})")
     return _flash(q, k, v, causal, block_q, block_k, interpret)

@@ -124,8 +124,9 @@ def test_actor_order_two_submitting_threads(ray_cluster):
 
 
 def test_admit_buffers_out_of_order_sequences():
-    """Receiver-side unit test: early-arriving sequence numbers are held
-    until the gap fills; duplicates are dropped."""
+    """Receiver-side unit test: admission starts at sequence 1 per
+    (caller, incarnation); early arrivals are held until the gap fills,
+    duplicates and stale-incarnation specs are dropped."""
     from ray_tpu._private.common import TaskSpec
     from ray_tpu._private.ids import ActorID, JobID, TaskID, WorkerID
     from ray_tpu._private.worker import Worker
@@ -136,13 +137,14 @@ def test_admit_buffers_out_of_order_sequences():
     w._admit_lock = threading.Lock()
     w._actor_expected = {}
     w._actor_buffer = {}
+    w._actor_caller_inc = {}
     w._exec_queue = queue_mod.Queue()
 
     job = JobID.from_random()
     actor = ActorID.of(job)
     caller = WorkerID.from_random()
 
-    def spec(seq):
+    def spec(seq, inc=0):
         return TaskSpec(
             task_id=TaskID.of(actor),
             job_id=job,
@@ -154,17 +156,62 @@ def test_admit_buffers_out_of_order_sequences():
             is_actor_task=True,
             actor_id=actor,
             sequence_number=seq,
+            actor_incarnation=inc,
             owner_worker_id=caller,
         )
 
-    # Arrival order 2, 4, 1, 3  (first contact seq=2 sets the base), dup 2.
+    def drain():
+        out = []
+        while not w._exec_queue.empty():
+            s, _ = w._exec_queue.get_nowait()
+            out.append(s.sequence_number)
+        return out
+
+    # Arrival order 2, 4, 1, 3 — nothing admits until 1 shows up; then all
+    # flow contiguously.  A duplicate redelivery of 2 is dropped.
     w._admit_actor_task(spec(2), None)
     w._admit_actor_task(spec(4), None)
-    w._admit_actor_task(spec(1), None)  # below base: dropped as duplicate
+    assert drain() == []
+    w._admit_actor_task(spec(1), None)
     w._admit_actor_task(spec(3), None)
     w._admit_actor_task(spec(2), None)  # duplicate redelivery: dropped
-    admitted = []
-    while not w._exec_queue.empty():
-        s, _ = w._exec_queue.get_nowait()
-        admitted.append(s.sequence_number)
-    assert admitted == [2, 3, 4], admitted
+    assert drain() == [1, 2, 3, 4]
+
+    # New incarnation resets admission to 1; stale incarnation 0 drops.
+    w._admit_actor_task(spec(1, inc=1), None)
+    w._admit_actor_task(spec(5, inc=0), None)  # stale: dropped
+    assert drain() == [1]
+
+
+def test_actor_restart_resets_sequencing(ray_cluster):
+    """After an actor restart the new worker has fresh receiver state; the
+    caller must renumber so calls keep executing (incarnation reset)."""
+
+    @ray_tpu.remote(max_restarts=1)
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def ping(self, i):
+            self.calls += 1
+            return i
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    a = Flaky.remote()
+    assert ray_tpu.get([a.ping.remote(i) for i in range(5)], timeout=60) == list(range(5))
+    a.die.remote()
+    # Wait for the restart, then keep calling — must not hang or misorder.
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            assert ray_tpu.get(a.ping.remote(100), timeout=10) == 100
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+    assert ray_tpu.get([a.ping.remote(i) for i in range(3)], timeout=60) == [0, 1, 2]

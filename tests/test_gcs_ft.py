@@ -1,0 +1,117 @@
+"""GCS fault tolerance: kill + restart the GCS mid-run.
+
+The cluster must survive: raylets reconnect with backoff and resync,
+drivers reattach their job, actors keep serving direct calls throughout
+the outage, and work that needs the GCS (new function pushes) blocks and
+completes once it's back (reference: redis-backed GCS restart,
+gcs/store_client/redis_store_client.h:106, gcs_redis_failure_detector.cc;
+test model: python/ray/tests external-redis GCS FT fixtures).
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import CONFIG
+
+
+def _spawn_gcs(session_dir: str, gcs_address: str) -> subprocess.Popen:
+    from ray_tpu._private.node import child_env
+
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.gcs_main",
+            "--address", gcs_address,
+            "--session-dir", session_dir,
+            "--config", CONFIG.dump(),
+        ],
+        env=child_env(),
+        start_new_session=True,
+    )
+
+
+def test_gcs_restart_mid_run():
+    from ray_tpu._private import node as node_mod
+
+    session_dir = node_mod.new_session_dir()
+    gcs_address = f"unix:{session_dir}/sockets/gcs.sock"
+    gcs = _spawn_gcs(session_dir, gcs_address)
+    raylet_proc = None
+    gcs2 = None
+    try:
+        raylet_proc, _ = node_mod.start_worker_node(
+            gcs_address, session_dir, num_cpus=4, wait=True
+        )
+        ray_tpu.init(address=gcs_address)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+        assert ray_tpu.get(f.remote(1), timeout=60) == 2
+        time.sleep(1.0)  # let the snapshot loop persist the state above
+
+        # ---- kill the GCS hard ----
+        gcs.kill()
+        gcs.wait(timeout=10)
+
+        # Running actors keep serving during the outage (direct channels
+        # don't involve the GCS).
+        assert ray_tpu.get(c.incr.remote(), timeout=30) == 2
+
+        # Work needing the GCS (a NEW function's first push) blocks until
+        # the GCS is back, then completes — no error surfaces.
+        result = {}
+
+        def submit_new_fn():
+            @ray_tpu.remote
+            def g(x):
+                return x * 3
+
+            result["v"] = ray_tpu.get(g.remote(7), timeout=90)
+
+        t = threading.Thread(target=submit_new_fn, daemon=True)
+        t.start()
+        time.sleep(1.0)
+        assert "v" not in result  # still blocked on the dead GCS
+
+        # ---- restart the GCS against the same session dir ----
+        gcs2 = _spawn_gcs(session_dir, gcs_address)
+        t.join(timeout=90)
+        assert result.get("v") == 21, "queued task did not complete after GCS restart"
+
+        # The actor survived the restart with its state intact.
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 3
+        # And the restarted GCS knows about it (restored from snapshot,
+        # reconciled with the raylet's live_actors resync).
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        info = w.gcs_client.call("get_actor_info", c._actor_id.binary())
+        assert info is not None and info["state"] == "ALIVE"
+    finally:
+        ray_tpu.shutdown()
+        for p in (gcs2, gcs, raylet_proc):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
