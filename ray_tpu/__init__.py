@@ -108,12 +108,22 @@ def init(
             gcs_address = address
             raylet_address = node_mod.head_raylet_address(gcs_address)
 
+        # Normalize the job-level runtime env before connecting (local
+        # dirs become content-addressed gcs:// URIs); the packages are
+        # uploaded right after the GCS connection exists, before any task
+        # can be submitted (reference: runtime_env/working_dir.py
+        # upload_package_if_needed).
+        from ray_tpu._private import runtime_env as _renv
+
+        norm_env, _uploads = _renv.prepare(runtime_env)
         worker.connect_driver(
             gcs_address,
             raylet_address,
             namespace,
-            {"namespace": namespace or "", "runtime_env": runtime_env or {}},
+            {"namespace": namespace or "", "runtime_env": norm_env or {}},
         )
+        _renv.finish_uploads(worker.gcs_client, _uploads)
+        worker.job_runtime_env = norm_env
         return RayContext(worker)
 
 

@@ -38,6 +38,12 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "scheduler_spread_threshold": 0.5,
     # Workers prestarted per node (0 = num_cpus).
     "num_prestart_workers": 0,
+    # A runtime_env whose staging failed is considered broken for this
+    # long; tasks needing it fail fast with RuntimeEnvSetupError.
+    "runtime_env_error_ttl_s": 30,
+    # A spawned worker that hasn't registered within this window (runtime
+    # env staging included) is presumed wedged and killed.
+    "worker_register_timeout_s": 900,
     # Max idle workers kept around per node.
     "idle_worker_pool_size": 8,
     "idle_worker_killing_time_ms": 300_000,

@@ -53,12 +53,14 @@ class _Lease:
 
 class _KeyState:
     __slots__ = (
-        "key", "resources", "pending", "leases", "requests_inflight", "ewma_ms",
+        "key", "resources", "runtime_env", "pending", "leases",
+        "requests_inflight", "ewma_ms",
     )
 
-    def __init__(self, key, resources):
+    def __init__(self, key, resources, runtime_env=None):
         self.key = key
         self.resources = resources
+        self.runtime_env = runtime_env
         self.pending: deque = deque()
         self.leases: Dict[bytes, _Lease] = {}
         self.requests_inflight = 0
@@ -88,7 +90,13 @@ class DirectTaskSubmitter:
 
     # ------------------------------------------------------------------
     def scheduling_key(self, spec: TaskSpec) -> Tuple:
-        return (tuple(sorted(spec.resources.items())), spec.job_id.binary())
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        return (
+            tuple(sorted(spec.resources.items())),
+            spec.job_id.binary(),
+            runtime_env_mod.spec_env_hash(spec),
+        )
 
     def submit(self, spec: TaskSpec) -> None:
         """Queue a spec; dispatches to an idle lease or requests one."""
@@ -98,7 +106,7 @@ class DirectTaskSubmitter:
             key = self.scheduling_key(spec)
             ks = self._keys.get(key)
             if ks is None:
-                ks = self._keys[key] = _KeyState(key, spec.resources)
+                ks = self._keys[key] = _KeyState(key, spec.resources, spec.runtime_env)
             ks.pending.append(spec)
             self._assign_locked(ks)
             self._maybe_request_leases_locked(ks)
@@ -168,6 +176,7 @@ class DirectTaskSubmitter:
                     "resources": dict(ks.resources),
                     "job_id": self._worker.job_id.binary(),
                     "spilled": hops > 0,
+                    "runtime_env": ks.runtime_env,
                 },
                 timeout=CONFIG.worker_lease_timeout_ms / 1000,
             )
@@ -176,6 +185,9 @@ class DirectTaskSubmitter:
             # (e.g. OSError from a failed worker spawn) — any failure here
             # must still decrement requests_inflight via _on_lease_reply
             # or the scheduling key wedges permanently.
+            reply = None
+        if reply and reply.get("runtime_env_error"):
+            self._fail_pending_env(ks, reply["runtime_env_error"])
             reply = None
         if reply and reply.get("spill") and hops < 4:
             try:
@@ -282,6 +294,23 @@ class DirectTaskSubmitter:
             self._worker.memory_store.resolve_stored(
                 [o.binary() for o in spec.return_ids()]
             )
+
+    def _fail_pending_env(self, ks: _KeyState, msg: str) -> None:
+        """The raylet reported this key's runtime_env failed to stage:
+        fail every queued spec with RuntimeEnvSetupError."""
+        from ray_tpu import exceptions
+
+        with self._lock:
+            doomed = list(ks.pending)
+            ks.pending.clear()
+        err = exceptions.RuntimeEnvSetupError(f"runtime_env setup failed: {msg}")
+        for spec in doomed:
+            try:
+                self._worker._store_error_returns(spec, err)
+            finally:
+                self._worker.memory_store.resolve_stored(
+                    [o.binary() for o in spec.return_ids()]
+                )
 
     # ------------------------------------------------------------------
     def _reap_loop(self) -> None:

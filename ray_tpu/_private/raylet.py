@@ -3,11 +3,11 @@
 Equivalent of the reference raylet (reference: src/ray/raylet/
 node_manager.h:119, worker_pool.h:216, local_task_manager.h:58,
 scheduling/cluster_task_manager.h:42) plus the object-manager pull path
-(reference: src/ray/object_manager/pull_manager.h:52).  Differences by
-design: task submitters send the full TaskSpec to a raylet and the raylet
-pushes it to a leased worker over the worker's registration connection
-(the reference grants a lease and the submitter pushes worker-to-worker;
-that optimization can layer on later without API changes).
+(reference: src/ray/object_manager/pull_manager.h:52).  The default task
+path is direct submission: submitters lease workers per scheduling key
+(rpc_request_worker_lease) and push specs worker-to-worker (direct.py),
+matching reference normal_task_submitter.cc:295; raylet-mediated dispatch
+remains for non-DEFAULT scheduling strategies and actor creation.
 
 Scheduling is two-level like the reference: a cluster decision (run here
 vs. spill to another node, using the GCS-synced availability view) and a
@@ -26,7 +26,7 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import rpc, serialization
+from ray_tpu._private import rpc, runtime_env as runtime_env_mod, serialization
 from ray_tpu._private.common import ResourceSet, TaskSpec
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
@@ -40,6 +40,7 @@ class WorkerHandle:
         "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
         "running", "spawn_time", "idle_since", "resources_held", "bundle_key",
         "direct_address", "lease_owner", "lease_blocked", "reserved",
+        "env_hash",
     )
 
     def __init__(self, worker_id: WorkerID, proc, job_id: JobID):
@@ -66,6 +67,11 @@ class WorkerHandle:
         # Claimed by an in-progress lease grant (worker still starting):
         # keeps the dispatch loop and other grants off it.
         self.reserved = False
+        # Runtime-env identity this worker was spawned with ('' = default);
+        # the idle pool is keyed by (job, env_hash) so tasks only reuse
+        # workers whose environment matches (reference: worker_pool.h:216
+        # keys its pools by runtime_env_hash too).
+        self.env_hash = ""
 
 
 class Raylet:
@@ -101,10 +107,17 @@ class Raylet:
         self.store = ObjectStoreCore(
             store_dir, cap, on_seal=self._on_object_sealed, on_evict=self._on_object_evicted
         )
+        # In-flight object_location_add pushes, by object id (see
+        # _on_object_sealed for why seal RPCs await these).
+        self._seal_reports: Dict[bytes, asyncio.Task] = {}
 
-        # Worker pool
+        # Worker pool; idle queues keyed by (job_id, runtime-env hash).
         self.workers: Dict[WorkerID, WorkerHandle] = {}
-        self.idle_workers: Dict[JobID, deque] = defaultdict(deque)
+        self.idle_workers: Dict[Tuple[JobID, str], deque] = defaultdict(deque)
+        # env_hash -> (error message, monotonic time): envs whose staging
+        # failed recently; tasks requiring them fail fast with
+        # RuntimeEnvSetupError instead of spawn-looping.
+        self.bad_runtime_envs: Dict[str, Tuple[str, float]] = {}
         self.actor_workers: Dict[ActorID, WorkerHandle] = {}
         self.num_starting = 0
         self.job_configs: Dict[JobID, dict] = {}
@@ -301,7 +314,7 @@ class Raylet:
             limit = CONFIG.idle_worker_pool_size
             kill_after = CONFIG.idle_worker_killing_time_ms / 1000
             now = time.monotonic()
-            for job_id, dq in self.idle_workers.items():
+            for pool_key, dq in self.idle_workers.items():
                 while len(dq) > limit:
                     w = dq.popleft()
                     self._kill_worker_proc(w)
@@ -309,11 +322,29 @@ class Raylet:
                     if now - w.idle_since > kill_after:
                         dq.remove(w)
                         self._kill_worker_proc(w)
+            # STARTING workers that never registered (wedged staging, a
+            # hung pip, a crashed interpreter that left the handle) are
+            # reaped by age so they don't leak forever.
+            for w in list(self.workers.values()):
+                if (
+                    w.state == "STARTING"
+                    and now - w.spawn_time > CONFIG.worker_register_timeout_s
+                ):
+                    logger.warning(
+                        "reaping worker %s: not registered after %.0fs",
+                        w.worker_id.hex()[:12], now - w.spawn_time,
+                    )
+                    self._kill_worker_proc(w)
 
     # ------------------------------------------------------------------
     # worker pool (reference: raylet/worker_pool.h:216)
     # ------------------------------------------------------------------
-    def _spawn_worker(self, job_id: JobID, actor_id: Optional[ActorID] = None) -> WorkerHandle:
+    def _spawn_worker(
+        self,
+        job_id: JobID,
+        actor_id: Optional[ActorID] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         from ray_tpu._private.node import child_env
 
@@ -324,6 +355,12 @@ class Raylet:
         env["RAY_TPU_JOB_ID"] = job_id.hex()
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
         env["RAY_TPU_STORE_DIR"] = self.store.store_dir
+        if self.session_dir:
+            env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if runtime_env:
+            import json as _json
+
+            env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
@@ -337,6 +374,7 @@ class Raylet:
         out.close()
         w = WorkerHandle(worker_id, proc, job_id)
         w.actor_id = actor_id
+        w.env_hash = runtime_env_mod.env_hash(runtime_env)
         self.workers[worker_id] = w
         self.num_starting += 1
         return w
@@ -346,6 +384,18 @@ class Raylet:
         w = self.workers.get(worker_id)
         if w is None:
             # Driver registering as a worker-like client, or unknown.
+            return {"ok": False}
+        if payload.get("runtime_env_error"):
+            # The worker failed to stage its runtime env: remember the bad
+            # env, fail every queued task that needs it, and refuse the
+            # registration — letting the worker die without this would
+            # respawn it in a loop (reference: runtime-env agent surfaces
+            # RuntimeEnvSetupError the same way).
+            msg = payload["runtime_env_error"]
+            self.num_starting = max(0, self.num_starting - 1)
+            self.bad_runtime_envs[w.env_hash] = (msg, time.monotonic())
+            self._fail_queued_for_env(w.env_hash, msg)
+            self._kill_worker_proc(w)
             return {"ok": False}
         if w.job_id not in self.job_configs:
             # Worker of a job whose driver registered at another raylet:
@@ -362,7 +412,7 @@ class Raylet:
         w.state = "IDLE"
         conn.meta["worker_id"] = worker_id
         if w.actor_id is None and not w.reserved:
-            self.idle_workers[w.job_id].append(w)
+            self.idle_workers[(w.job_id, w.env_hash)].append(w)
         self._schedule_dispatch()
         return {"ok": True, "job_config": self.job_configs.get(w.job_id, {})}
 
@@ -373,10 +423,12 @@ class Raylet:
             job_id = JobID(payload["job_id"])
             conn.meta["job_id"] = job_id
             self.job_configs[job_id] = payload.get("job_config", {})
-            # Prestart workers for the job.
+            # Prestart workers for the job (with its default runtime env,
+            # so the common case reuses them instead of spawning again).
+            job_env = self.job_configs[job_id].get("runtime_env") or None
             n = CONFIG.num_prestart_workers or min(2, int(self.resources_total.get("CPU", 1)))
             for _ in range(n):
-                self._spawn_worker(job_id)
+                self._spawn_worker(job_id, runtime_env=job_env)
         return {"node_id": self.node_id.binary(), "store_dir": self.store.store_dir}
 
     async def push_task_blocked(self, payload, conn):
@@ -477,11 +529,29 @@ class Raylet:
         for oid in spec.return_ids():
             self.store.create_from_bytes(oid, blob)
 
+    def _fail_spec_with_error(self, spec: TaskSpec, err: Exception):
+        blob = serialization.serialize_to_bytes(err, tag=serialization.TAG_ERROR)
+        for oid in spec.return_ids():
+            self.store.create_from_bytes(oid, blob)
+
+    def _fail_queued_for_env(self, env_hash: str, msg: str):
+        from ray_tpu import exceptions
+
+        err = exceptions.RuntimeEnvSetupError(f"runtime_env setup failed: {msg}")
+        kept = deque()
+        for spec in self.queue:
+            if runtime_env_mod.spec_env_hash(spec) == env_hash:
+                self._fail_spec_with_error(spec, err)
+            else:
+                kept.append(spec)
+        self.queue = kept
+
     def _on_job_finished(self, job_id: JobID):
         for w in list(self.workers.values()):
             if w.job_id == job_id:
                 self._kill_worker_proc(w)
-        self.idle_workers.pop(job_id, None)
+        for key in [k for k in self.idle_workers if k[0] == job_id]:
+            self.idle_workers.pop(key, None)
         self.job_configs.pop(job_id, None)
         self.queue = deque(s for s in self.queue if s.job_id != job_id)
         self.infeasible = [s for s in self.infeasible if s.job_id != job_id]
@@ -626,19 +696,44 @@ class Raylet:
                 else:
                     self.infeasible.append(spec)
                 continue
+            eh = runtime_env_mod.spec_env_hash(spec)
+            bad = self.bad_runtime_envs.get(eh)
+            if bad is not None:
+                if time.monotonic() - bad[1] < CONFIG.runtime_env_error_ttl_s:
+                    from ray_tpu import exceptions
+
+                    self._fail_spec_with_error(
+                        spec,
+                        exceptions.RuntimeEnvSetupError(
+                            f"runtime_env setup failed: {bad[0]}"
+                        ),
+                    )
+                    continue
+                self.bad_runtime_envs.pop(eh, None)
             if not self._try_acquire(spec):
                 remaining.append(spec)
                 continue
-            w = self._pop_idle_worker(spec.job_id)
+            w = self._pop_idle_worker(spec.job_id, eh)
             if w is None:
                 self._release_task_resources(spec)
                 remaining.append(spec)
-                # Make sure a worker is coming.
-                if self.num_starting == 0:
-                    self._spawn_worker(spec.job_id)
+                # Make sure a worker with the right (job, env) is coming —
+                # a worker starting for a *different* env can never serve
+                # this task, so it must not suppress the spawn.
+                if not self._worker_starting_for(spec.job_id, eh):
+                    self._spawn_worker(spec.job_id, runtime_env=spec.runtime_env)
                 continue
             self._push_task_to_worker(w, spec)
         self.queue = remaining
+
+    def _worker_starting_for(self, job_id: JobID, env_hash: str) -> bool:
+        return any(
+            w.state == "STARTING"
+            and w.actor_id is None  # dedicated actor workers don't count
+            and w.job_id == job_id
+            and w.env_hash == env_hash
+            for w in self.workers.values()
+        )
 
     def _locally_feasible(self, spec: TaskSpec) -> bool:
         bk = self._bundle_key(spec)
@@ -646,8 +741,8 @@ class Raylet:
             return bk in self.bundles
         return self._task_resources(spec).fits_in(self.resources_total)
 
-    def _pop_idle_worker(self, job_id: JobID) -> Optional[WorkerHandle]:
-        dq = self.idle_workers.get(job_id)
+    def _pop_idle_worker(self, job_id: JobID, env_hash: str = "") -> Optional[WorkerHandle]:
+        dq = self.idle_workers.get((job_id, env_hash))
         while dq:
             w = dq.popleft()
             if w.state == "IDLE" and w.conn is not None and not w.conn.closed:
@@ -675,7 +770,7 @@ class Raylet:
         if w.actor_id is None and w.state != "DEAD":
             w.state = "IDLE"
             w.idle_since = time.monotonic()
-            self.idle_workers[w.job_id].append(w)
+            self.idle_workers[(w.job_id, w.env_hash)].append(w)
         self._schedule_dispatch()
         return True
 
@@ -688,6 +783,13 @@ class Raylet:
     async def rpc_request_worker_lease(self, payload, conn):
         res = ResourceSet.of(payload["resources"])
         job_id = JobID(payload["job_id"])
+        lease_env = payload.get("runtime_env")
+        lease_env_hash = runtime_env_mod.env_hash(lease_env)
+        bad = self.bad_runtime_envs.get(lease_env_hash)
+        if bad is not None:
+            if time.monotonic() - bad[1] < CONFIG.runtime_env_error_ttl_s:
+                return {"runtime_env_error": bad[0]}
+            self.bad_runtime_envs.pop(lease_env_hash, None)
         allow_spill = not payload.get("spilled", False)
         if not res.fits_in(self.resources_total):
             target = self._spill_target(res) if allow_spill else None
@@ -728,19 +830,23 @@ class Raylet:
         granted = False
         try:
             # Find or spawn a worker with a direct endpoint.
-            w = self._pop_idle_worker_for_lease(job_id)
+            w = self._pop_idle_worker_for_lease(job_id, lease_env_hash)
             if w is None:
-                w = self._spawn_worker(job_id)
+                w = self._spawn_worker(job_id, runtime_env=lease_env)
             w.reserved = True  # keep dispatch + concurrent grants off it
             try:
                 ok = await self._wait_worker_ready(w, deadline)
             finally:
                 w.reserved = False
+            if not ok:
+                bad = self.bad_runtime_envs.get(lease_env_hash)
+                if bad is not None:
+                    return {"runtime_env_error": bad[0]}
             if not ok or conn.closed:
                 if ok:  # requester vanished: put the worker back
                     w.state = "IDLE"
                     w.idle_since = time.monotonic()
-                    self.idle_workers[w.job_id].append(w)
+                    self.idle_workers[(w.job_id, w.env_hash)].append(w)
                 return None
             w.state = "LEASED"
             w.resources_held = res.copy()
@@ -765,8 +871,10 @@ class Raylet:
                     best = view["raylet_address"]
         return best
 
-    def _pop_idle_worker_for_lease(self, job_id: JobID) -> Optional["WorkerHandle"]:
-        dq = self.idle_workers.get(job_id)
+    def _pop_idle_worker_for_lease(
+        self, job_id: JobID, env_hash: str = ""
+    ) -> Optional["WorkerHandle"]:
+        dq = self.idle_workers.get((job_id, env_hash))
         found = None
         rejected = []
         while dq:
@@ -787,10 +895,16 @@ class Raylet:
         if deadline is None:
             deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
         while w.conn is None or w.direct_address is None:
-            if w.state == "DEAD" or time.monotonic() > deadline or (
-                w.proc is not None and w.proc.poll() is not None
-            ):
+            if w.state == "DEAD" or (w.proc is not None and w.proc.poll() is not None):
                 self._kill_worker_proc(w)
+                return False
+            if time.monotonic() > deadline:
+                # Deadline expired but the worker process is alive: it is
+                # still staging its runtime env (pip install can take
+                # minutes).  Do NOT kill it — it will join the idle pool
+                # when it registers and the requester's retry picks it up.
+                # Truly wedged STARTING workers are reaped by age in
+                # _idle_reaper_loop.
                 return False
             await asyncio.sleep(0.005)
         # The pool may have routed the freshly-registered worker to the
@@ -820,7 +934,7 @@ class Raylet:
         self._release_resources(w)  # handles the lease_blocked case itself
         w.state = "IDLE"
         w.idle_since = time.monotonic()
-        self.idle_workers[w.job_id].append(w)
+        self.idle_workers[(w.job_id, w.env_hash)].append(w)
         self._grant_lease_waiters()
         self._schedule_dispatch()
 
@@ -841,7 +955,9 @@ class Raylet:
             if not res.fits_in(self.resources_available):
                 raise RuntimeError("insufficient resources for actor")
             self.resources_available.subtract(res)
-        w = self._spawn_worker(spec.job_id, actor_id=spec.actor_id)
+        w = self._spawn_worker(
+            spec.job_id, actor_id=spec.actor_id, runtime_env=spec.runtime_env
+        )
         w.resources_held = res.copy()
         w.bundle_key = bk
         self.actor_workers[spec.actor_id] = w
@@ -850,6 +966,13 @@ class Raylet:
         while w.conn is None:
             if time.monotonic() > deadline or w.proc.poll() is not None:
                 self._kill_worker_proc(w)
+                bad = self.bad_runtime_envs.get(w.env_hash)
+                if bad is not None:
+                    from ray_tpu import exceptions
+
+                    raise exceptions.RuntimeEnvSetupError(
+                        f"runtime_env setup failed: {bad[0]}"
+                    )
                 raise RuntimeError("actor worker failed to start")
             await asyncio.sleep(0.01)
         self._push_task_to_worker(w, spec)
@@ -934,9 +1057,18 @@ class Raylet:
     # ------------------------------------------------------------------
     def _on_object_sealed(self, object_id: ObjectID):
         if self.gcs is not None and self.gcs._connected:
-            self.loop.create_task(
-                self._safe_gcs_push("object_location_add", (object_id.binary(), self.node_id.binary()))
+            key = object_id.binary()
+            task = self.loop.create_task(
+                self._safe_gcs_push("object_location_add", (key, self.node_id.binary()))
             )
+            # Kept so the seal RPC handlers can await the GCS ack before
+            # replying: a ref must not escape this node (e.g. in a direct
+            # worker->driver task result) before the GCS knows the object
+            # exists, or losing the node makes object_lost_check report
+            # "never sealed" and the borrower's get hangs to timeout
+            # instead of raising ObjectLostError.
+            self._seal_reports[key] = task
+            task.add_done_callback(lambda _t, k=key: self._seal_reports.pop(k, None))
 
     def _on_object_evicted(self, object_id: ObjectID):
         if self.gcs is not None and self.gcs._connected:
@@ -950,9 +1082,17 @@ class Raylet:
         except rpc.RpcError:
             pass
 
+    async def _await_seal_report(self, oid_bytes: bytes):
+        task = self._seal_reports.get(oid_bytes)
+        if task is not None:
+            await asyncio.shield(task)
+
     async def rpc_store_put_inline(self, payload, conn):
         oid_bytes, data = payload
-        return self.store.put_inline(ObjectID(oid_bytes), data)
+        ok = self.store.put_inline(ObjectID(oid_bytes), data)
+        if ok:
+            await self._await_seal_report(oid_bytes)
+        return ok
 
     async def push_store_put_inline(self, payload, conn):
         """Fire-and-forget variant used by memory-store → shm promotion."""
@@ -961,7 +1101,10 @@ class Raylet:
 
     async def rpc_store_seal(self, payload, conn):
         oid_bytes, size = payload
-        return self.store.seal_file(ObjectID(oid_bytes), size)
+        ok = self.store.seal_file(ObjectID(oid_bytes), size)
+        if ok:
+            await self._await_seal_report(oid_bytes)
+        return ok
 
     async def rpc_store_contains(self, payload, conn):
         return self.store.contains(ObjectID(payload))

@@ -1,0 +1,323 @@
+"""Runtime environments: working_dir / py_modules / env_vars / pip.
+
+Reference: python/ray/_private/runtime_env/{working_dir.py,pip.py,
+uri_cache.py} and the per-node agent (runtime_env/agent/main.py).
+
+Design here (tpu-idiomatic compression of the same contract):
+
+- The *driver* normalizes a runtime_env at decoration/init time:
+  local ``working_dir`` / ``py_modules`` directories are zipped,
+  content-hashed, and uploaded once to the GCS KV store under
+  ``gcs://_runtime_envs/<sha>.zip`` — the cluster-wide content store the
+  reference keeps in its GCS too (working_dir.py upload_package_if_needed).
+- The raylet keys its idle-worker pool by (job, env-hash) and passes the
+  serialized env to spawned workers via ``RAY_TPU_RUNTIME_ENV``.
+- The *worker* self-stages before registering: downloads + unzips under a
+  cross-process file lock into ``<session>/runtime_resources/<sha>/``
+  (so staging happens once per node, like the reference's per-node
+  runtime-env agent, but without a separate daemon), installs pip specs
+  with ``pip install --target`` into a cached dir, prepends staged dirs
+  to ``sys.path``, chdirs into the working_dir, and applies ``env_vars``.
+  Staging failures are reported to the raylet at registration and fail
+  the requesting tasks with RuntimeEnvSetupError instead of spawn-looping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import subprocess
+import sys
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+KV_NS = b"fun:_runtime_envs"  # GCS KV namespace for uploaded packages
+URI_PREFIX = "gcs://_runtime_envs/"
+
+SUPPORTED_KEYS = {"working_dir", "py_modules", "env_vars", "pip", "config"}
+
+# Dirs never worth shipping (reference: working_dir.py excludes .git etc.
+# via upload filters; __pycache__ differs per interpreter run).
+DEFAULT_EXCLUDES = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+class RuntimeEnvError(ValueError):
+    pass
+
+
+# ----------------------------------------------------------------------
+# normalization (driver side)
+# ----------------------------------------------------------------------
+def validate(env: dict) -> None:
+    unknown = set(env) - SUPPORTED_KEYS
+    if unknown:
+        raise RuntimeEnvError(
+            f"Unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(SUPPORTED_KEYS)}"
+        )
+    ev = env.get("env_vars")
+    if ev is not None and not (
+        isinstance(ev, dict)
+        and all(isinstance(k, str) and isinstance(v, str) for k, v in ev.items())
+    ):
+        raise RuntimeEnvError("runtime_env['env_vars'] must be a Dict[str, str]")
+    pip = env.get("pip")
+    if pip is not None and not (
+        isinstance(pip, list) and all(isinstance(p, str) for p in pip)
+    ):
+        raise RuntimeEnvError("runtime_env['pip'] must be a List[str] of pip specs")
+
+
+def prepare(env: Optional[dict]) -> Tuple[Optional[dict], List[Tuple[str, bytes]]]:
+    """Normalize an env without touching the network.
+
+    Local directories become content-addressed ``gcs://`` URIs; the
+    returned ``uploads`` list of (uri, zip_bytes) must be pushed to the
+    GCS KV (see :func:`finish_uploads`) before any task using the env is
+    submitted.  Separating the two lets ``ray_tpu.init`` hash the
+    working_dir before it is connected to a cluster.
+    """
+    if not env:
+        return (None, [])
+    validate(env)
+    norm: dict = {}
+    uploads: List[Tuple[str, bytes]] = []
+    wd = env.get("working_dir")
+    if wd:
+        norm["working_dir"], blob = _to_uri(wd)
+        if blob is not None:
+            uploads.append((norm["working_dir"], blob))
+    mods = env.get("py_modules")
+    if mods:
+        out = []
+        for m in mods:
+            uri, blob = _to_uri(m)
+            out.append(uri)
+            if blob is not None:
+                uploads.append((uri, blob))
+        norm["py_modules"] = out
+    if env.get("env_vars"):
+        norm["env_vars"] = dict(env["env_vars"])
+    if env.get("pip"):
+        norm["pip"] = sorted(env["pip"])
+    if env.get("config"):
+        norm["config"] = dict(env["config"])
+    return (norm or None, uploads)
+
+
+def _to_uri(path_or_uri: str) -> Tuple[str, Optional[bytes]]:
+    if path_or_uri.startswith(URI_PREFIX):
+        return path_or_uri, None
+    if not os.path.isdir(path_or_uri):
+        raise RuntimeEnvError(
+            f"runtime_env working_dir/py_modules entry {path_or_uri!r} is not "
+            f"a local directory or {URI_PREFIX} URI"
+        )
+    blob = _zip_dir(path_or_uri)
+    limit = 200 * 1024 * 1024
+    if len(blob) > limit:
+        raise RuntimeEnvError(
+            f"runtime_env package {path_or_uri!r} is {len(blob)/1e6:.0f} MB "
+            f"zipped; the limit is {limit/1e6:.0f} MB"
+        )
+    sha = hashlib.sha1(blob).hexdigest()
+    return f"{URI_PREFIX}{sha}.zip", blob
+
+
+def _zip_dir(path: str) -> bytes:
+    """Deterministic zip (sorted names, zeroed timestamps) so equal trees
+    hash equal across hosts and runs."""
+    buf = io.BytesIO()
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in DEFAULT_EXCLUDES)
+        for f in sorted(files):
+            if f.endswith(".pyc"):
+                continue
+            full = os.path.join(root, f)
+            entries.append((os.path.relpath(full, path), full))
+    entries.sort()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel, full in entries:
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            with open(full, "rb") as fh:
+                zf.writestr(info, fh.read())
+    return buf.getvalue()
+
+
+def finish_uploads(gcs_client, uploads: List[Tuple[str, bytes]]) -> None:
+    """Idempotently push packaged dirs into the GCS KV."""
+    for uri, blob in uploads:
+        key = uri[len(URI_PREFIX):].encode()
+        if not gcs_client.call("kv_exists", (KV_NS, key)):
+            gcs_client.call("kv_put", (KV_NS, key, blob, False))
+
+
+def merge(job_env: Optional[dict], task_env: Optional[dict]) -> Optional[dict]:
+    """Task env overrides the job env per-field; env_vars are merged with
+    the task's winning (reference: runtime_env.py build_proto_runtime_env
+    parent/child override semantics)."""
+    if not job_env:
+        return task_env or None
+    if not task_env:
+        return job_env or None
+    out = dict(job_env)
+    for k, v in task_env.items():
+        if k == "env_vars":
+            out["env_vars"] = {**job_env.get("env_vars", {}), **v}
+        else:
+            out[k] = v
+    return out
+
+
+def env_hash(env: Optional[dict]) -> str:
+    """Stable identity for worker-pool keying ('' = default env)."""
+    if not env:
+        return ""
+    return hashlib.sha1(
+        json.dumps(env, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:16]
+
+
+def spec_env_hash(spec) -> str:
+    """Cached env hash for a TaskSpec."""
+    h = getattr(spec, "_env_hash", None)
+    if h is None:
+        h = env_hash(spec.runtime_env)
+        try:
+            spec._env_hash = h
+        except Exception:
+            pass
+    return h
+
+
+# ----------------------------------------------------------------------
+# staging (worker side)
+# ----------------------------------------------------------------------
+class _FileLock:
+    """fcntl flock wrapper; staging must be once-per-node even when many
+    workers of the same env spawn concurrently."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f = None
+
+    def __enter__(self):
+        import fcntl
+
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._f = open(self._path, "a+")
+        fcntl.flock(self._f, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+
+        fcntl.flock(self._f, fcntl.LOCK_UN)
+        self._f.close()
+
+
+def _resources_dir(session_dir: str) -> str:
+    return os.path.join(session_dir, "runtime_resources")
+
+
+def _fetch_package(gcs_client, uri: str, dest_dir: str, session_dir: str) -> str:
+    """Download + unzip a gcs:// package into the cache; returns the
+    staged directory.  Cached by content hash (uri), so a hit is free
+    (reference: uri_cache.py)."""
+    name = uri[len(URI_PREFIX):]
+    final = os.path.join(dest_dir, name[:-4])  # strip .zip
+    if os.path.isdir(final):
+        return final
+    with _FileLock(os.path.join(dest_dir, name + ".lock")):
+        if os.path.isdir(final):
+            return final
+        # A prestarted worker can boot before the driver's upload lands
+        # in the KV (connect_driver triggers prestart, finish_uploads
+        # runs just after): retry for a short window before declaring
+        # the package missing.
+        import time as _time
+
+        deadline = _time.monotonic() + 15
+        while True:
+            blob = gcs_client.call("kv_get", (KV_NS, name.encode()), timeout=60)
+            if blob is not None:
+                break
+            if _time.monotonic() > deadline:
+                raise RuntimeEnvError(f"runtime_env package {uri} not found in GCS")
+            _time.sleep(0.2)
+        tmp = final + ".staging"
+        if os.path.isdir(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        for root, _dirs, files in os.walk(tmp):
+            for f in files:
+                full = os.path.join(root, f)
+                info_mode = os.stat(full).st_mode
+                os.chmod(full, info_mode | 0o600)
+        os.replace(tmp, final)
+    return final
+
+
+def _stage_pip(specs: List[str], dest_dir: str) -> str:
+    """``pip install --target`` into a content-addressed dir.  The
+    reference builds a full virtualenv (pip.py); --target + sys.path
+    gives the same import semantics for pure-python deps without the
+    venv spin-up cost, and works with local wheel paths offline."""
+    h = hashlib.sha1(json.dumps(specs).encode()).hexdigest()[:16]
+    final = os.path.join(dest_dir, f"pip-{h}")
+    marker = os.path.join(final, ".ray_tpu_complete")
+    if os.path.exists(marker):
+        return final
+    with _FileLock(final + ".lock"):
+        if os.path.exists(marker):
+            return final
+        cmd = [
+            sys.executable, "-m", "pip", "install",
+            "--target", final, "--no-input", "--disable-pip-version-check",
+        ] + list(specs)
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=600
+        )
+        if proc.returncode != 0:
+            raise RuntimeEnvError(
+                f"pip install of {specs} failed:\n{proc.stdout}\n{proc.stderr}"
+            )
+        with open(marker, "w") as f:
+            f.write("ok")
+    return final
+
+
+def stage_and_apply(env: Optional[dict], gcs_client, session_dir: str) -> None:
+    """Worker-process side: materialize the env and mutate this process
+    (cwd, sys.path, os.environ) to match.  Raises RuntimeEnvError on any
+    failure — the caller reports it to the raylet instead of crashing."""
+    if not env:
+        return
+    res_dir = _resources_dir(session_dir)
+    os.makedirs(res_dir, exist_ok=True)
+    if env.get("pip"):
+        target = _stage_pip(env["pip"], res_dir)
+        sys.path.insert(0, target)
+        os.environ["PYTHONPATH"] = target + os.pathsep + os.environ.get("PYTHONPATH", "")
+    for uri in reversed(env.get("py_modules", ())):
+        staged = _fetch_package(gcs_client, uri, res_dir, session_dir)
+        sys.path.insert(0, staged)
+        os.environ["PYTHONPATH"] = staged + os.pathsep + os.environ.get("PYTHONPATH", "")
+    wd = env.get("working_dir")
+    if wd:
+        staged = _fetch_package(gcs_client, wd, res_dir, session_dir)
+        os.chdir(staged)
+        sys.path.insert(0, staged)
+        os.environ["PYTHONPATH"] = staged + os.pathsep + os.environ.get("PYTHONPATH", "")
+    for k, v in (env.get("env_vars") or {}).items():
+        os.environ[k] = v

@@ -194,6 +194,9 @@ class Worker:
         self.node_id: Optional[NodeID] = None
         self.namespace: str = "default"
         self.session_info: dict = {}
+        # Job-level default runtime env (normalized); merged under any
+        # per-task/actor runtime_env at submit time.
+        self.job_runtime_env: Optional[dict] = None
         self.gcs_client: Optional[rpc.RpcClient] = None
         self.raylet_client: Optional[rpc.RpcClient] = None
         self.store: Optional[StoreClient] = None
@@ -297,13 +300,35 @@ class Worker:
         self.raylet_client = rpc.RpcClient(
             raylet_address, on_push=self._on_raylet_push, on_close=self._on_raylet_lost
         )
+        # Stage this worker's runtime env (set by the raylet at spawn)
+        # BEFORE registering: a staging failure is reported in the
+        # registration so the raylet can fail the waiting tasks instead
+        # of respawning us in a loop.
+        runtime_env_error = None
+        renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+        if renv_json:
+            import json as _json
+            import tempfile
+
+            from ray_tpu._private import runtime_env as runtime_env_mod
+
+            try:
+                runtime_env_mod.stage_and_apply(
+                    _json.loads(renv_json),
+                    self.gcs_client,
+                    os.environ.get("RAY_TPU_SESSION_DIR") or tempfile.gettempdir(),
+                )
+            except Exception as e:
+                runtime_env_error = f"{type(e).__name__}: {e}"
         # Host a direct RPC endpoint before registering so the raylet can
         # hand our address to lease holders (reference: CoreWorkerService).
         self._start_direct_server(raylet_address)
-        reply = self.raylet_client.call(
-            "register_worker",
-            {"worker_id": self.worker_id.binary(), "address": self.direct_address},
-        )
+        payload = {"worker_id": self.worker_id.binary(), "address": self.direct_address}
+        if runtime_env_error:
+            payload["runtime_env_error"] = runtime_env_error
+        reply = self.raylet_client.call("register_worker", payload)
+        if runtime_env_error:
+            raise RuntimeError(f"runtime_env setup failed: {runtime_env_error}")
         if not reply.get("ok"):
             raise RuntimeError("raylet rejected worker registration")
         job_config = reply.get("job_config", {})
@@ -314,6 +339,15 @@ class Worker:
                 _sys.path.insert(0, p)
         self.namespace = job_config.get("namespace", "default")
         self.session_info = {"session_dir": job_config.get("session_dir")}
+        # Nested tasks inherit THIS worker's env (already job-env-merged
+        # by the parent submitter), not the bare job env — matching the
+        # reference's parent-inheritance semantics.
+        if renv_json:
+            import json as _json
+
+            self.job_runtime_env = _json.loads(renv_json) or None
+        else:
+            self.job_runtime_env = job_config.get("runtime_env") or None
         self.store = StoreClient(self.raylet_client, os.environ["RAY_TPU_STORE_DIR"])
         self.connected = True
         if CONFIG.direct_task_submission:
@@ -716,6 +750,27 @@ class Worker:
         base_actor = self.actor_id or ActorID.nil_of(self.job_id)
         return TaskID.of(base_actor)
 
+    def _effective_runtime_env(self, options: dict) -> Optional[dict]:
+        """Normalize the per-task runtime_env (uploading local dirs once —
+        the normalized form is cached in the options dict, which lives on
+        the RemoteFunction/ActorClass) and merge it over the job env."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        raw = options.get("runtime_env")
+        if not raw:
+            return self.job_runtime_env
+        # Cache key includes the session: a RemoteFunction reused across
+        # shutdown()+init() must re-upload its packages to the new GCS.
+        session = self.session_info.get("session_dir") or ""
+        cached = options.get("_runtime_env_norm")
+        if cached is not None and cached[0] == session:
+            norm = cached[1]
+        else:
+            norm, uploads = runtime_env_mod.prepare(raw)
+            runtime_env_mod.finish_uploads(self.gcs_client, uploads)
+            options["_runtime_env_norm"] = (session, norm)
+        return runtime_env_mod.merge(self.job_runtime_env, norm or None)
+
     def submit_task(self, fn_blob: bytes, name: str, args, kwargs, options: dict) -> List[ObjectRef]:
         self._check_connected()
         key = self._push_function(fn_blob)
@@ -733,7 +788,7 @@ class Worker:
             retry_exceptions=options.get("retry_exceptions", False),
             scheduling_strategy=_resolve_strategy(options),
             owner_worker_id=self.worker_id,
-            runtime_env=options.get("runtime_env"),
+            runtime_env=self._effective_runtime_env(options),
         )
         if CONFIG.lineage_reconstruction_enabled:
             for oid in spec.return_ids():
@@ -803,7 +858,7 @@ class Worker:
             detached=options.get("lifetime") == "detached",
             scheduling_strategy=_resolve_strategy(options),
             owner_worker_id=self.worker_id,
-            runtime_env=options.get("runtime_env"),
+            runtime_env=self._effective_runtime_env(options),
         )
         self.gcs_client.call("register_actor", {"spec": spec})
         return actor_id
