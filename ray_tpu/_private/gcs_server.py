@@ -802,7 +802,11 @@ class GcsServer:
         self.placement_groups[pg.pg_id] = pg
         if pg.name:
             self.named_pgs[pg.name] = pg.pg_id
-        await self._schedule_pg(pg)
+        # Scheduling runs in the background: a slow/retrying 2-phase
+        # commit (node churn, dropped RPCs) must not stall the creating
+        # client's call — it polls the state via get_placement_group
+        # (reference: gcs_placement_group_manager.h async creation).
+        self.loop.create_task(self._schedule_pg(pg))
         return {"pg_id": pg.pg_id.binary(), "state": pg.state}
 
     def _pg_node_assignment(self, pg: PlacementGroupInfo) -> Optional[List[NodeID]]:
@@ -848,6 +852,8 @@ class GcsServer:
         return assignment
 
     async def _schedule_pg(self, pg: PlacementGroupInfo):
+        if pg.state == "REMOVED":
+            return  # removed while queued
         assignment = self._pg_node_assignment(pg)
         if assignment is None:
             pg._queued = True  # retried by _kick_pending
@@ -872,24 +878,54 @@ class GcsServer:
             except Exception:
                 ok = False
                 break
-        if not ok:
-            for node_id, idx in prepared:
-                client = self.node_clients.get(node_id)
-                if client:
-                    try:
-                        await client.call("return_bundle", {"pg_id": pg.pg_id.binary(), "bundle_index": idx})
-                    except Exception:
-                        pass
-            pg._queued = True
+        if not ok or pg.state == "REMOVED":
+            await self._rollback_bundles(pg, prepared)
+            if pg.state != "REMOVED":
+                pg._queued = True
             return
-        # Phase 2: commit.
-        for (node_id, idx) in prepared:
-            client = self.node_clients.get(node_id)
-            await client.call("commit_bundle", {"pg_id": pg.pg_id.binary(), "bundle_index": idx})
-            pg.bundles[idx].node_id = node_id
+        # Phase 2: commit.  A failed/lost commit (node died, reply dropped)
+        # must not leave the PG wedged in PENDING: roll every bundle back
+        # and requeue the whole group (commit_bundle and return_bundle are
+        # both idempotent on the raylet side).
+        try:
+            for (node_id, idx) in prepared:
+                client = self.node_clients.get(node_id)
+                if client is None:
+                    raise rpc.RpcError(f"node {node_id.hex()[:8]} vanished before commit")
+                await client.call(
+                    "commit_bundle", {"pg_id": pg.pg_id.binary(), "bundle_index": idx}
+                )
+                pg.bundles[idx].node_id = node_id
+        except Exception:
+            logger.exception("PG %s commit failed; rolling back", pg.pg_id.hex()[:8])
+            await self._rollback_bundles(pg, prepared)
+            if pg.state != "REMOVED":
+                pg._queued = True
+                self.loop.call_later(0.5, self._kick_pending)
+            return
+        if pg.state == "REMOVED":
+            # remove_placement_group raced the commit (creation runs in
+            # the background since it stopped blocking the create call):
+            # the group must not resurrect, and every committed bundle
+            # must go back to its node.
+            await self._rollback_bundles(pg, prepared)
+            return
         pg.state = "CREATED"
         self.publish("placement_groups", {"pg_id": pg.pg_id.binary(), "state": "CREATED"})
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": "CREATED"})
+
+    async def _rollback_bundles(self, pg: PlacementGroupInfo, prepared):
+        for node_id, idx in prepared:
+            client = self.node_clients.get(node_id)
+            if client:
+                try:
+                    await client.call(
+                        "return_bundle",
+                        {"pg_id": pg.pg_id.binary(), "bundle_index": idx},
+                    )
+                except Exception:
+                    pass
+            pg.bundles[idx].node_id = None
 
     async def _remove_pg(self, pg: PlacementGroupInfo):
         pg.state = "REMOVED"

@@ -110,6 +110,9 @@ class Raylet:
         # In-flight object_location_add pushes, by object id (see
         # _on_object_sealed for why seal RPCs await these).
         self._seal_reports: Dict[bytes, asyncio.Task] = {}
+        # Tail of the per-object location add/remove push chain (ordering
+        # guard — see _push_location_ordered).
+        self._loc_chain: Dict[bytes, asyncio.Task] = {}
 
         # Worker pool; idle queues keyed by (job_id, runtime-env hash).
         self.workers: Dict[WorkerID, WorkerHandle] = {}
@@ -119,7 +122,6 @@ class Raylet:
         # RuntimeEnvSetupError instead of spawn-looping.
         self.bad_runtime_envs: Dict[str, Tuple[str, float]] = {}
         self.actor_workers: Dict[ActorID, WorkerHandle] = {}
-        self.num_starting = 0
         self.job_configs: Dict[JobID, dict] = {}
 
         # Task queues
@@ -376,7 +378,6 @@ class Raylet:
         w.actor_id = actor_id
         w.env_hash = runtime_env_mod.env_hash(runtime_env)
         self.workers[worker_id] = w
-        self.num_starting += 1
         return w
 
     async def rpc_register_worker(self, payload, conn):
@@ -392,7 +393,6 @@ class Raylet:
             # respawn it in a loop (reference: runtime-env agent surfaces
             # RuntimeEnvSetupError the same way).
             msg = payload["runtime_env_error"]
-            self.num_starting = max(0, self.num_starting - 1)
             self.bad_runtime_envs[w.env_hash] = (msg, time.monotonic())
             self._fail_queued_for_env(w.env_hash, msg)
             self._kill_worker_proc(w)
@@ -406,7 +406,6 @@ class Raylet:
                 )
             except rpc.RpcError:
                 pass
-        self.num_starting = max(0, self.num_starting - 1)
         w.conn = conn
         w.direct_address = payload.get("address")
         w.state = "IDLE"
@@ -1058,34 +1057,66 @@ class Raylet:
     def _on_object_sealed(self, object_id: ObjectID):
         if self.gcs is not None and self.gcs._connected:
             key = object_id.binary()
-            task = self.loop.create_task(
-                self._safe_gcs_push("object_location_add", (key, self.node_id.binary()))
-            )
-            # Kept so the seal RPC handlers can await the GCS ack before
-            # replying: a ref must not escape this node (e.g. in a direct
-            # worker->driver task result) before the GCS knows the object
-            # exists, or losing the node makes object_lost_check report
-            # "never sealed" and the borrower's get hangs to timeout
-            # instead of raising ObjectLostError.
+            # The returned task is kept so the seal RPC handlers can await
+            # the GCS ack before replying: a ref must not escape this node
+            # (e.g. in a direct worker->driver task result) before the GCS
+            # knows the object exists, or losing the node makes
+            # object_lost_check report "never sealed" and the borrower's
+            # get hangs to timeout instead of raising ObjectLostError.
+            task = self._push_location_ordered(key, "object_location_add")
             self._seal_reports[key] = task
             task.add_done_callback(lambda _t, k=key: self._seal_reports.pop(k, None))
 
     def _on_object_evicted(self, object_id: ObjectID):
         if self.gcs is not None and self.gcs._connected:
-            self.loop.create_task(
-                self._safe_gcs_push("object_location_remove", (object_id.binary(), self.node_id.binary()))
-            )
+            self._push_location_ordered(object_id.binary(), "object_location_remove")
 
-    async def _safe_gcs_push(self, method, payload):
-        try:
-            await self.gcs.call(method, payload, timeout=10)
-        except rpc.RpcError:
-            pass
+    def _push_location_ordered(self, key: bytes, method: str) -> asyncio.Task:
+        """Location add/remove pushes for one object are chained so a
+        retried add can never land AFTER the remove that followed it
+        (seal -> evict must leave the GCS with no location, not a stale
+        one)."""
+        prev = self._loc_chain.get(key)
+
+        async def run():
+            if prev is not None:
+                await prev
+            await self._safe_gcs_push(method, (key, self.node_id.binary()))
+
+        task = self.loop.create_task(run())
+        self._loc_chain[key] = task
+
+        def _cleanup(_t, k=key, me=task):
+            if self._loc_chain.get(k) is me:
+                del self._loc_chain[k]
+
+        task.add_done_callback(_cleanup)
+        return task
+
+    async def _safe_gcs_push(self, method, payload, retries: int = 3):
+        """Best-effort GCS call with bounded retries — object location
+        add/remove must survive transient drops (a location report lost
+        forever makes a live object look 'never sealed' to lost-object
+        checks, wedging cross-node gets)."""
+        for attempt in range(retries):
+            try:
+                await self.gcs.call(method, payload, timeout=10)
+                return
+            except rpc.RpcError:
+                if attempt + 1 < retries:
+                    await asyncio.sleep(0.2 * (attempt + 1))
 
     async def _await_seal_report(self, oid_bytes: bytes):
         task = self._seal_reports.get(oid_bytes)
         if task is not None:
-            await asyncio.shield(task)
+            # Bounded: during a GCS outage the full retry budget is ~30s
+            # and the ack is lost anyway — don't stall every put on the
+            # task-result hot path for it (availability over the escape-
+            # ordering guarantee while the GCS is down).
+            try:
+                await asyncio.wait_for(asyncio.shield(task), timeout=10)
+            except asyncio.TimeoutError:
+                pass
 
     async def rpc_store_put_inline(self, payload, conn):
         oid_bytes, data = payload

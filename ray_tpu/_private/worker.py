@@ -197,6 +197,9 @@ class Worker:
         # Job-level default runtime env (normalized); merged under any
         # per-task/actor runtime_env at submit time.
         self.job_runtime_env: Optional[dict] = None
+        # (session, canonical-json raw env) -> normalized env; avoids
+        # re-zipping/re-uploading working_dirs on every .remote().
+        self._runtime_env_norm_cache: Dict[Tuple[str, str], dict] = {}
         self.gcs_client: Optional[rpc.RpcClient] = None
         self.raylet_client: Optional[rpc.RpcClient] = None
         self.store: Optional[StoreClient] = None
@@ -751,24 +754,33 @@ class Worker:
         return TaskID.of(base_actor)
 
     def _effective_runtime_env(self, options: dict) -> Optional[dict]:
-        """Normalize the per-task runtime_env (uploading local dirs once —
-        the normalized form is cached in the options dict, which lives on
-        the RemoteFunction/ActorClass) and merge it over the job env."""
+        """Normalize the per-task runtime_env (zipping + uploading local
+        dirs once per distinct env per session — .remote() passes a fresh
+        copy of the options dict each call, so the cache lives on the
+        worker, keyed by the env's canonical JSON) and merge it over the
+        job env.  Local dir contents are snapshotted at first use in a
+        session, like the reference's upload-at-decoration semantics."""
+        import json as _json
+
         from ray_tpu._private import runtime_env as runtime_env_mod
 
         raw = options.get("runtime_env")
         if not raw:
             return self.job_runtime_env
-        # Cache key includes the session: a RemoteFunction reused across
+        # Key includes the session: a RemoteFunction reused across
         # shutdown()+init() must re-upload its packages to the new GCS.
-        session = self.session_info.get("session_dir") or ""
-        cached = options.get("_runtime_env_norm")
-        if cached is not None and cached[0] == session:
-            norm = cached[1]
-        else:
-            norm, uploads = runtime_env_mod.prepare(raw)
+        key = (
+            self.session_info.get("session_dir") or "",
+            _json.dumps(raw, sort_keys=True, default=str),
+        )
+        with self._lock:
+            norm = self._runtime_env_norm_cache.get(key)
+        if norm is None:
+            prepared, uploads = runtime_env_mod.prepare(raw)
             runtime_env_mod.finish_uploads(self.gcs_client, uploads)
-            options["_runtime_env_norm"] = (session, norm)
+            norm = prepared if prepared is not None else {}
+            with self._lock:
+                self._runtime_env_norm_cache[key] = norm
         return runtime_env_mod.merge(self.job_runtime_env, norm or None)
 
     def submit_task(self, fn_blob: bytes, name: str, args, kwargs, options: dict) -> List[ObjectRef]:

@@ -1,0 +1,193 @@
+"""Chaos tests: inject RPC drops via the testing_rpc_failure hook and
+kill raylets mid-run (reference: src/ray/rpc/rpc_chaos.h:23 +
+RayletKiller in python/ray/_private/test_utils.py:1496).
+
+The hook spec "method:kind:count" drops the first `count` requests
+(kind=req: handler never runs) or replies (kind=rep: handler ran, caller
+never hears) of `method`, independently in each server process.  It is
+configured through the RAY_TPU_testing_rpc_failure env var, which every
+spawned cluster process inherits; rpc_call_timeout_s is lowered so
+dropped calls fail fast instead of waiting out the 120 s default.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def chaos_cluster(request):
+    """Per-test cluster factory: set chaos env vars BEFORE processes
+    spawn, clean them up after."""
+    created = []
+    saved = {}
+
+    def make(env: dict, head_args=None, nodes=()):
+        for k, v in env.items():
+            saved.setdefault(k, os.environ.get(k))
+            os.environ[k] = v
+        c = Cluster(
+            initialize_head=True, head_node_args=head_args or {"num_cpus": 2}
+        )
+        handles = [c.add_node(**kw) for kw in nodes]
+        c.wait_for_nodes()
+        ray_tpu.init(address=c.address)
+        created.append(c)
+        return c, handles
+
+    yield make
+    ray_tpu.shutdown()
+    for c in created:
+        c.shutdown()
+    for k, old in saved.items():
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+
+
+def test_location_report_dropped(chaos_cluster):
+    """The raylet's object_location_add push to the GCS is dropped once;
+    the bounded retry must land it so a cross-node get still works."""
+    _, _ = chaos_cluster(
+        {"RAY_TPU_testing_rpc_failure": "object_location_add:req:1"},
+        nodes=[{"num_cpus": 1, "resources": {"side": 1}}],
+    )
+
+    @ray_tpu.remote(resources={"side": 0.1})
+    def make():
+        return ray_tpu.put(np.arange(200_000))
+
+    inner = ray_tpu.get(make.remote(), timeout=60)
+    # Fetch the put object across nodes: requires the (retried) location.
+    arr = ray_tpu.get(inner, timeout=90)
+    assert int(arr.sum()) == 19999900000
+
+
+def test_lost_check_dropped_during_recovery(chaos_cluster):
+    """Lineage reconstruction still happens when the GCS drops the first
+    object_lost_check probes — the pull loop keeps asking."""
+    c, [node] = chaos_cluster(
+        {"RAY_TPU_testing_rpc_failure": "object_lost_check:req:2"},
+        nodes=[{"num_cpus": 1, "resources": {"doomed": 1}}],
+    )
+
+    @ray_tpu.remote(resources={"doomed": 0.1}, max_retries=3)
+    def produce():
+        return np.full(150_000, 7.0)
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref, timeout=60).sum() == 7.0 * 150_000
+    c.remove_node(node)
+    c.add_node(num_cpus=1, resources={"doomed": 1})
+    c.wait_for_nodes()
+    # Every copy died with the node; the owner must resubmit produce()
+    # even though the first lost-checks are eaten.
+    assert ray_tpu.get(ref, timeout=120).sum() == 7.0 * 150_000
+
+
+def test_pg_prepare_reply_dropped(chaos_cluster):
+    """2-phase PG creation: a dropped prepare reply looks like a failed
+    node; the GCS must roll back and retry until the group commits."""
+    chaos_cluster(
+        {
+            "RAY_TPU_testing_rpc_failure": "prepare_bundle:rep:1",
+            "RAY_TPU_rpc_call_timeout_s": "6",
+        },
+        head_args={"num_cpus": 4},
+    )
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=90)
+    assert len(pg.bundle_specs) == 2
+
+
+def test_pg_commit_reply_dropped(chaos_cluster):
+    """A dropped commit reply must not wedge the group in PENDING: the
+    GCS rolls the bundles back and reschedules."""
+    chaos_cluster(
+        {
+            "RAY_TPU_testing_rpc_failure": "commit_bundle:rep:1",
+            "RAY_TPU_rpc_call_timeout_s": "6",
+        },
+        head_args={"num_cpus": 4},
+    )
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=90)
+
+    # The committed group is actually usable.
+    @ray_tpu.remote(num_cpus=1)
+    def inside():
+        return "ok"
+
+    from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+    ref = inside.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+    ).remote()
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+
+
+def test_worker_lease_reply_dropped(chaos_cluster):
+    """Direct task submission: a dropped lease grant strands a LEASED
+    worker on the raylet side and returns None to the submitter — the
+    submitter's reaper must re-request and tasks still complete."""
+    chaos_cluster(
+        {
+            "RAY_TPU_testing_rpc_failure": "request_worker_lease:rep:1",
+            "RAY_TPU_worker_lease_timeout_ms": "6000",
+        }
+    )
+
+    @ray_tpu.remote
+    def f(i):
+        return i * 2
+
+    out = ray_tpu.get([f.remote(i) for i in range(20)], timeout=120)
+    assert out == [i * 2 for i in range(20)]
+
+
+def test_register_worker_reply_dropped(chaos_cluster):
+    """A worker whose registration reply is eaten dies; the pool must
+    spawn a replacement and tasks still run."""
+    chaos_cluster(
+        {
+            "RAY_TPU_testing_rpc_failure": "register_worker:rep:1",
+            "RAY_TPU_rpc_call_timeout_s": "6",
+        }
+    )
+
+    @ray_tpu.remote
+    def f():
+        return os.getpid()
+
+    assert ray_tpu.get(f.remote(), timeout=90) > 0
+
+
+def test_raylet_killer_tasks_retry(chaos_cluster):
+    """Kill a node's raylet (SIGKILL) while its tasks are in flight;
+    retriable tasks reschedule onto the surviving node."""
+    c, [node] = chaos_cluster(
+        {},
+        head_args={"num_cpus": 2},
+        nodes=[{"num_cpus": 2}],
+    )
+
+    @ray_tpu.remote(max_retries=5)
+    def slow(i):
+        time.sleep(0.4)
+        return i
+
+    refs = [slow.remote(i) for i in range(16)]
+    time.sleep(1.0)  # let tasks spread to both nodes
+    c.remove_node(node)  # SIGKILL mid-flight
+    out = ray_tpu.get(refs, timeout=180)
+    assert out == list(range(16))
