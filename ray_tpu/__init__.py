@@ -23,6 +23,7 @@ from ray_tpu import exceptions
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.streaming import ObjectRefGenerator
 from ray_tpu._private.worker import get_global_worker, global_worker_maybe
 from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
@@ -47,6 +48,7 @@ __all__ = [
     "available_resources",
     "get_runtime_context",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "exceptions",
     "__version__",

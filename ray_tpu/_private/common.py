@@ -132,9 +132,19 @@ class TaskSpec:
     # (reference: task_manager.h:212 lineage pinning + retry accounting).
     reconstructions: int = 0
     detached: bool = False
+    # num_returns="streaming": the task is a generator whose yields are
+    # sealed incrementally as return indices 1..N; return index 0 is the
+    # end-of-stream sentinel (item count, or the task's error).
+    # Reference: core_worker/generator_waiter.h + ObjectRefGenerator.
+    is_streaming: bool = False
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def stream_item_id(self, index: int) -> ObjectID:
+        """ObjectID of the index-th yielded item (0-based) of a streaming
+        task; slot 0 is reserved for the end-of-stream sentinel."""
+        return ObjectID.for_task_return(self.task_id, index + 1)
 
 
 @dataclass

@@ -235,6 +235,9 @@ class DirectTaskSubmitter:
 
     # ------------------------------------------------------------------
     def _on_worker_push(self, wid: bytes, ks: _KeyState, method: str, payload) -> None:
+        if method == "stream_item":
+            self._worker._on_stream_item(payload)
+            return
         if method != "task_finished":
             return
         ms = self._worker.memory_store
@@ -242,6 +245,7 @@ class DirectTaskSubmitter:
             if ms.put(oid, blob):
                 self._worker.promote_blob(oid, blob)
         ms.resolve_stored(payload.get("stored", ()))
+        self._worker._notify_stream_finished(payload["task_id"])
         with self._lock:
             lease = ks.leases.get(wid)
             if lease is None:
@@ -412,6 +416,9 @@ class ActorDirectChannel:
                 raise
 
     def _on_push(self, method: str, payload) -> None:
+        if method == "stream_item":
+            self.worker._on_stream_item(payload)
+            return
         if method != "task_finished":
             return
         ms = self.worker.memory_store
@@ -419,6 +426,7 @@ class ActorDirectChannel:
             if ms.put(oid, blob):
                 self.worker.promote_blob(oid, blob)
         ms.resolve_stored(payload.get("stored", ()))
+        self.worker._notify_stream_finished(payload["task_id"])
         self.inflight.pop(payload["task_id"], None)
 
     def _on_close(self) -> None:

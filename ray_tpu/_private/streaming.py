@@ -1,0 +1,210 @@
+"""Streaming generators: ``num_returns="streaming"``.
+
+Reference: src/ray/core_worker/generator_waiter.h + ObjectRefGenerator in
+python/ray/_raylet.pyx; used pervasively by the reference Data executor
+so a consumer can start on the first yielded block before the producer
+finishes.
+
+Protocol here: a streaming task's yields are sealed incrementally as
+return indices 1..N of the task (``TaskSpec.stream_item_id``); return
+index 0 is the end-of-stream sentinel — a :class:`StreamEnd` carrying the
+item count on success, or the task's error.  On the direct call paths the
+executing worker pushes a ``stream_item`` message per yield over the same
+connection that later carries ``task_finished`` (socket FIFO ⇒ items are
+seen before the end).  On the raylet-mediated path there are no pushes;
+the owner's generator falls back to polling the store, where the items
+and the sentinel were sealed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class StreamEnd:
+    """End-of-stream sentinel stored as a streaming task's return 0."""
+
+    __slots__ = ("count",)
+
+    def __init__(self, count: int):
+        self.count = count
+
+    def __reduce__(self):
+        return (StreamEnd, (self.count,))
+
+    def __repr__(self):
+        return f"StreamEnd(count={self.count})"
+
+
+class _StreamState:
+    """Owner-side arrival log for one streaming task."""
+
+    __slots__ = ("cond", "arrived", "finished")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        # item index -> True once its object is fetchable.
+        self.arrived: Dict[int, bool] = {}
+        # task_finished seen (sentinel resolvable).
+        self.finished = False
+
+    def on_item(self, index: int):
+        with self.cond:
+            self.arrived[index] = True
+            self.cond.notify_all()
+
+    def on_finished(self):
+        with self.cond:
+            self.finished = True
+            self.cond.notify_all()
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs for a streaming task's yields, in yield
+    order.  ``next()`` blocks until the next item is ready; when the task
+    finishes it raises StopIteration (or the task's error, re-raised at
+    the position the task failed)."""
+
+    def __init__(self, worker, spec):
+        self._worker = worker
+        self._spec = spec
+        self._task_id = spec.task_id
+        self._consumed = 0
+        self._count: Optional[int] = None  # known once the sentinel reads
+        self._error: Optional[Exception] = None
+        self._state = worker._register_stream(spec)
+        self._last_poll = time.monotonic()
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next_internal(timeout=None)
+
+    def next(self, timeout: Optional[float] = None) -> ObjectRef:
+        return self._next_internal(timeout)
+
+    def _item_ref(self) -> ObjectRef:
+        ref = ObjectRef(self._spec.stream_item_id(self._consumed), owned=True)
+        self._consumed += 1
+        return ref
+
+    def _resolve_sentinel(self):
+        """Read return 0: StreamEnd(count) or raises the task error."""
+        sentinel = ObjectRef(self._spec.return_ids()[0], owned=False)
+        value = self._worker.get([sentinel], timeout=30)[0]
+        if isinstance(value, StreamEnd):
+            self._count = value.count
+        else:  # pragma: no cover — get() re-raises stored errors
+            raise RuntimeError(f"unexpected stream sentinel: {value!r}")
+
+    def _next_internal(self, timeout: Optional[float]) -> ObjectRef:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        state = self._state
+        while True:
+            with state.cond:
+                if self._consumed in state.arrived:
+                    del state.arrived[self._consumed]
+                    return self._item_ref()
+                finished = state.finished
+            if self._count is not None or finished:
+                if self._count is None:
+                    self._resolve_sentinel()  # raises the task's error
+                if self._consumed < self._count:
+                    # Sentinel read but this item's push never arrived
+                    # (raylet-mediated path, or push raced shutdown): the
+                    # item is sealed in the store — hand out its ref.
+                    return self._item_ref()
+                self._worker._drop_stream(self._task_id)
+                raise StopIteration
+            # Raylet-mediated fallback: no pushes arrive at all — probe
+            # for the next item / the sentinel (rate-limited; on the push
+            # path these probes can never win, so they're pure overhead).
+            now = time.monotonic()
+            if now - self._last_poll > 0.2:
+                self._last_poll = now
+                if self._store_has(self._spec.stream_item_id(self._consumed)):
+                    return self._item_ref()
+                if self._store_has(self._spec.return_ids()[0]):
+                    state.on_finished()
+                    continue
+            if deadline is not None and time.monotonic() > deadline:
+                from ray_tpu import exceptions
+
+                raise exceptions.GetTimeoutError(
+                    f"no stream item from {self._spec.name} within {timeout}s"
+                )
+            with state.cond:
+                state.cond.wait(0.05)
+
+    def try_next(self) -> Optional[ObjectRef]:
+        """Non-blocking: the next item's ref if ready, None otherwise;
+        raises StopIteration (or the task's error) at end of stream.
+        Push-path checks are pure-local; the store fallback (for
+        raylet-mediated submissions) is rate-limited to one probe per
+        200 ms so pollers don't hammer the raylet with RPCs."""
+        state = self._state
+        with state.cond:
+            if self._consumed in state.arrived:
+                del state.arrived[self._consumed]
+                return self._item_ref()
+            finished = state.finished
+        if self._count is not None or finished:
+            if self._count is None:
+                self._resolve_sentinel()  # raises the task's error
+            if self._consumed < self._count:
+                return self._item_ref()
+            self._worker._drop_stream(self._task_id)
+            raise StopIteration
+        now = time.monotonic()
+        if now - self._last_poll > 0.2:
+            self._last_poll = now
+            if self._store_has(self._spec.stream_item_id(self._consumed)):
+                return self._item_ref()
+            if self._store_has(self._spec.return_ids()[0]):
+                state.on_finished()
+        return None
+
+    def _store_has(self, oid: ObjectID) -> bool:
+        """Cluster-wide existence probe via the GCS object directory —
+        on the raylet-mediated path the items are sealed on the executing
+        node, which need not be the owner's (a local store_contains would
+        never see them)."""
+        try:
+            if self._worker.gcs_client.call(
+                "object_locations_get", oid.binary(), timeout=10
+            ):
+                return True
+            # Small objects can live only in the owner's raylet store
+            # (inline put), which reports locations too — but check
+            # locally as a cheap belt-and-braces fallback.
+            return bool(
+                self._worker.raylet_client.call("store_contains", oid.binary(), timeout=10)
+            )
+        except Exception:
+            return False
+
+    # -- conveniences ---------------------------------------------------
+    def __del__(self):
+        try:
+            self._worker._drop_stream(self._task_id)
+        except Exception:
+            pass
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration

@@ -248,6 +248,9 @@ class Worker:
         self._actor_caller_inc: Dict[bytes, int] = {}
         # Direct channels to actor workers: actor_id -> _ActorChannel.
         self._actor_channels: Dict[ActorID, Any] = {}
+        # Owner-side streaming-generator state: task_id bytes -> _StreamState
+        # (reference: core_worker ObjectRefGenerator bookkeeping).
+        self._streams: Dict[bytes, Any] = {}
 
     # ------------------------------------------------------------------
     # connection
@@ -445,6 +448,22 @@ class Worker:
         self.gcs_client = None
         self.raylet_client = None
         self.store = None
+        # Reset session-scoped state: the Worker instance is reused across
+        # shutdown()+init(), and a fresh GCS restarts job ids at 1 — so a
+        # (job_id + blob-hash) function key from the OLD session collides
+        # with the new one and _push_function would silently skip the
+        # upload ("function missing from GCS" on the new cluster).
+        self._pushed_functions.clear()
+        self._function_cache.clear()
+        self.lineage.clear()
+        self._streams.clear()
+        self._recovery_inflight.clear()
+        self._actor_seq.clear()
+        self._actor_send_inc.clear()
+        self._runtime_env_norm_cache.clear()
+        self.job_runtime_env = None
+        self.memory_store = MemoryStore()
+        self.actor_cache = ActorStateCache(self)
 
     # ------------------------------------------------------------------
     # pushes
@@ -783,10 +802,15 @@ class Worker:
                 self._runtime_env_norm_cache[key] = norm
         return runtime_env_mod.merge(self.job_runtime_env, norm or None)
 
-    def submit_task(self, fn_blob: bytes, name: str, args, kwargs, options: dict) -> List[ObjectRef]:
+    def submit_task(self, fn_blob: bytes, name: str, args, kwargs, options: dict):
+        """Returns the List[ObjectRef] of the task's returns, or an
+        ObjectRefGenerator when num_returns="streaming"."""
         self._check_connected()
         key = self._push_function(fn_blob)
         num_returns = options.get("num_returns", 1)
+        is_streaming = num_returns == "streaming"
+        if is_streaming:
+            num_returns = 1  # return 0 is the end-of-stream sentinel
         resources = _resolve_resources(options, default_cpu=1.0)
         spec = TaskSpec(
             task_id=self._next_task_id(),
@@ -801,8 +825,18 @@ class Worker:
             scheduling_strategy=_resolve_strategy(options),
             owner_worker_id=self.worker_id,
             runtime_env=self._effective_runtime_env(options),
+            is_streaming=is_streaming,
         )
-        if CONFIG.lineage_reconstruction_enabled:
+        generator = None
+        if is_streaming:
+            # Register before submitting: items can start arriving the
+            # moment the spec is pushed.  Yielded items are not covered by
+            # lineage reconstruction (stream state is consumed as it
+            # arrives), so streaming tasks are not retried for lost items.
+            from ray_tpu._private.streaming import ObjectRefGenerator
+
+            generator = ObjectRefGenerator(self, spec)
+        if CONFIG.lineage_reconstruction_enabled and not is_streaming:
             for oid in spec.return_ids():
                 self.lineage[oid.binary()] = spec
         if (
@@ -818,7 +852,48 @@ class Worker:
                 self.raylet_client.call("submit_task", {"spec": spec})
         else:
             self.raylet_client.call("submit_task", {"spec": spec})
+        if generator is not None:
+            return generator
         return [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
+
+    # ------------------------------------------------------------------
+    # streaming generators (owner side)
+    # ------------------------------------------------------------------
+    def _register_stream(self, spec: TaskSpec):
+        from ray_tpu._private.streaming import _StreamState
+
+        state = _StreamState()
+        with self._lock:
+            self._streams[spec.task_id.binary()] = state
+        return state
+
+    def _drop_stream(self, task_id):
+        with self._lock:
+            self._streams.pop(task_id.binary() if hasattr(task_id, "binary") else task_id, None)
+
+    def _on_stream_item(self, payload: dict):
+        """A yielded item arrived from the executing worker (pushed on the
+        direct/actor channel, before its task_finished)."""
+        tid = payload["task_id"]
+        state = self._streams.get(tid)
+        if state is None:
+            # Generator abandoned: discard — retaining blobs nobody will
+            # ever consume leaks the owner's memory store for the rest of
+            # the stream.
+            return
+        blob = payload.get("inline")
+        if blob is not None:
+            oid = payload["oid"]
+            ms = self.memory_store
+            ms.add_pending([oid])
+            if ms.put(oid, blob):
+                self.promote_blob(oid, blob)
+        state.on_item(payload["index"])
+
+    def _notify_stream_finished(self, task_id_bytes: bytes):
+        state = self._streams.get(task_id_bytes)
+        if state is not None:
+            state.on_finished()
 
     def promote_blob(self, oid_bytes: bytes, blob: bytes):
         """Copy a memory-store object into the shm store so non-owners can
@@ -875,9 +950,12 @@ class Worker:
         self.gcs_client.call("register_actor", {"spec": spec})
         return actor_id
 
-    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict) -> List[ObjectRef]:
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict):
         self._check_connected()
         num_returns = options.get("num_returns", 1)
+        is_streaming = num_returns == "streaming"
+        if is_streaming:
+            num_returns = 1
         # sequence_number is assigned at SEND time (_send_actor_task), per
         # actor incarnation, so queued/retried specs renumber consistently.
         spec = TaskSpec(
@@ -892,7 +970,13 @@ class Worker:
             actor_id=actor_id,
             method_name=method_name,
             owner_worker_id=self.worker_id,
+            is_streaming=is_streaming,
         )
+        generator = None
+        if is_streaming:
+            from ray_tpu._private.streaming import ObjectRefGenerator
+
+            generator = ObjectRefGenerator(self, spec)
         refs = [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
         if CONFIG.direct_actor_calls:
             # Mark returns in-flight now: gets wait on the memory store
@@ -912,7 +996,7 @@ class Worker:
             )
         else:
             self._send_actor_task(spec, info)
-        return refs
+        return generator if generator is not None else refs
 
     def _send_actor_task(self, spec: TaskSpec, info: dict):
         oids = [o.binary() for o in spec.return_ids()]
@@ -1177,9 +1261,9 @@ class Worker:
             if spec.is_actor_creation:
                 self._execute_actor_creation(spec, sink)
             elif spec.is_actor_task:
-                self._execute_actor_method(spec, sink)
+                self._execute_actor_method(spec, sink, conn)
             else:
-                self._execute_normal_task(spec, sink)
+                self._execute_normal_task(spec, sink, conn)
         finally:
             self.current_spec = None
             self.current_task_id = None
@@ -1237,16 +1321,54 @@ class Worker:
                 if sink is not None:
                     sink["stored"].append(oid.binary())
 
-    def _execute_normal_task(self, spec: TaskSpec, sink=None):
+    def _execute_normal_task(self, spec: TaskSpec, sink=None, conn=None):
         try:
             fn = self._fetch_function(spec.function_key)
             args, kwargs = self._resolve_args(spec)
             result = fn(*args, **kwargs)
-            self._store_returns(spec, result, sink)
+            if spec.is_streaming:
+                self._drain_stream(spec, result, sink, conn)
+            else:
+                self._store_returns(spec, result, sink)
         except Exception as e:  # noqa: BLE001
             self._store_error_returns(
                 spec, exceptions.RayTaskError.from_exception(e, spec.name), sink
             )
+
+    def _emit_stream_item(self, spec: TaskSpec, index: int, value, conn) -> None:
+        """Seal one yielded item and announce it to the owner immediately
+        (reference: generator_waiter.h — report before continuing)."""
+        oid = spec.stream_item_id(index)
+        meta, bufs = serialization.serialize(value)
+        size = serialization.total_size(meta, bufs)
+        payload = {"task_id": spec.task_id.binary(), "index": index, "oid": oid.binary()}
+        if conn is not None and size <= CONFIG.max_direct_call_object_size:
+            blob = bytearray(size)
+            serialization.write_into(memoryview(blob), meta, bufs)
+            payload["inline"] = bytes(blob)
+        else:
+            self.store.put_serialized(oid, meta, bufs)
+        if conn is not None:
+            try:
+                # Same loop as the eventual task_finished push: FIFO per
+                # connection, so the owner sees every item first.
+                self._direct_loop.call_soon_threadsafe(conn.push, "stream_item", payload)
+            except RuntimeError:
+                pass  # server loop stopped (process exiting)
+
+    def _drain_stream(self, spec: TaskSpec, result, sink, conn) -> None:
+        from ray_tpu._private.streaming import StreamEnd
+
+        if not hasattr(result, "__next__") and not hasattr(result, "__iter__"):
+            raise TypeError(
+                f"Task {spec.name} has num_returns='streaming' but returned "
+                f"{type(result).__name__}, not a generator/iterable"
+            )
+        count = 0
+        for item in result:
+            self._emit_stream_item(spec, count, item, conn)
+            count += 1
+        self._store_returns(spec, StreamEnd(count), sink)
 
     def _execute_actor_creation(self, spec: TaskSpec, sink=None):
         try:
@@ -1256,7 +1378,7 @@ class Worker:
             self.actor_id = spec.actor_id
             # Set up concurrency: thread pool or asyncio loop.
             has_async = any(
-                inspect.iscoroutinefunction(m)
+                inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
                 for _, m in inspect.getmembers(type(self.actor_instance), inspect.isfunction)
             )
             if has_async:
@@ -1291,7 +1413,7 @@ class Worker:
         method = getattr(self.actor_instance, spec.method_name)
         return method(*args, **kwargs)
 
-    def _execute_actor_method(self, spec: TaskSpec, sink=None):
+    def _execute_actor_method(self, spec: TaskSpec, sink=None, conn=None):
         try:
             if spec.method_name == "__ray_terminate__":
                 self._store_returns(spec, None, sink)
@@ -1300,7 +1422,10 @@ class Worker:
                 self._exec_queue.put(None)
                 return
             result = self._run_actor_method(spec)
-            self._store_returns(spec, result, sink)
+            if spec.is_streaming:
+                self._drain_stream(spec, result, sink, conn)
+            else:
+                self._store_returns(spec, result, sink)
         except Exception as e:  # noqa: BLE001
             self._store_error_returns(
                 spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}"), sink
@@ -1321,7 +1446,19 @@ class Worker:
             result = self._run_actor_method(spec)
             if inspect.iscoroutine(result):
                 result = await result
-            self._store_returns(spec, result, sink)
+            if spec.is_streaming:
+                if hasattr(result, "__aiter__"):
+                    from ray_tpu._private.streaming import StreamEnd
+
+                    count = 0
+                    async for item in result:
+                        self._emit_stream_item(spec, count, item, conn)
+                        count += 1
+                    self._store_returns(spec, StreamEnd(count), sink)
+                else:
+                    self._drain_stream(spec, result, sink, conn)
+            else:
+                self._store_returns(spec, result, sink)
         except Exception as e:  # noqa: BLE001
             self._store_error_returns(
                 spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}"), sink

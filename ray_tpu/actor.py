@@ -84,7 +84,10 @@ class ActorHandle:
     def _submit(self, method_name: str, args, kwargs, options: dict):
         worker = get_global_worker()
         refs = worker.submit_actor_task(self._actor_id, method_name, args, kwargs, options)
-        if options.get("num_returns", 1) == 1:
+        num_returns = options.get("num_returns", 1)
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
+        if num_returns == 1:
             return refs[0]
         return refs
 

@@ -58,6 +58,22 @@ def _run_read_task(read_task, transforms: List[Callable]) -> Tuple[Block, BlockM
     return _with_meta(block)
 
 
+def _run_read_task_streaming(read_task, transforms: List[Callable]):
+    """Streaming read body (num_returns="streaming"): each block the
+    datasource yields (file / row group) is emitted the moment it is
+    read, as a (block, metadata) pair of stream items — so the first
+    batch reaches the consumer before the last file is opened
+    (reference: read tasks are streaming generators throughout
+    data/_internal/execution/, via core_worker/generator_waiter.h)."""
+    for block in read_task():
+        block = BlockAccessor.for_block(block).to_arrow()
+        for t in transforms:
+            block = t(block)
+        acc = BlockAccessor.for_block(block)
+        yield block
+        yield acc.get_metadata()
+
+
 def _run_transforms(transforms: List[Callable], block: Block) -> Tuple[Block, BlockMetadata]:
     for t in transforms:
         block = t(block)
@@ -312,6 +328,97 @@ class TaskPoolMapOperator(PhysicalOperator):
 
     def internal_queue_size(self) -> int:
         return len(self._pending_inputs) + len(self._reorder)
+
+
+class StreamingReadOperator(PhysicalOperator):
+    """One *streaming* task per read-task bundle: blocks flow downstream
+    as the datasource yields them, instead of after the whole read task
+    finishes.  Emission stays deterministic: all blocks of read task i
+    (in yield order) before any block of task i+1.
+
+    submit(bundle) -> ObjectRefGenerator yielding block, meta, block,
+    meta, ... (see _run_read_task_streaming).
+    """
+
+    class _TaskState:
+        __slots__ = ("gen", "parts", "buffered", "done")
+
+        def __init__(self, gen):
+            self.gen = gen
+            self.parts: List[Any] = []  # ref accumulator for one pair
+            self.buffered: List[RefBundle] = []
+            self.done = False
+
+    def __init__(self, name: str, input_op: PhysicalOperator, submit: Callable[[RefBundle], Any]):
+        super().__init__(name, [input_op])
+        self._submit = submit
+        self._pending_inputs: List[RefBundle] = []
+        self._tasks: Dict[int, StreamingReadOperator._TaskState] = {}
+        self._task_idx = 0
+        self._next_emit_task = 0
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        self._pending_inputs.append(bundle)
+
+    def dispatch(self, ctx: DataContext) -> None:
+        while (
+            self._pending_inputs
+            and len(self._tasks) < ctx.max_in_flight_tasks_per_op
+            and len(self._output_queue) + sum(len(t.buffered) for t in self._tasks.values())
+            < ctx.op_output_queue_max_blocks
+        ):
+            bundle = self._pending_inputs.pop(0)
+            self._tasks[self._task_idx] = self._TaskState(self._submit(bundle))
+            self._task_idx += 1
+        self._poll()
+
+    def _poll(self) -> None:
+        from ray_tpu import exceptions
+
+        for st in self._tasks.values():
+            if st.done:
+                continue
+            while True:
+                try:
+                    ref = st.gen.try_next()
+                except StopIteration:
+                    st.done = True
+                    break
+                except exceptions.RayError:
+                    st.done = True
+                    raise
+                if ref is None:
+                    break
+                st.parts.append(ref)
+                if len(st.parts) == 2:
+                    block_ref, meta_ref = st.parts
+                    st.parts = []
+                    st.buffered.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+        # Emit in task order; within a task, in yield order.
+        while self._next_emit_task in self._tasks:
+            st = self._tasks[self._next_emit_task]
+            if st.buffered:
+                self._output_queue.extend(st.buffered)
+                st.buffered = []
+            if st.done and not st.parts:
+                del self._tasks[self._next_emit_task]
+                self._next_emit_task += 1
+            else:
+                break
+
+    def num_active_tasks(self) -> int:
+        return sum(1 for t in self._tasks.values() if not t.done)
+
+    def internal_queue_size(self) -> int:
+        return len(self._pending_inputs) + sum(len(t.buffered) for t in self._tasks.values())
+
+    def completed(self) -> bool:
+        return (
+            self.all_inputs_done()
+            and not self._pending_inputs
+            and not self._tasks
+            and not self._output_queue
+        )
 
 
 class ActorPoolMapOperator(PhysicalOperator):

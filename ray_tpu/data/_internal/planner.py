@@ -217,6 +217,19 @@ class Planner:
             opts.update({k: v for k, v in resource_opts.items() if v is not None})
 
         if is_read:
+            if self._ctx.streaming_read_enabled:
+                from ray_tpu.data._internal.executor import (
+                    StreamingReadOperator,
+                    _run_read_task_streaming,
+                )
+
+                stream_opts = dict(opts, num_returns="streaming")
+                stream_fn = ray_tpu.remote(_run_read_task_streaming).options(**stream_opts)
+
+                def submit(bundle: RefBundle):
+                    return stream_fn.remote(bundle.block_ref, transforms)
+
+                return StreamingReadOperator(name, input_op, submit)
             remote_fn = ray_tpu.remote(_run_read_task).options(**opts)
 
             def factory(bundle: RefBundle, task_idx: int):

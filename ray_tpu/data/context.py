@@ -34,6 +34,9 @@ class DataContext:
     task_num_cpus: float = 1.0
     # Shuffle strategy: "pull" (1-stage) or "push" (2-stage).
     shuffle_strategy: str = "pull"
+    # Reads run as streaming-generator tasks: each file/row-group block
+    # flows downstream the moment it is read (num_returns="streaming").
+    streaming_read_enabled: bool = True
     # Whether iter_jax_batches double-buffers device transfers.
     jax_prefetch: bool = True
     # Extra metadata propagated to tasks.

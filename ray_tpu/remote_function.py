@@ -63,6 +63,8 @@ class RemoteFunction:
         refs = worker.submit_task(
             self._blob(), opts.get("name") or self._name, args, kwargs, opts
         )
+        if self._options["num_returns"] == "streaming":
+            return refs  # an ObjectRefGenerator
         if self._options["num_returns"] == 1:
             return refs[0]
         return refs
