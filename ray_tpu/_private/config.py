@@ -47,6 +47,15 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # Max idle workers kept around per node.
     "idle_worker_pool_size": 8,
     "idle_worker_killing_time_ms": 300_000,
+    # --- memory monitor / OOM killing (reference: memory_monitor.h:52,
+    # worker_killing_policy_group_by_owner.cc) ---
+    "memory_monitor_enabled": True,
+    "memory_monitor_refresh_ms": 500,
+    # System policy: kill when MemAvailable < (1-threshold) * MemTotal.
+    "memory_usage_threshold": 0.95,
+    # Explicit budget for the sum of worker RSS on this node (bytes);
+    # 0 = use the system MemAvailable policy instead.
+    "memory_limit_bytes": 0,
     # --- health / failure detection ---
     "health_check_period_ms": 1_000,
     "health_check_timeout_ms": 10_000,

@@ -263,7 +263,9 @@ class DirectTaskSubmitter:
                 lease.idle_since = time.monotonic()
 
     def _on_lease_lost(self, wid: bytes, ks: _KeyState) -> None:
-        """The leased worker's connection dropped (worker crash or exit)."""
+        """The leased worker's connection dropped (worker crash, exit, or
+        an OOM kill by the raylet)."""
+        oom_msg = self._worker._oom_worker_kills.pop(wid, None)
         with self._lock:
             lease = ks.leases.pop(wid, None)
             if lease is None:
@@ -283,15 +285,26 @@ class DirectTaskSubmitter:
             if ks.pending and not self._closed:
                 self._assign_locked(ks)
                 self._maybe_request_leases_locked(ks)
+        if failed and oom_msg is None:
+            # The oom_kill push rides the raylet connection while the
+            # close event comes from the worker's own (killed) socket —
+            # give the push a beat to arrive before picking the error.
+            time.sleep(0.15)
+            oom_msg = self._worker._oom_worker_kills.pop(wid, None)
         for spec in failed:
-            self._fail_spec(spec)
+            self._fail_spec(spec, oom_msg)
 
-    def _fail_spec(self, spec: TaskSpec) -> None:
+    def _fail_spec(self, spec: TaskSpec, oom_msg: Optional[str] = None) -> None:
         from ray_tpu import exceptions
 
-        err = exceptions.WorkerCrashedError(
-            f"Task {spec.name} failed: the worker executing it died"
-        )
+        if oom_msg is not None:
+            err = exceptions.OutOfMemoryError(
+                f"Task {spec.name} was killed by the memory monitor: {oom_msg}"
+            )
+        else:
+            err = exceptions.WorkerCrashedError(
+                f"Task {spec.name} failed: the worker executing it died"
+            )
         try:
             self._worker._store_error_returns(spec, err)
         finally:

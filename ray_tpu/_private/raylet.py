@@ -160,7 +160,133 @@ class Raylet:
         await self._connect_gcs(first=True)
         self._bg.append(self.loop.create_task(self._report_loop()))
         self._bg.append(self.loop.create_task(self._idle_reaper_loop()))
+        if CONFIG.memory_monitor_enabled:
+            self._bg.append(self.loop.create_task(self._memory_monitor_loop()))
         logger.info("raylet %s listening on %s", self.node_id.hex()[:8], self.address)
+
+    # ------------------------------------------------------------------
+    # memory monitor / OOM worker killing (reference:
+    # src/ray/common/memory_monitor.h:52 UsageAboveThreshold +
+    # raylet/worker_killing_policy_group_by_owner.cc — kill the newest
+    # retriable work first so long-running work survives)
+    # ------------------------------------------------------------------
+    async def _memory_monitor_loop(self):
+        period = CONFIG.memory_monitor_refresh_ms / 1000
+        while not self._stopping:
+            await asyncio.sleep(period)
+            try:
+                self._check_memory_once()
+            except Exception:
+                logger.exception("memory monitor check failed")
+
+    @staticmethod
+    def _proc_rss(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * 4096
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    def _workers_rss(self) -> Dict[WorkerID, int]:
+        return {
+            w.worker_id: self._proc_rss(w.pid)
+            for w in self.workers.values()
+            if w.proc is not None and w.proc.poll() is None
+        }
+
+    def _check_memory_once(self):
+        limit = int(CONFIG.memory_limit_bytes)
+        if limit > 0:
+            # Explicit per-node worker-memory budget (sum of worker RSS) —
+            # deterministic, unaffected by other tenants of the host.
+            rss = self._workers_rss()
+            used = sum(rss.values())
+            if used <= limit:
+                return
+            detail = (
+                f"workers use {used >> 20} MiB, over the node's "
+                f"{limit >> 20} MiB worker-memory limit"
+            )
+        else:
+            # System policy: MemAvailable below (1 - threshold) of MemTotal.
+            total, avail = self._read_meminfo()
+            if total <= 0 or avail >= (1.0 - CONFIG.memory_usage_threshold) * total:
+                return
+            rss = self._workers_rss()
+            detail = (
+                f"node memory critical: {avail >> 20} MiB available of "
+                f"{total >> 20} MiB ({CONFIG.memory_usage_threshold:.0%} threshold)"
+            )
+        victim = self._pick_oom_victim(rss)
+        if victim is not None:
+            self._oom_kill_worker(
+                victim, f"{detail}; killed worker rss={rss.get(victim.worker_id, 0) >> 20} MiB"
+            )
+
+    @staticmethod
+    def _read_meminfo() -> Tuple[int, int]:
+        total = avail = 0
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1]) * 1024
+                    if total and avail:
+                        break
+        except OSError:
+            pass
+        return total, avail
+
+    def _pick_oom_victim(self, rss: Dict[WorkerID, int]) -> Optional[WorkerHandle]:
+        """Newest working (task-running) worker first, normal tasks before
+        actors (tasks are retriable by default, actors are stateful); idle
+        workers last — killing them frees memory without failing work."""
+        working, idle = [], []
+        for w in self.workers.values():
+            if w.proc is None or w.proc.poll() is not None or w.state == "DEAD":
+                continue
+            (working if w.state in ("BUSY", "LEASED", "ACTOR") else idle).append(w)
+        if working:
+            working.sort(key=lambda w: (w.actor_id is not None, -w.spawn_time))
+            return working[0]
+        if idle and rss.get(max(idle, key=lambda w: rss.get(w.worker_id, 0)).worker_id, 0) > 0:
+            return max(idle, key=lambda w: rss.get(w.worker_id, 0))
+        return None
+
+    def _oom_kill_worker(self, w: WorkerHandle, detail: str):
+        logger.warning(
+            "OOM-killing worker %s (%s): %s", w.worker_id.hex()[:12], w.state, detail
+        )
+        # Tell the lease holder first: the direct submitter owns the specs
+        # the raylet can't see, and uses this to surface OutOfMemoryError
+        # instead of a generic worker-crash.
+        if w.lease_owner is not None and not w.lease_owner.closed:
+            try:
+                w.lease_owner.push(
+                    "oom_kill", {"worker_id": w.worker_id.binary(), "message": detail}
+                )
+            except Exception:
+                pass
+        for _tb, spec in list(w.running.items()):
+            self._handle_failed_execution(spec, f"oom: {detail}")
+        w.running.clear()
+        actor_id = w.actor_id
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.kill()  # SIGKILL: a thrashing process may not die to SIGTERM
+            except Exception:
+                pass
+        self._kill_worker_proc(w)
+        if actor_id is not None and self.gcs is not None:
+            self.loop.create_task(
+                self._safe_gcs_push(
+                    "actor_death_report",
+                    {"actor_id": actor_id.binary(), "intended": False, "reason": f"oom: {detail}"},
+                )
+            )
+        self._schedule_dispatch()
 
     def _register_payload(self) -> dict:
         return {
@@ -520,7 +646,9 @@ class Raylet:
                 CONFIG.task_retry_delay_ms / 1000, lambda: (self.queue.append(spec), self._schedule_dispatch())
             )
             return
-        if spec.is_actor_task:
+        if reason.startswith("oom:"):
+            err = exceptions.OutOfMemoryError(f"Task {spec.name} failed: {reason}")
+        elif spec.is_actor_task:
             err = exceptions.RayActorError(f"The actor died while running {spec.name}: {reason}")
         else:
             err = exceptions.WorkerCrashedError(f"Task {spec.name} failed: {reason}")

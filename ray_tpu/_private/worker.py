@@ -251,6 +251,9 @@ class Worker:
         # Owner-side streaming-generator state: task_id bytes -> _StreamState
         # (reference: core_worker ObjectRefGenerator bookkeeping).
         self._streams: Dict[bytes, Any] = {}
+        # worker_id bytes -> reason, for leased workers the raylet
+        # OOM-killed (consumed by DirectTaskSubmitter._on_lease_lost).
+        self._oom_worker_kills: Dict[bytes, str] = {}
 
     # ------------------------------------------------------------------
     # connection
@@ -461,6 +464,7 @@ class Worker:
         self._actor_seq.clear()
         self._actor_send_inc.clear()
         self._runtime_env_norm_cache.clear()
+        self._oom_worker_kills.clear()
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
         self.actor_cache = ActorStateCache(self)
@@ -494,6 +498,11 @@ class Worker:
                 self._admit_actor_task(spec, None)
             else:
                 self._exec_queue.put((spec, None))
+        elif method == "oom_kill":
+            # The raylet OOM-killed a worker we hold a lease on; remember
+            # why so the lease-lost handler raises OutOfMemoryError
+            # instead of a generic crash (reference: memory_monitor.h).
+            self._oom_worker_kills[payload["worker_id"]] = payload["message"]
         elif method == "exit":
             self._intended_exit = True
             self._shutdown_event.set()

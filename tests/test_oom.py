@@ -1,0 +1,88 @@
+"""Memory monitor / OOM worker killing (reference:
+src/ray/common/memory_monitor.h:52, worker_killing_policy_group_by_owner.cc).
+
+The clusters here set an explicit worker-memory budget
+(memory_limit_bytes) so the tests are deterministic regardless of what
+else runs on the host; production defaults to the MemAvailable policy.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+LIMIT = 700 * 1024 * 1024  # headroom for ~4 idle workers (~60 MiB each)
+
+
+@pytest.fixture()
+def oom_cluster():
+    saved = os.environ.get("RAY_TPU_memory_limit_bytes")
+    os.environ["RAY_TPU_memory_limit_bytes"] = str(LIMIT)
+    os.environ["RAY_TPU_memory_monitor_refresh_ms"] = "200"
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    if saved is None:
+        os.environ.pop("RAY_TPU_memory_limit_bytes", None)
+    else:
+        os.environ["RAY_TPU_memory_limit_bytes"] = saved
+    os.environ.pop("RAY_TPU_memory_monitor_refresh_ms", None)
+
+
+def test_oom_task_killed_and_error_names_culprit(oom_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        ballast = bytearray(1024 * 1024 * 1024)  # 1 GiB, way over budget
+        for i in range(0, len(ballast), 4096):
+            ballast[i] = 1  # touch every page so RSS actually grows
+        time.sleep(30)
+        return len(ballast)
+
+    with pytest.raises(ray_tpu.exceptions.OutOfMemoryError) as ei:
+        ray_tpu.get(hog.remote(), timeout=90)
+    assert "hog" in str(ei.value)
+    assert "MiB" in str(ei.value)
+
+
+def test_oom_retries_then_fails(oom_cluster):
+    """An OOM-killed task is retriable like a crashed worker; when every
+    attempt OOMs, the final error is still OutOfMemoryError."""
+
+    @ray_tpu.remote(max_retries=1)
+    def hog2():
+        ballast = bytearray(1024 * 1024 * 1024)
+        for i in range(0, len(ballast), 4096):
+            ballast[i] = 1
+        time.sleep(30)
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+        ray_tpu.get(hog2.remote(), timeout=150)
+
+
+def test_oom_survivors_unaffected(oom_cluster):
+    """Killing the hog must leave well-behaved tasks running."""
+
+    @ray_tpu.remote(max_retries=0)
+    def hog3():
+        ballast = bytearray(1024 * 1024 * 1024)
+        for i in range(0, len(ballast), 4096):
+            ballast[i] = 1
+        time.sleep(30)
+        return 1
+
+    @ray_tpu.remote
+    def polite(x):
+        time.sleep(0.2)
+        return x * 2
+
+    bad = hog3.remote()
+    good = [polite.remote(i) for i in range(8)]
+    assert ray_tpu.get(good, timeout=90) == [i * 2 for i in range(8)]
+    with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+        ray_tpu.get(bad, timeout=90)
