@@ -246,6 +246,7 @@ class DirectTaskSubmitter:
                 self._worker.promote_blob(oid, blob)
         ms.resolve_stored(payload.get("stored", ()))
         self._worker._notify_stream_finished(payload["task_id"])
+        self._worker.reference_counter.return_borrows(payload["task_id"])
         with self._lock:
             lease = ks.leases.get(wid)
             if lease is None:
@@ -440,6 +441,7 @@ class ActorDirectChannel:
                 self.worker.promote_blob(oid, blob)
         ms.resolve_stored(payload.get("stored", ()))
         self.worker._notify_stream_finished(payload["task_id"])
+        self.worker.reference_counter.return_borrows(payload["task_id"])
         self.inflight.pop(payload["task_id"], None)
 
     def _on_close(self) -> None:

@@ -37,11 +37,15 @@ FUNCTION_KV_NS = "fn"
 class ReferenceCounter:
     """Owner-side local reference counts; frees cluster-wide at zero.
 
-    Objects whose refs have *escaped* this process (passed as task args or
-    pickled into other objects) are not freed eagerly — they are reclaimed
-    by per-job GC when the job ends (the job id is embedded in the object
-    id), a simplification of the reference's borrowing protocol
-    (reference: src/ray/core_worker/reference_count.h:64)."""
+    Borrowing-lite (reference: src/ray/core_worker/reference_count.h:64):
+    a ref passed as a direct-path task arg registers a *borrow* that is
+    returned when the task completes — an object whose local refs died
+    while borrows were outstanding is freed the moment the last borrow
+    returns, instead of leaking until job end.  Refs that escape through
+    generic pickling (nested in other objects) or down paths with no
+    completion signal (raylet-mediated submission, actor creation) fall
+    back to the *escaped* set: reclaimed by per-job GC when the job ends
+    (the job id is embedded in the object id)."""
 
     def __init__(self, worker: "Worker"):
         self._worker = worker
@@ -49,6 +53,12 @@ class ReferenceCounter:
         self._escaped: set = set()
         self._lock = threading.Lock()
         self._to_free: List[bytes] = []
+        self._flusher = None
+        # Outstanding borrow count per object, the task->borrowed-oids
+        # binding, and objects whose local refs died while borrowed.
+        self._borrows: Dict[ObjectID, int] = {}
+        self._task_borrows: Dict[bytes, List[ObjectID]] = {}
+        self._deferred: set = set()
 
     def add_owned(self, object_id: ObjectID):
         with self._lock:
@@ -57,6 +67,61 @@ class ReferenceCounter:
     def mark_escaped(self, object_id: ObjectID):
         with self._lock:
             self._escaped.add(object_id)
+
+    # -- borrowing-lite ----------------------------------------------------
+    def hold(self, object_id: ObjectID):
+        """Register one borrow immediately (called while serializing args,
+        BEFORE the task id exists — the caller's temporary refs may die as
+        soon as serialization returns)."""
+        with self._lock:
+            self._borrows[object_id] = self._borrows.get(object_id, 0) + 1
+
+    def bind_borrows(self, task_id: bytes, oids: List[ObjectID]):
+        """Associate already-held borrows with the submitted task."""
+        if not oids:
+            return
+        with self._lock:
+            self._task_borrows[task_id] = list(oids)
+
+    def return_borrows(self, task_id: bytes):
+        """The task completed (result, error, or gave up retrying): its
+        borrows return; objects whose local refs already died free now."""
+        with self._lock:
+            oids = self._task_borrows.pop(task_id, None)
+            if not oids:
+                return
+            for oid in oids:
+                self._drop_borrow_locked(oid)
+
+    def escalate_to_escape(self, task_id: bytes, oids: Optional[List[ObjectID]] = None):
+        """The spec went down a path with no completion signal: convert
+        its borrows to permanent escapes (job-end GC reclaims them).
+        With oids=None, escalates whatever was bound to the task."""
+        with self._lock:
+            bound = self._task_borrows.pop(task_id, None)
+            if oids is None:
+                oids = bound or []
+            for oid in oids:
+                self._escaped.add(oid)
+                self._drop_borrow_locked(oid, escaped=True)
+
+    def _drop_borrow_locked(self, oid: ObjectID, escaped: bool = False):
+        c = self._borrows.get(oid, 0) - 1
+        if c > 0:
+            self._borrows[oid] = c
+            return
+        self._borrows.pop(oid, None)
+        if oid in self._deferred:
+            self._deferred.discard(oid)
+            if not escaped and oid not in self._escaped and oid not in self._counts:
+                # Keep the lineage: live dependents (the borrower's own
+                # results) may still need this task for transitive
+                # reconstruction; per-job GC reclaims the entry.
+                self._worker.memory_store.free(oid.binary())
+                self._to_free.append(oid.binary())
+                self._ensure_flusher_locked()
+                if len(self._to_free) >= 100:
+                    self._flush_locked()
 
     def remove_owned(self, object_id: ObjectID):
         with self._lock:
@@ -76,11 +141,19 @@ class ReferenceCounter:
                     self._escaped.discard(object_id)
                     self._worker.memory_store.free_if_settled(object_id.binary())
                     return
+                if self._borrows.get(object_id, 0) > 0:
+                    # In-flight tasks still use it as an arg: free when the
+                    # last borrow returns (reference: borrower count in
+                    # reference_count.h).
+                    self._deferred.add(object_id)
+                    self._worker.memory_store.free_if_settled(object_id.binary())
+                    return
                 self._worker.memory_store.free(object_id.binary())
                 # No dependents can exist: drop lineage with the ref
                 # (reference: task_manager.h lineage pinning).
                 self._worker.lineage.pop(object_id.binary(), None)
                 self._to_free.append(object_id.binary())
+                self._ensure_flusher_locked()
                 if len(self._to_free) >= 100:
                     self._flush_locked()
             else:
@@ -93,6 +166,23 @@ class ReferenceCounter:
                 self._worker.gcs_client.push("free_objects", batch)
         except Exception:
             pass
+
+    def _ensure_flusher_locked(self):
+        """Freed ids batch up to amortize the GCS push, but a trickle of
+        frees (the common case) must still go out promptly — a lazy
+        background flusher drains the batch every 200 ms."""
+        if self._flusher is not None:
+            return
+
+        def run():
+            while True:
+                time.sleep(0.2)
+                with self._lock:
+                    if self._to_free:
+                        self._flush_locked()
+
+        self._flusher = threading.Thread(target=run, daemon=True, name="ref-free-flush")
+        self._flusher.start()
 
     def flush(self):
         with self._lock:
@@ -468,6 +558,7 @@ class Worker:
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
         self.actor_cache = ActorStateCache(self)
+        self.reference_counter = ReferenceCounter(self)
 
     # ------------------------------------------------------------------
     # pushes
@@ -739,8 +830,15 @@ class Worker:
     # ------------------------------------------------------------------
     # task submission
     # ------------------------------------------------------------------
-    def _serialize_args(self, args: Tuple, kwargs: Dict) -> List[Tuple[str, Any]]:
+    def _serialize_args(self, args: Tuple, kwargs: Dict) -> Tuple[List[Tuple[str, Any]], List[ObjectID]]:
+        """Pack args for a TaskSpec.  Returns (packed, borrowed_oids):
+        every "ref" arg registers a *borrow* (held immediately — temporary
+        refs like auto-put large values die when this scope exits); the
+        submit path binds the borrows to the task for return at
+        completion, or escalates them to escapes on paths with no
+        completion signal (reference: reference_count.h:64 borrowing)."""
         packed = []
+        borrowed: List[ObjectID] = []
         for a in list(args) + ([kwargs] if kwargs else []):
             if isinstance(a, ObjectRef):
                 key = a.id.binary()
@@ -762,20 +860,20 @@ class Worker:
                     # Error result (TAG_ERROR): can't inline as a value —
                     # promote so the consumer's fetch finds (and raises) it.
                     self.promote_blob(key, blob)
-                # The ref escapes this process: exempt it from eager free so
-                # the in-flight task can't lose its argument.
-                self.reference_counter.mark_escaped(a.id)
+                self.reference_counter.hold(a.id)
+                borrowed.append(a.id)
                 packed.append(("ref", key))
             else:
                 blob = serialization.serialize_to_bytes(a)
                 if len(blob) > CONFIG.max_direct_call_object_size:
                     ref = self.put(a)
-                    self.reference_counter.mark_escaped(ref.id)
+                    self.reference_counter.hold(ref.id)
+                    borrowed.append(ref.id)
                     packed.append(("ref", ref.id.binary()))
                 else:
                     packed.append(("v", blob))
         packed.append(("haskw", bool(kwargs)))
-        return packed
+        return packed, borrowed
 
     def _next_task_id(self) -> TaskID:
         base_actor = self.actor_id or ActorID.nil_of(self.job_id)
@@ -821,12 +919,13 @@ class Worker:
         if is_streaming:
             num_returns = 1  # return 0 is the end-of-stream sentinel
         resources = _resolve_resources(options, default_cpu=1.0)
+        packed_args, borrowed = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=self._next_task_id(),
             job_id=self.job_id,
             name=name,
             function_key=key,
-            args=self._serialize_args(args, kwargs),
+            args=packed_args,
             num_returns=num_returns,
             resources=resources,
             max_retries=options.get("max_retries", CONFIG.task_max_retries),
@@ -848,18 +947,26 @@ class Worker:
         if CONFIG.lineage_reconstruction_enabled and not is_streaming:
             for oid in spec.return_ids():
                 self.lineage[oid.binary()] = spec
+        tid = spec.task_id.binary()
         if (
             self._direct_submitter is not None
             and spec.scheduling_strategy.kind == "DEFAULT"
         ):
             oids = [o.binary() for o in spec.return_ids()]
             self.memory_store.add_pending(oids)
+            # Direct path has a completion signal (task_finished /
+            # _fail_spec): arg borrows return then, freeing args eagerly.
+            self.reference_counter.bind_borrows(tid, borrowed)
             try:
                 self._direct_submitter.submit(spec)
             except Exception:
                 self.memory_store.resolve_stored(oids)
+                self.reference_counter.escalate_to_escape(tid, borrowed)
                 self.raylet_client.call("submit_task", {"spec": spec})
         else:
+            # Raylet-mediated: no owner-side completion signal — args
+            # stay pinned until job-end GC (escaped).
+            self.reference_counter.escalate_to_escape(tid, borrowed)
             self.raylet_client.call("submit_task", {"spec": spec})
         if generator is not None:
             return generator
@@ -936,12 +1043,16 @@ class Worker:
         key = self._push_function(cls_blob)
         actor_id = ActorID.of(self.job_id)
         resources = _resolve_resources(options, default_cpu=0.0)
+        # Actor creation flows through the GCS with no owner-side
+        # completion signal: creation args escape until job end.
+        packed_args, borrowed = self._serialize_args(args, kwargs)
+        self.reference_counter.escalate_to_escape(b"", borrowed)
         spec = TaskSpec(
             task_id=TaskID.of(actor_id),
             job_id=self.job_id,
             name=class_name,
             function_key=key,
-            args=self._serialize_args(args, kwargs),
+            args=packed_args,
             num_returns=1,
             resources=resources,
             is_actor_creation=True,
@@ -967,12 +1078,13 @@ class Worker:
             num_returns = 1
         # sequence_number is assigned at SEND time (_send_actor_task), per
         # actor incarnation, so queued/retried specs renumber consistently.
+        packed_args, borrowed = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.of(actor_id),
             job_id=self.job_id,
             name=method_name,
             function_key=b"",
-            args=self._serialize_args(args, kwargs),
+            args=packed_args,
             num_returns=num_returns,
             resources=ResourceSet(),
             is_actor_task=True,
@@ -981,6 +1093,9 @@ class Worker:
             owner_worker_id=self.worker_id,
             is_streaming=is_streaming,
         )
+        # Completion flows back through the actor channel / stored error
+        # paths in this process, all of which return the borrows.
+        self.reference_counter.bind_borrows(spec.task_id.binary(), borrowed)
         generator = None
         if is_streaming:
             from ray_tpu._private.streaming import ObjectRefGenerator
@@ -1023,6 +1138,9 @@ class Worker:
         address = info["raylet_address"]
         try:
             client = self._get_raylet_client(address)
+            # No owner-side completion signal on this path: the spec's arg
+            # borrows escape until job-end GC.
+            self.reference_counter.escalate_to_escape(spec.task_id.binary())
             client.call("submit_task", {"spec": spec})
             # Results will be sealed in the shm store: stop gets from
             # waiting on the memory store for them.
@@ -1139,6 +1257,10 @@ class Worker:
         # The owner may be blocked on these as in-flight direct results
         # (e.g. an actor died and errors were stored on its behalf).
         self.memory_store.resolve_stored([o.binary() for o in spec.return_ids()])
+        # Owner-side finalization: the task will never run (or gave up),
+        # so its arg borrows return.  No-op in the executing worker's
+        # process, where the spec was never bound.
+        self.reference_counter.return_borrows(spec.task_id.binary())
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs_client.call("kill_actor", {"actor_id": actor_id.binary(), "no_restart": no_restart})

@@ -1,0 +1,99 @@
+"""Borrowing-lite eager free (reference: core_worker/reference_count.h:64).
+
+A ref passed as a direct-path task arg registers a borrow; when the task
+completes and the owner's local refs are gone, the object frees
+immediately — it must NOT linger until job-end GC.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray():
+    ray_tpu.init(
+        num_cpus=4,
+        object_store_memory=80 * 1024 * 1024,
+        ignore_reinit_error=True,
+    )
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _store_stats():
+    w = ray_tpu.get_global_worker()
+    return w.raylet_client.call("store_stats", None)
+
+
+def _stored_bytes():
+    s = _store_stats()
+    for k in ("bytes_in_use", "used_bytes", "bytes_used", "size"):
+        if k in s:
+            return s[k]
+    raise AssertionError(f"no usage key in {s}")
+
+
+def test_arg_freed_after_task_completes():
+    @ray_tpu.remote
+    def consume(a):
+        return float(a.sum())
+
+    before = _stored_bytes()
+    ref = ray_tpu.put(np.ones(2_000_000))  # 16 MB
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 2_000_000.0
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if _stored_bytes() <= before + 1_000_000:
+            return
+        time.sleep(0.2)
+    raise AssertionError(
+        f"arg not freed after borrow returned: {_stored_bytes()} > {before}"
+    )
+
+
+def test_arg_kept_while_task_inflight():
+    """Dropping the local ref while the consumer still runs must NOT free
+    the argument out from under it."""
+
+    @ray_tpu.remote
+    def slow_consume(a):
+        time.sleep(2.0)
+        return float(a.sum())
+
+    ref = ray_tpu.put(np.ones(1_000_000))
+    fut = slow_consume.remote(ref)
+    del ref
+    gc.collect()
+    assert ray_tpu.get(fut, timeout=60) == 1_000_000.0
+
+
+def test_data_streams_many_times_store_capacity():
+    """VERDICT contract: a Data job streaming ~10x the object-store
+    capacity completes with stable store usage and (near) zero spilling,
+    because consumed blocks free as their borrows return."""
+    import ray_tpu.data as rd
+
+    spilled_before = _store_stats().get("num_spilled", 0)
+    # 64 blocks x ~12.8 MB = ~800 MB through an 80 MB store.
+    n_rows = 800
+    ds = rd.range_tensor(n_rows, shape=(2000,), parallelism=64).map_batches(
+        lambda b: {"data": b["data"] * 2.0}, batch_format="numpy"
+    )
+    total_rows = 0
+    for batch in ds.iter_batches(batch_size=50, prefetch_batches=1):
+        total_rows += len(batch["data"])
+    assert total_rows == n_rows
+    spilled_after = _store_stats().get("num_spilled", 0)
+    # Eager free keeps the working set bounded: allow a handful of spills
+    # for scheduling jitter, not the ~10x overflow.
+    assert spilled_after - spilled_before < 16, (
+        f"spilled {spilled_after - spilled_before} objects — blocks are "
+        f"not being freed eagerly"
+    )

@@ -204,6 +204,51 @@ def test_actor_restart_resets_sequencing(ray_cluster):
     a = Flaky.remote()
     assert ray_tpu.get([a.ping.remote(i) for i in range(5)], timeout=60) == list(range(5))
     a.die.remote()
+    _finish_flaky_restart(a)
+
+
+def test_actor_retry_preserves_order_across_crash(ray_cluster, tmp_path):
+    """Induced redelivery: the actor's worker dies mid-stream with calls
+    in flight; with max_task_retries=-1 every call completes and each
+    incarnation executes its calls in submission order (reference:
+    sequential_actor_submit_queue.h + actor_task_submitter retry path).
+    Completed-but-unacknowledged calls MAY re-execute on the new
+    incarnation — retriable actor tasks are at-least-once, as in the
+    reference — but never out of order within an incarnation.  Execution
+    is observed through a file because the crash wipes instance state."""
+    log = str(tmp_path / "calls.log")
+    marker = str(tmp_path / "died")
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=-1)
+    class Crashy:
+        def log(self, path, marker, i):
+            import os as _os
+
+            if i == 7 and not _os.path.exists(marker):
+                open(marker, "w").write("x")
+                _os._exit(1)  # dies BEFORE logging: the call must be retried
+            with open(path, "a") as f:
+                f.write(f"{i}\n")
+            return i
+
+    a = Crashy.remote()
+    refs = [a.log.remote(log, marker, i) for i in range(15)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(15))
+    lines = [int(x) for x in open(log).read().split()]
+    # The log is two strictly-increasing runs (one per incarnation): the
+    # pre-crash run, then the post-restart run that finishes the stream.
+    runs = [[lines[0]]] if lines else []
+    for x in lines[1:]:
+        (runs[-1].append(x) if x > runs[-1][-1] else runs.append([x]))
+    assert len(runs) <= 2, f"interleaved execution: {lines}"
+    assert runs[-1][-1] == 14
+    assert set(lines) == set(range(15)), lines
+    # No duplicates within one incarnation.
+    for run in runs:
+        assert len(run) == len(set(run))
+
+
+def _finish_flaky_restart(a):
     # Wait for the restart, then keep calling — must not hang or misorder.
     deadline = time.monotonic() + 60
     while True:
