@@ -526,11 +526,16 @@ class GcsServer:
 
     async def rpc_object_free(self, payload, conn):
         """Owner released all refs: delete everywhere.  Inline objects are
-        not in the directory, so the free is broadcast to every node."""
+        not in the directory, so the free is broadcast to every node.
+
+        The id stays in sealed_ever: a freed object must read as LOST
+        (not never-sealed) so a dependent task resubmitted by lineage
+        reconstruction can recover the freed arg via its own lineage
+        instead of waiting forever for a seal that won't come.  Per-job
+        GC reclaims the entries at job end."""
         oids = payload
         for oid in oids:
             self.object_locations.pop(oid, None)
-            self.sealed_ever.discard(bytes(oid))
         for client in self.node_clients.values():
             try:
                 await client.push("store_free", oids)

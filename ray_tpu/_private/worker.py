@@ -54,6 +54,7 @@ class ReferenceCounter:
         self._lock = threading.Lock()
         self._to_free: List[bytes] = []
         self._flusher = None
+        self._stopped = False
         # Outstanding borrow count per object, the task->borrowed-oids
         # binding, and objects whose local refs died while borrowed.
         self._borrows: Dict[ObjectID, int] = {}
@@ -175,7 +176,7 @@ class ReferenceCounter:
             return
 
         def run():
-            while True:
+            while not self._stopped:
                 time.sleep(0.2)
                 with self._lock:
                     if self._to_free:
@@ -183,6 +184,12 @@ class ReferenceCounter:
 
         self._flusher = threading.Thread(target=run, daemon=True, name="ref-free-flush")
         self._flusher.start()
+
+    def stop(self):
+        """End the flusher (the counter is being replaced on disconnect;
+        a 'while True' loop would leak one thread per init/shutdown cycle
+        and pin the old Worker graph through its closure)."""
+        self._stopped = True
 
     def flush(self):
         with self._lock:
@@ -558,6 +565,7 @@ class Worker:
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
         self.actor_cache = ActorStateCache(self)
+        self.reference_counter.stop()
         self.reference_counter = ReferenceCounter(self)
 
     # ------------------------------------------------------------------
@@ -839,6 +847,15 @@ class Worker:
         completion signal (reference: reference_count.h:64 borrowing)."""
         packed = []
         borrowed: List[ObjectID] = []
+        try:
+            return self._serialize_args_inner(args, kwargs, packed, borrowed)
+        except BaseException:
+            # Failing mid-pack must not leak the holds already taken —
+            # escalate them to escapes (job-end GC) and surface the error.
+            self.reference_counter.escalate_to_escape(b"", borrowed)
+            raise
+
+    def _serialize_args_inner(self, args, kwargs, packed, borrowed):
         for a in list(args) + ([kwargs] if kwargs else []):
             if isinstance(a, ObjectRef):
                 key = a.id.binary()
@@ -919,6 +936,11 @@ class Worker:
         if is_streaming:
             num_returns = 1  # return 0 is the end-of-stream sentinel
         resources = _resolve_resources(options, default_cpu=1.0)
+        # Anything that can raise must run BEFORE _serialize_args holds
+        # borrows — an exception in the hold→bind window would leak them
+        # (the object would defer frees forever).
+        strategy = _resolve_strategy(options)
+        runtime_env = self._effective_runtime_env(options)
         packed_args, borrowed = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=self._next_task_id(),
@@ -930,9 +952,9 @@ class Worker:
             resources=resources,
             max_retries=options.get("max_retries", CONFIG.task_max_retries),
             retry_exceptions=options.get("retry_exceptions", False),
-            scheduling_strategy=_resolve_strategy(options),
+            scheduling_strategy=strategy,
             owner_worker_id=self.worker_id,
-            runtime_env=self._effective_runtime_env(options),
+            runtime_env=runtime_env,
             is_streaming=is_streaming,
         )
         generator = None

@@ -74,6 +74,26 @@ def test_arg_kept_while_task_inflight():
     assert ray_tpu.get(fut, timeout=60) == 1_000_000.0
 
 
+def test_freed_object_reads_as_lost_not_never_sealed():
+    """A freed id stays in the GCS sealed-ever set so it reads as LOST
+    (recoverable via lineage), not never-sealed (which would hang pulls
+    and break lineage recovery of dependents whose args were eagerly
+    freed)."""
+    w = ray_tpu.get_global_worker()
+    ref = ray_tpu.put(np.ones(1_000_000))
+    oid = ref.id.binary()
+    # Sealed and located: not lost.
+    assert w.gcs_client.call("object_lost_check", oid) is False
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if w.gcs_client.call("object_lost_check", oid):
+            return  # freed → "lost" (owner-recoverable), NOT "never sealed"
+        time.sleep(0.2)
+    raise AssertionError("freed object still reads as never-sealed in the GCS")
+
+
 def test_data_streams_many_times_store_capacity():
     """VERDICT contract: a Data job streaming ~10x the object-store
     capacity completes with stable store usage and (near) zero spilling,
