@@ -223,7 +223,15 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    raise NotImplementedError("task cancellation lands with the task-manager milestone")
+    """Cancel the task that produces `ref` (reference: core_worker.cc
+    CancelTask).  Queued tasks are dropped; a running task gets
+    TaskCancelledError raised inside it (force=True kills its worker
+    process instead).  Cancelled tasks are never retried; a task that
+    already finished is unaffected.  `recursive` is accepted for API
+    compatibility (child-task tracking is not implemented — children
+    keep running)."""
+    worker = get_global_worker()
+    worker.cancel_task(ref.id, force=force)
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

@@ -117,6 +117,11 @@ class TaskSpec:
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     # Actor options
     max_concurrency: int = 1
+    # Creation: named concurrency groups (name -> max parallel calls);
+    # actor tasks carry the group to execute under (reference:
+    # core_worker/concurrency_group_manager.h).
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: Optional[str] = None
     max_restarts: int = 0
     max_task_retries: int = 0
     actor_name: Optional[str] = None

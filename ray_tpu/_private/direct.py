@@ -272,9 +272,11 @@ class DirectTaskSubmitter:
             if lease is None:
                 return
             lease.dead = True
-            retry, failed = [], []
+            retry, failed, cancelled = [], [], []
             for spec in lease.inflight.values():
-                if spec.max_retries < 0 or spec.attempt_number < spec.max_retries:
+                if spec.task_id.binary() in self._worker._cancelled_tasks:
+                    cancelled.append(spec)  # force-cancel killed the worker
+                elif spec.max_retries < 0 or spec.attempt_number < spec.max_retries:
                     spec.attempt_number += 1
                     retry.append(spec)
                 else:
@@ -294,6 +296,17 @@ class DirectTaskSubmitter:
             oom_msg = self._worker._oom_worker_kills.pop(wid, None)
         for spec in failed:
             self._fail_spec(spec, oom_msg)
+        from ray_tpu import exceptions
+
+        for spec in cancelled:
+            try:
+                self._worker._store_error_returns(
+                    spec, exceptions.TaskCancelledError(f"Task {spec.name} was cancelled")
+                )
+            finally:
+                self._worker.memory_store.resolve_stored(
+                    [o.binary() for o in spec.return_ids()]
+                )
 
     def _fail_spec(self, spec: TaskSpec, oom_msg: Optional[str] = None) -> None:
         from ray_tpu import exceptions
@@ -312,6 +325,49 @@ class DirectTaskSubmitter:
             self._worker.memory_store.resolve_stored(
                 [o.binary() for o in spec.return_ids()]
             )
+
+    def cancel(self, tid: bytes, force: bool) -> bool:
+        """Cancel a submitted task: drop it from a pending queue (storing
+        TaskCancelledError), or forward the cancel to the leased worker
+        running it.  Returns False if this submitter doesn't know the
+        task (caller falls through to the raylet path)."""
+        from ray_tpu import exceptions
+
+        doomed = None
+        target = None
+        with self._lock:
+            for ks in self._keys.values():
+                for spec in ks.pending:
+                    if spec.task_id.binary() == tid:
+                        doomed = spec
+                        break
+                if doomed is not None:
+                    ks.pending.remove(doomed)
+                    break
+                for lease in ks.leases.values():
+                    if tid in lease.inflight:
+                        target = lease
+                        break
+                if target is not None:
+                    break
+        if doomed is not None:
+            try:
+                self._worker._store_error_returns(
+                    doomed,
+                    exceptions.TaskCancelledError(f"Task {doomed.name} was cancelled"),
+                )
+            finally:
+                self._worker.memory_store.resolve_stored(
+                    [o.binary() for o in doomed.return_ids()]
+                )
+            return True
+        if target is not None:
+            try:
+                target.client.push("cancel_task", {"task_id": tid, "force": force})
+            except rpc.RpcError:
+                pass
+            return True
+        return False
 
     def _fail_pending_env(self, ks: _KeyState, msg: str) -> None:
         """The raylet reported this key's runtime_env failed to stage:

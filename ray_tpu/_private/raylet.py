@@ -121,6 +121,9 @@ class Raylet:
         # failed recently; tasks requiring them fail fast with
         # RuntimeEnvSetupError instead of spawn-looping.
         self.bad_runtime_envs: Dict[str, Tuple[str, float]] = {}
+        # task ids cancelled while running here: worker death for them is
+        # final (TaskCancelledError), never a retry.
+        self.cancelled_tasks: Set[bytes] = set()
         self.actor_workers: Dict[ActorID, WorkerHandle] = {}
         self.job_configs: Dict[JobID, dict] = {}
 
@@ -639,6 +642,12 @@ class Raylet:
     def _handle_failed_execution(self, spec: TaskSpec, reason: str):
         from ray_tpu import exceptions
 
+        if spec.task_id.binary() in self.cancelled_tasks:
+            self.cancelled_tasks.discard(spec.task_id.binary())
+            self._fail_spec_with_error(
+                spec, exceptions.TaskCancelledError(f"Task {spec.name} was cancelled")
+            )
+            return
         if spec.max_retries < 0 or spec.attempt_number < spec.max_retries:
             spec.attempt_number += 1
             logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt_number, reason)
@@ -693,6 +702,32 @@ class Raylet:
     # ------------------------------------------------------------------
     # task scheduling (reference: cluster_task_manager.cc:44 QueueAndScheduleTask)
     # ------------------------------------------------------------------
+    async def rpc_cancel_task(self, payload, conn):
+        """Cancel a raylet-queued task (error returns, never runs) or
+        forward the cancel to the worker running it (reference:
+        node_manager HandleCancelTask)."""
+        from ray_tpu import exceptions
+
+        tid = payload["task_id"]
+        force = payload.get("force", False)
+        for coll in (self.queue, self.infeasible):
+            for spec in list(coll):
+                if spec.task_id.binary() == tid:
+                    coll.remove(spec)
+                    self._fail_spec_with_error(
+                        spec,
+                        exceptions.TaskCancelledError(f"Task {spec.name} was cancelled"),
+                    )
+                    return True
+        for w in self.workers.values():
+            if tid in w.running and w.conn is not None and not w.conn.closed:
+                # Remembered so a force-kill's worker death doesn't send
+                # the cancelled spec around the retry loop.
+                self.cancelled_tasks.add(tid)
+                w.conn.push("cancel_task", {"task_id": tid, "force": force})
+                return True
+        return False
+
     async def rpc_submit_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
         spilled = payload.get("spilled", False)

@@ -214,6 +214,17 @@ class ActorStateCache:
         self._flushing: set = set()
         self._lock = threading.Lock()
 
+    def cancel_pending(self, tid: bytes) -> Optional[TaskSpec]:
+        """Remove (and return) a queued spec waiting for its actor to come
+        alive — a cancel must not let it run when the actor appears."""
+        with self._lock:
+            for specs in self._pending.values():
+                for spec in specs:
+                    if spec.task_id.binary() == tid:
+                        specs.remove(spec)
+                        return spec
+        return None
+
     def on_update(self, info: dict):
         actor_id = ActorID(info["actor_id"])
         with self._lock:
@@ -318,6 +329,10 @@ class Worker:
         self._async_loop = None
         self._async_loop_thread = None
         self._exec_pool = None
+        # Named concurrency groups: group -> bounded thread pool (sync
+        # actors) / asyncio semaphore (async actors).
+        self._group_pools: Dict[str, Any] = {}
+        self._async_group_sems: Dict[str, Any] = {}
         self._shutdown_event = threading.Event()
         self._task_events: list = []
         self._task_event_flusher = None
@@ -351,6 +366,16 @@ class Worker:
         # worker_id bytes -> reason, for leased workers the raylet
         # OOM-killed (consumed by DirectTaskSubmitter._on_lease_lost).
         self._oom_worker_kills: Dict[bytes, str] = {}
+        # Owner side: task ids cancelled via ray_tpu.cancel — retry paths
+        # consult this to fail instead of resubmitting.
+        self._cancelled_tasks: set = set()
+        # Executor side: cancel requests for tasks queued/running here,
+        # plus live execution registries so a cancel targets exactly the
+        # right thread / asyncio task (a shared "current thread" would
+        # misfire on concurrent actors).
+        self._cancel_requested: set = set()
+        self._running_threads: Dict[bytes, int] = {}  # task_id -> thread ident
+        self._running_async: Dict[bytes, Any] = {}  # task_id -> asyncio.Task
 
     # ------------------------------------------------------------------
     # connection
@@ -597,6 +622,8 @@ class Worker:
                 self._admit_actor_task(spec, None)
             else:
                 self._exec_queue.put((spec, None))
+        elif method == "cancel_task":
+            self._handle_cancel_request(payload)
         elif method == "oom_kill":
             # The raylet OOM-killed a worker we hold a lease on; remember
             # why so the lease-lost handler raises OutOfMemoryError
@@ -995,6 +1022,79 @@ class Worker:
         return [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
 
     # ------------------------------------------------------------------
+    # task cancellation (reference: core_worker.cc CancelTask)
+    # ------------------------------------------------------------------
+    def cancel_task(self, object_id: ObjectID, force: bool = False):
+        tid = object_id.task_id().binary()
+        self._cancelled_tasks.add(tid)
+        if self._direct_submitter is not None and self._direct_submitter.cancel(tid, force):
+            return
+        # Actor task in flight on a direct channel?
+        with self._lock:
+            channels = list(self._actor_channels.values())
+        for ch in channels:
+            if tid in ch.inflight:
+                try:
+                    ch.client.push("cancel_task", {"task_id": tid, "force": force})
+                except rpc.RpcError:
+                    pass
+                return
+        # Actor task parked waiting for a restarting/not-yet-alive actor.
+        parked = self.actor_cache.cancel_pending(tid)
+        if parked is not None:
+            self._store_error_returns(
+                parked, exceptions.TaskCancelledError(f"Task {parked.name} was cancelled")
+            )
+            return
+        # Raylet-mediated (queued or running on a raylet-dispatched worker).
+        try:
+            self.raylet_client.call("cancel_task", {"task_id": tid, "force": force})
+        except rpc.RpcError:
+            pass
+
+    def _handle_cancel_request(self, payload: dict):
+        """Executor side: a cancel arrived for a task queued or running in
+        THIS process."""
+        import ctypes
+
+        tid = payload["task_id"]
+        force = payload.get("force", False)
+        self._cancel_requested.add(tid)
+        ident = self._running_threads.get(tid)
+        if ident is not None:
+            if force:
+                os._exit(1)
+            # Raise TaskCancelledError inside exactly the thread running
+            # THIS task, at its next bytecode boundary (reference kills
+            # via KeyboardInterrupt in the worker; same mechanism).
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident),
+                ctypes.py_object(exceptions.TaskCancelledError),
+            )
+            return
+        atask = self._running_async.get(tid)
+        if atask is not None:
+            if force:
+                os._exit(1)
+            if self._async_loop is not None:
+                self._async_loop.call_soon_threadsafe(atask.cancel)
+
+    def push_cancel_task(self, payload, conn):
+        """Direct push from the owner (worker's RPC server)."""
+        self._handle_cancel_request(payload)
+
+    def _maybe_drop_cancelled(self, spec: TaskSpec, sink) -> bool:
+        """Before execution: a task cancelled while queued stores
+        TaskCancelledError and never runs."""
+        if spec.task_id.binary() not in self._cancel_requested:
+            return False
+        self._cancel_requested.discard(spec.task_id.binary())
+        self._store_error_returns(
+            spec, exceptions.TaskCancelledError(f"Task {spec.name} was cancelled"), sink
+        )
+        return True
+
+    # ------------------------------------------------------------------
     # streaming generators (owner side)
     # ------------------------------------------------------------------
     def _register_stream(self, spec: TaskSpec):
@@ -1082,6 +1182,7 @@ class Worker:
             max_restarts=options.get("max_restarts", 0),
             max_task_retries=options.get("max_task_retries", 0),
             max_concurrency=options.get("max_concurrency", 1),
+            concurrency_groups=options.get("concurrency_groups"),
             actor_name=options.get("name"),
             namespace=options.get("namespace") or self.namespace,
             detached=options.get("lifetime") == "detached",
@@ -1114,6 +1215,7 @@ class Worker:
             method_name=method_name,
             owner_worker_id=self.worker_id,
             is_streaming=is_streaming,
+            concurrency_group=options.get("concurrency_group"),
         )
         # Completion flows back through the actor channel / stored error
         # paths in this process, all of which return the borrows.
@@ -1309,7 +1411,10 @@ class Worker:
                 break
             spec, conn = item
             if spec.is_actor_task and self._exec_pool is not None:
-                self._exec_pool.submit(self._execute_task_guarded, spec, conn)
+                pool = self._exec_pool
+                if spec.concurrency_group and self._group_pools:
+                    pool = self._group_pools.get(spec.concurrency_group, pool)
+                pool.submit(self._execute_task_guarded, spec, conn)
             elif spec.is_actor_task and self._async_loop is not None:
                 import asyncio
 
@@ -1410,14 +1515,19 @@ class Worker:
         self.current_spec = spec
         self.current_task_id = spec.task_id
         sink = None if conn is None else {"inline": [], "stored": []}
+        self._running_threads[spec.task_id.binary()] = threading.get_ident()
         try:
-            if spec.is_actor_creation:
+            if self._maybe_drop_cancelled(spec, sink):
+                pass
+            elif spec.is_actor_creation:
                 self._execute_actor_creation(spec, sink)
             elif spec.is_actor_task:
                 self._execute_actor_method(spec, sink, conn)
             else:
                 self._execute_normal_task(spec, sink, conn)
         finally:
+            self._running_threads.pop(spec.task_id.binary(), None)
+            self._cancel_requested.discard(spec.task_id.binary())
             self.current_spec = None
             self.current_task_id = None
             if conn is not None:
@@ -1483,6 +1593,12 @@ class Worker:
                 self._drain_stream(spec, result, sink, conn)
             else:
                 self._store_returns(spec, result, sink)
+        except exceptions.TaskCancelledError:
+            # Injected by ray_tpu.cancel: stored unwrapped so the owner's
+            # get raises TaskCancelledError itself, not RayTaskError.
+            self._store_error_returns(
+                spec, exceptions.TaskCancelledError(f"Task {spec.name} was cancelled"), sink
+            )
         except Exception as e:  # noqa: BLE001
             self._store_error_returns(
                 spec, exceptions.RayTaskError.from_exception(e, spec.name), sink
@@ -1548,10 +1664,35 @@ class Worker:
 
                 self._async_loop_thread = threading.Thread(target=run_loop, daemon=True, name="actor-async-loop")
                 self._async_loop_thread.start()
-            elif spec.max_concurrency > 1:
+            elif spec.max_concurrency > 1 or spec.concurrency_groups:
                 from concurrent.futures import ThreadPoolExecutor
 
-                self._exec_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency, thread_name_prefix="actor-exec")
+                self._exec_pool = ThreadPoolExecutor(
+                    max_workers=max(1, spec.max_concurrency), thread_name_prefix="actor-exec"
+                )
+            # Named concurrency groups: a dedicated bounded pool per group
+            # (reference: core_worker/concurrency_group_manager.h — one
+            # thread/fiber pool per group).  For async actors the bound is
+            # a per-group semaphore on the actor loop instead.
+            if spec.concurrency_groups:
+                if self._async_loop is not None:
+                    import asyncio as _aio
+
+                    # Loop-agnostic since 3.10: safe to construct off-loop.
+                    self._async_group_sems = {
+                        g: _aio.Semaphore(max(1, int(n)))
+                        for g, n in spec.concurrency_groups.items()
+                    }
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._group_pools = {
+                        g: ThreadPoolExecutor(
+                            max_workers=max(1, int(n)),
+                            thread_name_prefix=f"actor-cg-{g}",
+                        )
+                        for g, n in spec.concurrency_groups.items()
+                    }
             # The creation return is checked by the raylet/GCS as well as
             # the owner: always seal it in the store, never inline-only.
             self._store_returns(spec, None, None)
@@ -1579,6 +1720,14 @@ class Worker:
                 self._drain_stream(spec, result, sink, conn)
             else:
                 self._store_returns(spec, result, sink)
+        except exceptions.TaskCancelledError:
+            self._store_error_returns(
+                spec,
+                exceptions.TaskCancelledError(
+                    f"Task {spec.name}.{spec.method_name} was cancelled"
+                ),
+                sink,
+            )
         except Exception as e:  # noqa: BLE001
             self._store_error_returns(
                 spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}"), sink
@@ -1587,8 +1736,32 @@ class Worker:
     async def _execute_task_async(self, spec: TaskSpec, conn=None):
         """Async-actor path: methods run as coroutines on the actor loop
         (reference: core_worker/transport/fiber.h — fibers → asyncio)."""
+        import asyncio
+
+        tid = spec.task_id.binary()
+        self._running_async[tid] = asyncio.current_task()
+        try:
+            sem = (
+                self._async_group_sems.get(spec.concurrency_group)
+                if spec.concurrency_group
+                else None
+            )
+            if sem is not None:
+                async with sem:
+                    return await self._execute_task_async_inner(spec, conn)
+            return await self._execute_task_async_inner(spec, conn)
+        finally:
+            self._running_async.pop(tid, None)
+            self._cancel_requested.discard(tid)
+
+    async def _execute_task_async_inner(self, spec: TaskSpec, conn=None):
         self.current_spec = spec
         sink = None if conn is None else {"inline": [], "stored": []}
+        if self._maybe_drop_cancelled(spec, sink):
+            if conn is not None:
+                self._send_task_finished(spec, conn, sink)
+            self.current_spec = None
+            return
         try:
             if spec.method_name == "__ray_terminate__":
                 self._store_returns(spec, None, sink)
@@ -1612,10 +1785,27 @@ class Worker:
                     self._drain_stream(spec, result, sink, conn)
             else:
                 self._store_returns(spec, result, sink)
-        except Exception as e:  # noqa: BLE001
-            self._store_error_returns(
-                spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}"), sink
-            )
+        except BaseException as e:  # noqa: BLE001
+            import asyncio
+
+            if isinstance(e, asyncio.CancelledError):
+                # ray_tpu.cancel on a running coroutine: store the typed
+                # error (NOT wrapped in RayTaskError, so user code can
+                # `except TaskCancelledError`) and swallow the cancel so
+                # the finally still reports completion.
+                self._store_error_returns(
+                    spec,
+                    exceptions.TaskCancelledError(
+                        f"Task {spec.name}.{spec.method_name} was cancelled"
+                    ),
+                    sink,
+                )
+            elif isinstance(e, Exception):
+                self._store_error_returns(
+                    spec, exceptions.RayTaskError.from_exception(e, f"{spec.name}.{spec.method_name}"), sink
+                )
+            else:
+                raise
         finally:
             self.current_spec = None
             if conn is not None:

@@ -27,30 +27,57 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     placement_group=None,
     placement_group_bundle_index=-1,
     runtime_env=None,
+    # name -> max parallel calls; methods opt in via
+    # @ray_tpu.method(concurrency_group="name") (reference:
+    # core_worker/concurrency_group_manager.h).
+    concurrency_groups=None,
 )
 
 
 def method(**kwargs):
-    """@ray_tpu.method(num_returns=2) decorator on actor methods."""
+    """@ray_tpu.method(num_returns=2, concurrency_group="io") decorator
+    on actor methods."""
 
     def decorator(m):
         m.__ray_num_returns__ = kwargs.get("num_returns", 1)
+        if kwargs.get("concurrency_group") is not None:
+            m.__ray_concurrency_group__ = kwargs["concurrency_group"]
         return m
 
     return decorator
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        method_name: str,
+        num_returns: int = 1,
+        concurrency_group: Optional[str] = None,
+    ):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
-        return self._handle._submit(self._method_name, args, kwargs, {"num_returns": self._num_returns})
+        return self._handle._submit(
+            self._method_name,
+            args,
+            kwargs,
+            {
+                "num_returns": self._num_returns,
+                "concurrency_group": self._concurrency_group,
+            },
+        )
 
     def options(self, **opts):
-        bound = ActorMethod(self._handle, self._method_name, opts.get("num_returns", self._num_returns))
+        bound = ActorMethod(
+            self._handle,
+            self._method_name,
+            opts.get("num_returns", self._num_returns),
+            opts.get("concurrency_group", self._concurrency_group),
+        )
         return bound
 
     def __call__(self, *args, **kwargs):
@@ -79,7 +106,10 @@ class ActorHandle:
             raise AttributeError(name)
         if name not in meta:
             raise AttributeError(f"Actor {self._class_name} has no method '{name}'")
-        return ActorMethod(self, name, meta[name])
+        entry = meta[name]
+        if isinstance(entry, tuple):
+            return ActorMethod(self, name, entry[0], entry[1])
+        return ActorMethod(self, name, entry)  # legacy int-only meta
 
     def _submit(self, method_name: str, args, kwargs, options: dict):
         worker = get_global_worker()
@@ -111,12 +141,16 @@ def _restore_handle(actor_id_bytes, method_meta, class_name):
     return ActorHandle(ActorID(actor_id_bytes), method_meta, class_name)
 
 
-def _method_meta_for(cls) -> Dict[str, int]:
+def _method_meta_for(cls) -> Dict[str, tuple]:
+    """name -> (num_returns, concurrency_group)."""
     meta = {}
     for name, m in inspect.getmembers(cls, predicate=callable):
         if name.startswith("__") and name not in ("__call__",):
             continue
-        meta[name] = getattr(m, "__ray_num_returns__", 1)
+        meta[name] = (
+            getattr(m, "__ray_num_returns__", 1),
+            getattr(m, "__ray_concurrency_group__", None),
+        )
     return meta
 
 

@@ -1,0 +1,309 @@
+"""Dashboard HTTP server (reference: python/ray/dashboard/dashboard.py +
+dashboard/modules/job/job_head.py REST routes).
+
+A ThreadingHTTPServer hosted in the head-node process.  All state reads
+go through the GCS (and raylet node_stats), the same sources as the
+state API; job routes delegate to the JobManager.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import urlparse
+
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu.dashboard.job_manager import JobManager
+
+logger = logging.getLogger(__name__)
+
+
+class _DashboardState:
+    """GCS-backed reads, mirroring ray_tpu.util.state without needing a
+    connected driver worker."""
+
+    def __init__(self, gcs_client):
+        self.gcs = gcs_client
+        self._raylet_clients = {}
+
+    def _raylet(self, address: str):
+        c = self._raylet_clients.get(address)
+        if c is None or c.closed:
+            c = rpc.RpcClient(address)
+            self._raylet_clients[address] = c
+        return c
+
+    def nodes(self):
+        info = self.gcs.call("get_cluster_info")
+        return [
+            {
+                "node_id": NodeID(n["node_id"]).hex(),
+                "state": n["state"],
+                "is_head": n.get("is_head", False),
+                "resources_total": n["resources_total"],
+                "raylet_address": n["raylet_address"],
+                "hostname": n.get("hostname", ""),
+            }
+            for n in info["nodes"].values()
+        ]
+
+    def cluster_status(self):
+        info = self.gcs.call("get_cluster_info")
+        total: dict = {}
+        available: dict = {}
+        for n in info["nodes"].values():
+            if n["state"] != "ALIVE":
+                continue
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0) + v
+        for avail in info.get("available", {}).values():
+            for k, v in avail.items():
+                available[k] = available.get(k, 0) + v
+        return {
+            "nodes_alive": sum(1 for n in info["nodes"].values() if n["state"] == "ALIVE"),
+            "nodes_dead": sum(1 for n in info["nodes"].values() if n["state"] == "DEAD"),
+            "resources_total": total,
+            "resources_available": available,
+        }
+
+    def actors(self):
+        out = []
+        for a in self.gcs.call("list_actors", None):
+            out.append(
+                {
+                    "actor_id": ActorID(a["actor_id"]).hex(),
+                    "state": a["state"],
+                    "class_name": a.get("class_name", ""),
+                    "name": a.get("name"),
+                    "node_id": NodeID(a["node_id"]).hex() if a.get("node_id") else None,
+                    "pid": a.get("pid", 0),
+                    "num_restarts": a.get("num_restarts", 0),
+                    "death_cause": a.get("death_cause"),
+                }
+            )
+        return out
+
+    def tasks(self, limit: int = 1000):
+        return self.gcs.call("list_task_events", {"limit": limit})
+
+    def placement_groups(self):
+        return self.gcs.call("list_placement_groups", None)
+
+    def jobs(self):
+        return self.gcs.call("list_jobs", None)
+
+    def workers(self):
+        out = []
+        for n in self.nodes():
+            if n["state"] != "ALIVE":
+                continue
+            try:
+                stats = self._raylet(n["raylet_address"]).call("node_stats", {})
+            except Exception:
+                continue
+            for w in stats.get("workers", []):
+                w["node_id"] = n["node_id"]
+                out.append(w)
+        return out
+
+    def objects(self):
+        out = []
+        for n in self.nodes():
+            if n["state"] != "ALIVE":
+                continue
+            try:
+                stats = self._raylet(n["raylet_address"]).call(
+                    "node_stats", {"include_objects": True}
+                )
+            except Exception:
+                continue
+            for obj in stats.get("objects", []):
+                obj["node_id"] = n["node_id"]
+                out.append(obj)
+        return out
+
+    def prometheus_metrics(self) -> str:
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            records = self.gcs.call("metrics_get", None) or []
+            return metrics_mod.prometheus_text(records)
+        except Exception:
+            return ""
+
+
+def _html_table(title: str, rows: list) -> str:
+    if not rows:
+        return f"<h3>{title}</h3><p>none</p>"
+    cols = list(rows[0].keys())
+    head = "".join(f"<th>{c}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{r.get(c, '')}</td>" for c in cols) + "</tr>" for r in rows
+    )
+    return (
+        f"<h3>{title}</h3><table border=1 cellpadding=4 "
+        f"style='border-collapse:collapse;font-family:monospace'>"
+        f"<tr>{head}</tr>{body}</table>"
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ray-tpu-dashboard"
+    state: _DashboardState = None  # type: ignore  # set by factory
+    jobs: JobManager = None  # type: ignore
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug("dashboard: " + fmt, *args)
+
+    # -- helpers --------------------------------------------------------
+    def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj: Any, code: int = 200):
+        self._send(code, json.dumps(obj, default=str).encode())
+
+    def _error(self, code: int, message: str):
+        self._json({"error": message}, code)
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        if not n:
+            return {}
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    # -- routes ---------------------------------------------------------
+    def do_GET(self):
+        path = urlparse(self.path).path.rstrip("/") or "/"
+        try:
+            if path == "/":
+                return self._index()
+            if path == "/api/version":
+                return self._json({"version": "ray_tpu", "api": 1})
+            if path == "/api/cluster_status":
+                return self._json(self.state.cluster_status())
+            if path == "/api/nodes":
+                return self._json(self.state.nodes())
+            if path == "/api/actors":
+                return self._json(self.state.actors())
+            if path == "/api/tasks":
+                return self._json(self.state.tasks())
+            if path == "/api/placement_groups":
+                return self._json(self.state.placement_groups())
+            if path == "/api/workers":
+                return self._json(self.state.workers())
+            if path == "/api/objects":
+                return self._json(self.state.objects())
+            if path == "/api/cluster_jobs":
+                return self._json(self.state.jobs())
+            if path == "/api/jobs":
+                return self._json(self.jobs.list_jobs())
+            if path.startswith("/api/jobs/"):
+                rest = path[len("/api/jobs/"):]
+                if rest.endswith("/logs"):
+                    sid = rest[: -len("/logs")]
+                    return self._json({"logs": self.jobs.get_job_logs(sid)})
+                info = self.jobs.get_job_status(rest)
+                if info is None:
+                    return self._error(404, f"job {rest!r} not found")
+                return self._json(info)
+            if path == "/metrics":
+                return self._send(
+                    200, self.state.prometheus_metrics().encode(), "text/plain; version=0.0.4"
+                )
+            return self._error(404, f"no route {path}")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.exception("dashboard GET %s failed", path)
+            try:
+                self._error(500, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def do_POST(self):
+        path = urlparse(self.path).path.rstrip("/")
+        try:
+            if path == "/api/jobs":
+                body = self._read_body()
+                if not body.get("entrypoint"):
+                    return self._error(400, "entrypoint is required")
+                sid = self.jobs.submit_job(
+                    entrypoint=body["entrypoint"],
+                    submission_id=body.get("submission_id"),
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                )
+                return self._json({"submission_id": sid})
+            if path.endswith("/stop") and path.startswith("/api/jobs/"):
+                sid = path[len("/api/jobs/"): -len("/stop")]
+                if not self.jobs.stop_job(sid):
+                    return self._error(404, f"job {sid!r} not found")
+                return self._json({"stopped": True})
+            return self._error(404, f"no route {path}")
+        except ValueError as e:
+            self._error(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("dashboard POST %s failed", path)
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_DELETE(self):
+        path = urlparse(self.path).path.rstrip("/")
+        if path.startswith("/api/jobs/"):
+            sid = path[len("/api/jobs/"):]
+            try:
+                if not self.jobs.delete_job(sid):
+                    return self._error(404, f"job {sid!r} not found")
+                return self._json({"deleted": True})
+            except ValueError as e:
+                return self._error(400, str(e))
+        return self._error(404, f"no route {path}")
+
+    def _index(self):
+        status = self.state.cluster_status()
+        html = (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            "<h2>ray_tpu cluster</h2>"
+            f"<p>alive nodes: {status['nodes_alive']} &nbsp; "
+            f"dead: {status['nodes_dead']}</p>"
+            f"<p>resources: {status['resources_total']} &nbsp; "
+            f"available: {status['resources_available']}</p>"
+            + _html_table("Nodes", self.state.nodes())
+            + _html_table("Actors", self.state.actors())
+            + _html_table("Jobs (submitted)", self.jobs.list_jobs())
+            + "<p>API: /api/nodes /api/actors /api/tasks /api/jobs "
+            "/api/objects /api/placement_groups /api/workers /metrics</p>"
+            "</body></html>"
+        )
+        self._send(200, html.encode(), "text/html")
+
+
+def start_dashboard(
+    gcs_address: str, session_dir: str, host: str = "127.0.0.1", port: int = 8265
+) -> Optional[ThreadingHTTPServer]:
+    """Start the dashboard in a daemon thread; returns the server (its
+    bound port is server.server_address[1]; port=0 picks a free one)."""
+    try:
+        gcs_client = rpc.RpcClient(gcs_address)
+        jobs_gcs_client = rpc.RpcClient(gcs_address)
+    except rpc.RpcError as e:
+        logger.warning("dashboard: cannot reach GCS: %s", e)
+        return None
+    handler = type("BoundHandler", (_Handler,), {})
+    handler.state = _DashboardState(gcs_client)
+    handler.jobs = JobManager(jobs_gcs_client, gcs_address, session_dir)
+    try:
+        server = ThreadingHTTPServer((host, port), handler)
+    except OSError as e:
+        logger.warning("dashboard: cannot bind %s:%s: %s", host, port, e)
+        return None
+    threading.Thread(target=server.serve_forever, daemon=True, name="dashboard-http").start()
+    logger.info("dashboard listening on http://%s:%s", *server.server_address)
+    return server

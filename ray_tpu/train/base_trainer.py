@@ -47,7 +47,81 @@ class BaseTrainer:
 
     @classmethod
     def restore(cls, path: str, **kwargs):
-        raise NotImplementedError("restore lands with experiment state persistence")
+        """Rebuild a trainer from a previous run's experiment directory
+        and resume from its latest checkpoint (reference:
+        train/base_trainer.py:250 restore → trainer.pkl + latest
+        checkpoint discovery).  `kwargs` override saved constructor
+        fields (e.g. a fresh `train_loop_per_worker` for unpicklable
+        loops)."""
+        import os
+        import pickle
+
+        state_path = os.path.join(path, "trainer.pkl")
+        if not os.path.exists(state_path):
+            raise FileNotFoundError(
+                f"{path!r} is not a restorable experiment dir (no trainer.pkl); "
+                f"was it produced by Trainer.fit()?"
+            )
+        with open(state_path, "rb") as f:
+            state = pickle.load(f)
+        state.update(kwargs)
+        if "resume_from_checkpoint" not in kwargs:
+            latest = _latest_checkpoint(path)
+            if latest is not None:
+                state["resume_from_checkpoint"] = Checkpoint.from_directory(latest)
+        run_config = state.get("run_config") or RunConfig()
+        # Re-run into the SAME experiment dir so repeated crashes keep
+        # resuming forward.
+        run_config.name = os.path.basename(os.path.normpath(path))
+        run_config.storage_path = os.path.dirname(os.path.normpath(path))
+        state["run_config"] = run_config
+        return cls(**state)
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        import os
+
+        return os.path.exists(os.path.join(path, "trainer.pkl"))
+
+    def _save_trainer_state(self, storage_dir: str) -> None:
+        """Persist what restore() needs, excluding live run state."""
+        import os
+        import pickle
+
+        state = self._constructor_state()
+        try:
+            blob = pickle.dumps(state)
+        except Exception:
+            logger.warning(
+                "trainer state not picklable; Trainer.restore will require "
+                "passing the unpicklable fields as overrides"
+            )
+            return
+        tmp = os.path.join(storage_dir, ".trainer.pkl.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(storage_dir, "trainer.pkl"))
+
+    def _constructor_state(self) -> Dict[str, Any]:
+        return {
+            "scaling_config": self.scaling_config,
+            "run_config": self.run_config,
+            "datasets": self.datasets,
+        }
+
+
+def _latest_checkpoint(path: str) -> Optional[str]:
+    """Newest checkpoint_NNNNNN_rank0 dir under the experiment dir."""
+    import os
+    import re
+
+    best, best_idx = None, -1
+    for entry in os.listdir(path):
+        m = re.match(r"checkpoint_(\d+)_rank0$", entry)
+        if m and int(m.group(1)) > best_idx:
+            best_idx = int(m.group(1))
+            best = os.path.join(path, entry)
+    return best
 
 
 class DataParallelTrainer(BaseTrainer):
@@ -107,6 +181,15 @@ class DataParallelTrainer(BaseTrainer):
                     shards[i][name] = ds
         return shards
 
+    def _constructor_state(self) -> Dict[str, Any]:
+        state = super()._constructor_state()
+        state.update(
+            train_loop_per_worker=self.train_loop_per_worker,
+            train_loop_config=self.train_loop_config,
+            backend_config=self.backend_config,
+        )
+        return state
+
     def fit(self) -> Result:
         name = self.run_config.name or f"train_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
         failure_config = self.run_config.failure_config or FailureConfig()
@@ -121,6 +204,7 @@ class DataParallelTrainer(BaseTrainer):
             )
             try:
                 executor.start()
+                self._save_trainer_state(executor.storage_dir)
                 executor.start_training(
                     self._wrapped_train_fn(),
                     resume_checkpoint=latest_checkpoint,

@@ -41,3 +41,9 @@ class JaxTrainer(DataParallelTrainer):
             datasets=datasets,
             resume_from_checkpoint=resume_from_checkpoint,
         )
+
+    def _constructor_state(self):
+        state = super()._constructor_state()
+        # This constructor names the backend config `jax_config`.
+        state["jax_config"] = state.pop("backend_config")
+        return state

@@ -1,0 +1,164 @@
+"""ray_tpu.cancel (reference: core_worker.cc CancelTask semantics)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_cancel_running_task():
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(60)
+        return "never"
+
+    ref = sleeper.remote()
+    time.sleep(1.0)  # let it start
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 20  # did not wait out the sleep
+
+
+def test_cancel_queued_task():
+    @ray_tpu.remote(num_cpus=1)
+    def busy():
+        time.sleep(8)
+        return "done"
+
+    # Fill both CPUs, then queue one more and cancel it before it runs.
+    running = [busy.remote() for _ in range(2)]
+    queued = busy.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(queued)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    assert ray_tpu.get(running, timeout=60) == ["done", "done"]
+
+
+def test_cancel_force_kills_worker_no_retry():
+    @ray_tpu.remote(max_retries=5)
+    def stubborn():
+        # Holds the GIL in C so the async-exception never lands: only
+        # force (SIGKILL-level) cancellation can stop it.
+        import numpy as np
+
+        x = 1.0
+        for _ in range(100):
+            x += float(np.ones(20_000_000).sum())  # long C-level loops
+        return x
+
+    ref = stubborn.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_finished_task_is_noop():
+    @ray_tpu.remote
+    def quick():
+        return 41
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 41
+    ray_tpu.cancel(ref)  # must not raise or corrupt the value
+    assert ray_tpu.get(ref, timeout=30) == 41
+
+
+def test_cancel_async_actor_task():
+    """Cancelling a running coroutine cancels exactly that asyncio task;
+    the actor keeps serving other calls."""
+
+    @ray_tpu.remote
+    class A:
+        async def stuck(self):
+            import asyncio
+
+            await asyncio.sleep(60)
+            return "never"
+
+        async def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ref = a.stuck.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    # The actor (and its loop) survived the cancel.
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(a)
+
+
+def test_concurrency_groups_sync_actor():
+    """Methods in a concurrency group run in parallel up to the group
+    limit; ungrouped methods stay serialized on the default pool
+    (reference: core_worker/concurrency_group_manager.h)."""
+
+    @ray_tpu.remote(concurrency_groups={"io": 3})
+    class Worker:
+        def __init__(self):
+            import threading as th
+
+            self.live = 0
+            self.peak = 0
+            self.lock = th.Lock()
+
+        @ray_tpu.method(concurrency_group="io")
+        def io_call(self):
+            with self.lock:
+                self.live += 1
+                self.peak = max(self.peak, self.live)
+            time.sleep(0.5)
+            with self.lock:
+                self.live -= 1
+            return "io"
+
+        def peak_seen(self):
+            return self.peak
+
+    w = Worker.remote()
+    refs = [w.io_call.remote() for _ in range(6)]
+    assert ray_tpu.get(refs, timeout=60) == ["io"] * 6
+    peak = ray_tpu.get(w.peak_seen.remote(), timeout=30)
+    assert 2 <= peak <= 3, peak  # parallel, but never above the cap
+    ray_tpu.kill(w)
+
+
+def test_concurrency_groups_async_actor():
+    @ray_tpu.remote(concurrency_groups={"limited": 2})
+    class AsyncWorker:
+        def __init__(self):
+            self.live = 0
+            self.peak = 0
+
+        @ray_tpu.method(concurrency_group="limited")
+        async def call(self):
+            import asyncio
+
+            self.live += 1
+            self.peak = max(self.peak, self.live)
+            await asyncio.sleep(0.4)
+            self.live -= 1
+            return "ok"
+
+        async def peak_seen(self):
+            return self.peak
+
+    a = AsyncWorker.remote()
+    refs = [a.call.remote() for _ in range(6)]
+    assert ray_tpu.get(refs, timeout=60) == ["ok"] * 6
+    assert ray_tpu.get(a.peak_seen.remote(), timeout=30) == 2
+    ray_tpu.kill(a)

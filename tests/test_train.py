@@ -100,6 +100,30 @@ def test_jax_trainer_resume_from_checkpoint(ray_cluster, tmp_path):
     assert r2.metrics["loss"] <= r1.metrics["loss"] + 0.5  # continued, not reset
 
 
+def test_trainer_restore_from_experiment_dir(ray_cluster, tmp_path):
+    """Trainer.restore(path) rebuilds the trainer from the saved
+    trainer.pkl and resumes from the latest checkpoint (reference:
+    train/base_trainer.py:250)."""
+    trainer = JaxTrainer(
+        _mlp_loop,
+        train_loop_config={"epochs": 2, "num_workers": 2},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="mlp_restore", storage_path=str(tmp_path)),
+    )
+    r1 = trainer.fit()
+    exp_dir = os.path.join(str(tmp_path), "mlp_restore")
+    assert JaxTrainer.can_restore(exp_dir)
+    restored = JaxTrainer.restore(exp_dir)
+    assert restored.resume_from_checkpoint is not None
+    assert restored.train_loop_config["epochs"] == 2
+    r2 = restored.fit()
+    # Restored run continued from r1's params (loss did not reset).
+    assert r2.metrics["loss"] <= r1.metrics["loss"] + 0.5
+    # Overrides replace saved fields.
+    restored2 = JaxTrainer.restore(exp_dir, train_loop_config={"epochs": 1, "num_workers": 2})
+    assert restored2.train_loop_config["epochs"] == 1
+
+
 def _flaky_loop(config):
     marker = config["marker"]
     if not os.path.exists(marker):
