@@ -137,7 +137,12 @@ class RayContext:
             "raylet_address": worker.raylet_client.address if worker.raylet_client else None,
             "node_id": worker.node_id.hex() if worker.node_id else None,
             "session_dir": worker.session_info.get("session_dir"),
+            "webui_url": worker.session_info.get("dashboard_url"),
         }
+
+    @property
+    def dashboard_url(self):
+        return self.address_info.get("webui_url")
 
     def __enter__(self):
         return self

@@ -47,6 +47,10 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # Max idle workers kept around per node.
     "idle_worker_pool_size": 8,
     "idle_worker_killing_time_ms": 300_000,
+    # --- dashboard (reference: dashboard/dashboard.py; -1 disables,
+    # 0 picks a free port) ---
+    "dashboard_host": "127.0.0.1",
+    "dashboard_port": 0,
     # --- memory monitor / OOM killing (reference: memory_monitor.h:52,
     # worker_killing_policy_group_by_owner.cc) ---
     "memory_monitor_enabled": True,

@@ -60,6 +60,24 @@ def main():
     async def run():
         await gcs.start()
         await raylet.start()
+        if CONFIG.dashboard_port >= 0:
+            # HTTP API + job submission, in-process (reference runs
+            # dashboard.py as its own process; same routes).
+            try:
+                from ray_tpu.dashboard import start_dashboard
+
+                server = start_dashboard(
+                    args.gcs_address,
+                    args.session_dir,
+                    host=CONFIG.dashboard_host,
+                    port=CONFIG.dashboard_port,
+                )
+                if server is not None:
+                    gcs.session_info["dashboard_url"] = (
+                        f"http://{server.server_address[0]}:{server.server_address[1]}"
+                    )
+            except Exception:
+                logging.getLogger(__name__).exception("dashboard failed to start")
         from ray_tpu._private.node import owner_watchdog
 
         watchdog_task = (

@@ -726,6 +726,23 @@ class Raylet:
                 self.cancelled_tasks.add(tid)
                 w.conn.push("cancel_task", {"task_id": tid, "force": force})
                 return True
+        # Not here: the task may have spilled to a peer raylet — fan the
+        # cancel out once (forwarded guard stops ping-pong).
+        if not payload.get("forwarded"):
+            for view in self.cluster_view.values():
+                addr = view.get("raylet_address")
+                if not addr or addr == self.address:
+                    continue
+                try:
+                    peer = await self._peer(addr)
+                    if await peer.call(
+                        "cancel_task",
+                        {"task_id": tid, "force": force, "forwarded": True},
+                        timeout=10,
+                    ):
+                        return True
+                except rpc.RpcError:
+                    continue
         return False
 
     async def rpc_submit_task(self, payload, conn):

@@ -376,6 +376,7 @@ class Worker:
         self._cancel_requested: set = set()
         self._running_threads: Dict[bytes, int] = {}  # task_id -> thread ident
         self._running_async: Dict[bytes, Any] = {}  # task_id -> asyncio.Task
+        self._cancel_signal_tid: Optional[bytes] = None
 
     # ------------------------------------------------------------------
     # connection
@@ -454,6 +455,7 @@ class Worker:
         # Host a direct RPC endpoint before registering so the raylet can
         # hand our address to lease holders (reference: CoreWorkerService).
         self._start_direct_server(raylet_address)
+        self._install_cancel_signal_handler()
         payload = {"worker_id": self.worker_id.binary(), "address": self.direct_address}
         if runtime_env_error:
             payload["runtime_env_error"] = runtime_env_error
@@ -1056,6 +1058,7 @@ class Worker:
         """Executor side: a cancel arrived for a task queued or running in
         THIS process."""
         import ctypes
+        import signal
 
         tid = payload["task_id"]
         force = payload.get("force", False)
@@ -1064,13 +1067,29 @@ class Worker:
         if ident is not None:
             if force:
                 os._exit(1)
-            # Raise TaskCancelledError inside exactly the thread running
-            # THIS task, at its next bytecode boundary (reference kills
-            # via KeyboardInterrupt in the worker; same mechanism).
-            ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_ulong(ident),
-                ctypes.py_object(exceptions.TaskCancelledError),
-            )
+            if ident == threading.main_thread().ident:
+                # Normal tasks run on the worker's main thread: a signal
+                # interrupts even C-level blocking calls (time.sleep,
+                # socket reads) — SetAsyncExc would wait for the next
+                # Python bytecode that may never come (reference: the
+                # worker raises KeyboardInterrupt off SIGINT the same
+                # way).  The handler re-checks the target tid before
+                # raising, so a cancel racing completion is a no-op.
+                self._cancel_signal_tid = tid
+                try:
+                    signal.pthread_kill(ident, signal.SIGUSR1)
+                except (OSError, ValueError):
+                    pass
+                return
+            # Pool-thread tasks (concurrent actors): best-effort async
+            # exception at the next bytecode boundary.  Re-check the
+            # registry right before injecting to shrink the window where
+            # a finished task's thread could be poisoned.
+            if self._running_threads.get(tid) == ident:
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(ident),
+                    ctypes.py_object(exceptions.TaskCancelledError),
+                )
             return
         atask = self._running_async.get(tid)
         if atask is not None:
@@ -1078,6 +1097,28 @@ class Worker:
                 os._exit(1)
             if self._async_loop is not None:
                 self._async_loop.call_soon_threadsafe(atask.cancel)
+
+    def _install_cancel_signal_handler(self):
+        """SIGUSR1 → TaskCancelledError in the main thread, iff the task
+        it was aimed at is still the one running there."""
+        import signal
+
+        def handler(_sig, _frame):
+            tid = self._cancel_signal_tid
+            spec = self.current_spec
+            if (
+                tid is not None
+                and spec is not None
+                and spec.task_id.binary() == tid
+                and self._running_threads.get(tid) == threading.get_ident()
+            ):
+                self._cancel_signal_tid = None
+                raise exceptions.TaskCancelledError()
+
+        try:
+            signal.signal(signal.SIGUSR1, handler)
+        except ValueError:
+            pass  # not the main thread (embedded use); cancel stays best-effort
 
     def push_cancel_task(self, payload, conn):
         """Direct push from the owner (worker's RPC server)."""
@@ -1406,6 +1447,10 @@ class Worker:
             try:
                 item = self._exec_queue.get(timeout=1.0)
             except queue.Empty:
+                continue
+            except exceptions.TaskCancelledError:
+                # Stray cancel signal that raced its task's completion:
+                # the loop itself must survive.
                 continue
             if item is None:
                 break

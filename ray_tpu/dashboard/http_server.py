@@ -137,15 +137,20 @@ class _DashboardState:
 
 
 def _html_table(title: str, rows: list) -> str:
+    import html as html_mod
+
+    esc = lambda v: html_mod.escape(str(v))  # noqa: E731 — user data (names,
+    # entrypoints, metadata) must never reach the page unescaped
     if not rows:
-        return f"<h3>{title}</h3><p>none</p>"
+        return f"<h3>{esc(title)}</h3><p>none</p>"
     cols = list(rows[0].keys())
-    head = "".join(f"<th>{c}</th>" for c in cols)
+    head = "".join(f"<th>{esc(c)}</th>" for c in cols)
     body = "".join(
-        "<tr>" + "".join(f"<td>{r.get(c, '')}</td>" for c in cols) + "</tr>" for r in rows
+        "<tr>" + "".join(f"<td>{esc(r.get(c, ''))}</td>" for c in cols) + "</tr>"
+        for r in rows
     )
     return (
-        f"<h3>{title}</h3><table border=1 cellpadding=4 "
+        f"<h3>{esc(title)}</h3><table border=1 cellpadding=4 "
         f"style='border-collapse:collapse;font-family:monospace'>"
         f"<tr>{head}</tr>{body}</table>"
     )
@@ -267,14 +272,16 @@ class _Handler(BaseHTTPRequestHandler):
         return self._error(404, f"no route {path}")
 
     def _index(self):
+        import html as html_mod
+
         status = self.state.cluster_status()
         html = (
             "<html><head><title>ray_tpu dashboard</title></head><body>"
             "<h2>ray_tpu cluster</h2>"
             f"<p>alive nodes: {status['nodes_alive']} &nbsp; "
             f"dead: {status['nodes_dead']}</p>"
-            f"<p>resources: {status['resources_total']} &nbsp; "
-            f"available: {status['resources_available']}</p>"
+            f"<p>resources: {html_mod.escape(str(status['resources_total']))} &nbsp; "
+            f"available: {html_mod.escape(str(status['resources_available']))}</p>"
             + _html_table("Nodes", self.state.nodes())
             + _html_table("Actors", self.state.actors())
             + _html_table("Jobs (submitted)", self.jobs.list_jobs())

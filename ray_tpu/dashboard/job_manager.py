@@ -109,6 +109,19 @@ class JobManager:
             return
         with self._lock:
             self._procs[submission_id] = proc
+        # A stop may have landed between submit and the Popen above (its
+        # _procs lookup found nothing to kill): honor it now instead of
+        # reviving the record to RUNNING.
+        latest = self._get(submission_id) or info
+        if latest["status"] == "STOPPED":
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            proc.wait()
+            with self._lock:
+                self._procs.pop(submission_id, None)
+            return
         info.update(status="RUNNING", message=f"pid {proc.pid}")
         self._put(info)
         rc = proc.wait()
@@ -138,6 +151,12 @@ class JobManager:
         info = self._get(submission_id)
         if info is None:
             return False
+        # Mark STOPPED BEFORE killing: the supervisor thread finalizes the
+        # record when the process exits, and must see the stop (writing
+        # after the kill races its FAILED write).
+        if info["status"] not in TERMINAL:
+            info.update(status="STOPPED", message="stopped by user", end_time=time.time())
+            self._put(info)
         with self._lock:
             proc = self._procs.get(submission_id)
         if proc is not None and proc.poll() is None:
@@ -158,9 +177,6 @@ class JobManager:
                         pass
 
             threading.Thread(target=_escalate, daemon=True).start()
-        if info["status"] not in TERMINAL:
-            info.update(status="STOPPED", message="stopped by user", end_time=time.time())
-            self._put(info)
         return True
 
     def delete_job(self, submission_id: str) -> bool:

@@ -1,0 +1,83 @@
+"""Pipeline-parallel GPT-2: transformer blocks staged over the "pp"
+mesh axis via the SPMD microbatched pipeline in
+:mod:`ray_tpu.parallel.pipeline`.
+
+The embedding, final layernorm, and lm head run replicated over pp
+(they are a tiny fraction of the FLOPs); the block stack — where the
+compute lives — is split into pp stages of n_layer/pp layers each, and
+activations rotate between stages over ICI with ppermute.  One jitted
+SPMD program covers the full schedule (reference substrate being
+replaced: dag/compiled_dag_node.py:1639 pipelines between actors).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ray_tpu.models.gpt2 import Block, GPT2Config
+from ray_tpu.parallel.pipeline import microbatch, pipeline_spmd
+
+
+def split_pipeline_params(params: Any, cfg: GPT2Config, pp: int) -> Tuple[Any, Any]:
+    """(stage_params, rest): blocks h_0..h_{L-1} stacked into leaves of
+    shape [pp, L/pp, ...]; `rest` holds the un-staged params (wte, wpe,
+    ln_f, lm_head)."""
+    if cfg.n_layer % pp:
+        raise ValueError(f"n_layer {cfg.n_layer} not divisible by pp={pp}")
+    per = cfg.n_layer // pp
+    blocks = [params[f"h_{i}"] for i in range(cfg.n_layer)]
+    stages = []
+    for s in range(pp):
+        stage_layers = blocks[s * per : (s + 1) * per]
+        stages.append(jax.tree.map(lambda *xs: jnp.stack(xs), *stage_layers))
+    stage_params = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    rest = {k: v for k, v in params.items() if not k.startswith("h_")}
+    return stage_params, rest
+
+
+def merge_pipeline_params(stage_params: Any, rest: Any, cfg: GPT2Config) -> Any:
+    """Inverse of split_pipeline_params (for checkpoint interop)."""
+    params = dict(rest)
+    flat = jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), stage_params)
+    for i in range(cfg.n_layer):
+        params[f"h_{i}"] = jax.tree.map(lambda x: x[i], flat)
+    return params
+
+
+def make_pp_loss_fn(cfg: GPT2Config, mesh: Mesh, n_micro: int, axis: str = "pp"):
+    """loss(stage_params, rest, tokens, targets) — differentiable w.r.t.
+    both parameter trees."""
+
+    def stage_fn(stage_layers, x):
+        # stage_layers leaves: [L/pp, ...] — scan this stage's blocks.
+        def body(h, layer):
+            return Block(cfg).apply({"params": layer}, h), None
+
+        out, _ = lax.scan(body, x, stage_layers)
+        return out
+
+    pipe = pipeline_spmd(stage_fn, mesh, axis)
+
+    def loss(stage_params, rest, tokens, targets):
+        B, T = tokens.shape
+        x = rest["wte"]["embedding"][tokens].astype(cfg.dtype)
+        x = x + rest["wpe"]["embedding"][jnp.arange(T)[None, :]].astype(cfg.dtype)
+        mbs = microbatch(x, n_micro)
+        x = pipe(stage_params, mbs).reshape(B, T, -1)
+        # final LN + head (replicated over pp).
+        import flax.linen as nn
+
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype).apply(
+            {"params": rest["ln_f"]}, x
+        )
+        logits = x @ rest["lm_head"]["kernel"].astype(cfg.dtype)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return (lse - tgt.astype(jnp.float32)).mean()
+
+    return loss

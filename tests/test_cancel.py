@@ -15,18 +15,33 @@ def ray():
 
 
 def test_cancel_running_task():
+    """Cancelling a task genuinely blocked in a C-level call (time.sleep)
+    must interrupt it promptly — the signal path, not just the
+    queued-drop path."""
+
+    @ray_tpu.remote
+    def warm():
+        import os as _os
+
+        return _os.getpid()
+
+    ray_tpu.get(warm.remote(), timeout=60)  # worker exists before submit
+
+    started = time.monotonic()
+
     @ray_tpu.remote
     def sleeper():
         time.sleep(60)
         return "never"
 
     ref = sleeper.remote()
-    time.sleep(1.0)  # let it start
+    time.sleep(2.0)  # well into the sleep on the warmed worker
     t0 = time.monotonic()
     ray_tpu.cancel(ref)
     with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
         ray_tpu.get(ref, timeout=30)
-    assert time.monotonic() - t0 < 20  # did not wait out the sleep
+    assert time.monotonic() - t0 < 10  # interrupted, not waited out
+    assert time.monotonic() - started < 40
 
 
 def test_cancel_queued_task():

@@ -1,0 +1,114 @@
+"""Dashboard HTTP API + REST job submission (reference:
+dashboard/dashboard.py routes, dashboard/modules/job/ REST + sdk)."""
+
+import json
+import time
+from urllib import request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def dash():
+    ctx = ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    url = ctx.dashboard_url
+    assert url, "head did not report a dashboard url"
+    yield url
+    ray_tpu.shutdown()
+
+
+def _get(url, path):
+    with request.urlopen(url + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_state_endpoints(dash):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    m = Marker.remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=30) == 1
+
+    status = _get(dash, "/api/cluster_status")
+    assert status["nodes_alive"] >= 1
+    assert status["resources_total"].get("CPU", 0) >= 2
+
+    nodes = _get(dash, "/api/nodes")
+    assert any(n["state"] == "ALIVE" for n in nodes)
+
+    actors = _get(dash, "/api/actors")
+    assert any(a["state"] == "ALIVE" and "Marker" in a["class_name"] for a in actors)
+
+    assert isinstance(_get(dash, "/api/tasks"), list)
+    assert isinstance(_get(dash, "/api/placement_groups"), list)
+    ray_tpu.kill(m)
+
+
+def test_index_and_metrics(dash):
+    with request.urlopen(dash + "/", timeout=10) as r:
+        page = r.read().decode()
+    assert "ray_tpu cluster" in page
+    with request.urlopen(dash + "/metrics", timeout=10) as r:
+        assert r.status == 200
+
+
+def test_job_submission_lifecycle(dash, tmp_path):
+    client = JobSubmissionClient(dash)
+    out = tmp_path / "job_out.txt"
+    sid = client.submit_job(
+        entrypoint=f"python -c \"open('{out}','w').write('done')\" && echo finished",
+        metadata={"who": "test"},
+    )
+    status = client.wait_until_finished(sid, timeout=60)
+    assert status == "SUCCEEDED"
+    assert out.read_text() == "done"
+    assert "finished" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info["metadata"] == {"who": "test"}
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+    assert client.delete_job(sid)
+    with pytest.raises(RuntimeError):
+        client.get_job_status(sid)
+
+
+def test_job_submission_runs_driver_against_cluster(dash, tmp_path):
+    """The submitted entrypoint connects to THIS cluster via
+    RAY_TPU_ADDRESS and runs real tasks."""
+    client = JobSubmissionClient(dash)
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()  # RAY_TPU_ADDRESS is set by the job supervisor\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 3\n"
+        "print('RESULT', ray_tpu.get(f.remote(14)))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    sid = client.submit_job(entrypoint=f"python {script}")
+    assert client.wait_until_finished(sid, timeout=120) == "SUCCEEDED"
+    assert "RESULT 42" in client.get_job_logs(sid)
+
+
+def test_job_stop(dash):
+    client = JobSubmissionClient(dash)
+    sid = client.submit_job(entrypoint="sleep 120")
+    deadline = time.monotonic() + 30
+    while client.get_job_status(sid) == "PENDING" and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert client.get_job_status(sid) == "RUNNING"
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout=30) == "STOPPED"
+
+
+def test_failed_job_status(dash):
+    client = JobSubmissionClient(dash)
+    sid = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(sid, timeout=60) == "FAILED"
+    assert "code 3" in client.get_job_info(sid)["message"]

@@ -1,0 +1,120 @@
+"""SPMD pipeline parallelism (parallel/pipeline.py + models/gpt2_pp.py).
+
+Correctness contract: the microbatched ppermute pipeline computes
+exactly what the sequential stack computes (forward AND gradients), on
+a real multi-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from ray_tpu.models import gpt2  # noqa: E402
+from ray_tpu.models.gpt2_pp import (  # noqa: E402
+    make_pp_loss_fn,
+    merge_pipeline_params,
+    split_pipeline_params,
+)
+from ray_tpu.parallel.pipeline import (  # noqa: E402
+    microbatch,
+    pipeline_spmd,
+    stack_stage_params,
+)
+
+
+def _mesh(pp):
+    devs = jax.devices()
+    if len(devs) < pp:
+        pytest.skip(f"needs {pp} devices")
+    return Mesh(np.array(devs[:pp]), ("pp",))
+
+
+@pytest.mark.parametrize("pp,n_micro", [(2, 4), (4, 8)])
+def test_pipeline_matches_sequential(pp, n_micro):
+    mesh = _mesh(pp)
+    rng = np.random.default_rng(0)
+    Ws = [jnp.asarray(rng.standard_normal((8, 8)) * 0.3) for _ in range(pp)]
+    bs = [jnp.asarray(rng.standard_normal(8) * 0.1) for _ in range(pp)]
+    stage_params = stack_stage_params([{"w": w, "b": b} for w, b in zip(Ws, bs)])
+
+    def stage_fn(p, x):
+        return jax.nn.relu(x @ p["w"] + p["b"])
+
+    pipe = pipeline_spmd(stage_fn, mesh, "pp")
+    x = jnp.asarray(rng.standard_normal((16, 8)))
+    out = jax.jit(pipe)(stage_params, microbatch(x, n_micro)).reshape(16, 8)
+    ref = x
+    for w, b in zip(Ws, bs):
+        ref = jax.nn.relu(ref @ w + b)
+    assert jnp.allclose(out, ref, atol=1e-5)
+
+
+def test_pipeline_gradients_match():
+    """grad through the scan+ppermute schedule == grad of the stack."""
+    mesh = _mesh(2)
+    rng = np.random.default_rng(1)
+    Ws = [jnp.asarray(rng.standard_normal((6, 6)) * 0.3) for _ in range(2)]
+    stage_params = stack_stage_params([{"w": w} for w in Ws])
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    pipe = pipeline_spmd(stage_fn, mesh, "pp")
+    x = jnp.asarray(rng.standard_normal((8, 6)))
+
+    def loss_pipe(sp):
+        return (pipe(sp, microbatch(x, 4)) ** 2).sum()
+
+    def loss_ref(sp):
+        h = x
+        for i in range(2):
+            h = jnp.tanh(h @ sp["w"][i])
+        return (h**2).sum()
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(stage_params)
+    g_ref = jax.grad(loss_ref)(stage_params)
+    err = jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), g_pipe, g_ref
+    )
+    assert all(v < 1e-4 for v in jax.tree.leaves(err)), err
+
+
+def test_gpt2_pp_loss_matches_unpipelined():
+    pp = 2
+    mesh = _mesh(pp)
+    cfg = gpt2.GPT2Config.tiny(remat=False)
+    params = gpt2.init_params(cfg)
+    stage_params, rest = split_pipeline_params(params, cfg, pp)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (4, 32), dtype=np.int32)
+    )
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    ref_loss = float(gpt2.loss_fn(params, inputs, targets, cfg))
+    pp_loss_fn = make_pp_loss_fn(cfg, mesh, n_micro=2)
+    pp_loss = float(jax.jit(pp_loss_fn)(stage_params, rest, inputs, targets))
+    assert abs(pp_loss - ref_loss) < 1e-3, (pp_loss, ref_loss)
+    # Round-trip of the param split (checkpoint interop).
+    merged = merge_pipeline_params(stage_params, rest, cfg)
+    err = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), merged, params)
+    assert all(v == 0.0 for v in jax.tree.leaves(err))
+
+
+def test_gpt2_pp_grads_flow():
+    pp = 2
+    mesh = _mesh(pp)
+    cfg = gpt2.GPT2Config.tiny(remat=False)
+    params = gpt2.init_params(cfg)
+    stage_params, rest = split_pipeline_params(params, cfg, pp)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (4, 32), dtype=np.int32)
+    )
+    loss_fn = make_pp_loss_fn(cfg, mesh, n_micro=4)
+    grads = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))(
+        stage_params, rest, tokens[:, :-1], tokens[:, 1:]
+    )
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(n) for n in norms)
+    assert sum(norms) > 0
