@@ -199,6 +199,10 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     worker = get_global_worker()
     if isinstance(refs, ObjectRef):
         return worker.get([refs], timeout)[0]
+    from ray_tpu.dag import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        return refs.get(timeout)  # None = wait forever, like ObjectRefs
     if not isinstance(refs, (list, tuple)):
         raise TypeError(f"ray_tpu.get takes an ObjectRef or a list of them, got {type(refs)}")
     for r in refs:

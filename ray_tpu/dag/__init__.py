@@ -4,12 +4,13 @@ dag/compiled_dag_node.py:694).
 
 `fn.bind(x)` builds nodes instead of launching tasks; `node.execute(v)`
 materializes one run.  `experimental_compile()` freezes the graph into a
-static per-actor schedule: actors are instantiated once, the topological
-order is precomputed, and repeated `execute()` calls only submit tasks —
-the graph-walk, validation, and actor bring-up costs are paid once
-(the reference gets its speedup the same way, plus preallocated
-shared-memory channels; here the object store's shm path carries the
-data plane)."""
+static per-actor schedule: actors are instantiated once and, for
+all-actor-method graphs, execution switches to mutable shared-memory
+channels written in place per call with resident per-actor op loops —
+no task submission or object-store traffic on the steady-state path
+(reference: compiled_dag_node.py:1639 schedules +
+experimental_mutable_object_manager.h:48 channels).  Graphs with
+driver-side FunctionNodes keep the per-node task path."""
 
 from __future__ import annotations
 
@@ -77,8 +78,10 @@ class DAGNode:
     def _execute_one(self, cache: dict, input_val, ctx: dict):
         raise NotImplementedError
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def experimental_compile(
+        self, buffer_size_bytes: int = 8 * 1024 * 1024, max_inflight: int = 4
+    ) -> "CompiledDAG":
+        return CompiledDAG(self, buffer_size_bytes, max_inflight)
 
 
 class InputNode(DAGNode):
@@ -181,12 +184,104 @@ class MultiOutputNode(DAGNode):
         return [cache[n._stable_uuid] for n in self._bound_args]
 
 
+def _actor_channel_loop(self, ops, chan_paths):
+    """Runs INSIDE a compiled DAG's actor (via __ray_call__): a frozen
+    per-actor op schedule reading args from in-channels and local
+    results, writing cross-process results to out-channels (reference:
+    compiled_dag_node.py:1639 per-actor op schedules executing over
+    preallocated channels).
+
+    Application errors do NOT kill the loop: the error is serialized and
+    flows through the op's out-channels like a result (downstream ops
+    see it, skip execution, and propagate), so the driver's get raises
+    the original exception and the DAG stays usable."""
+    from ray_tpu import exceptions
+    from ray_tpu._private import serialization
+    from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+    chans = {p: Channel(p) for p in chan_paths}
+    try:
+        while True:
+            local = {}
+            for op in ops:
+                args = []
+                arg_error = None
+                for kind, val in op["args"]:
+                    if kind == "chan":
+                        tag, v = serialization.deserialize(
+                            memoryview(chans[val].read(timeout=None))
+                        )
+                        if tag == serialization.TAG_ERROR:
+                            arg_error = v
+                        args.append(v)
+                    elif kind == "local":
+                        v = local[val]
+                        if isinstance(v, exceptions.RayTaskError):
+                            arg_error = v
+                        args.append(v)
+                    else:  # const
+                        args.append(val)
+                if arg_error is not None:
+                    result, tag = arg_error, serialization.TAG_ERROR
+                else:
+                    try:
+                        result = getattr(self, op["method"])(*args)
+                        tag = serialization.TAG_NORMAL
+                    except ChannelClosed:
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        result = exceptions.RayTaskError.from_exception(
+                            e, f"compiled_dag.{op['method']}"
+                        )
+                        tag = serialization.TAG_ERROR
+                local[op["uuid"]] = result
+                if op["outs"]:
+                    blob = serialization.serialize_to_bytes(result, tag=tag)
+                    for out in op["outs"]:
+                        chans[out].write(blob, timeout=None)
+    except ChannelClosed:
+        # Teardown: propagate the poison downstream so every consumer
+        # (other actor loops, the driver) unblocks.
+        for op in ops:
+            for out in op["outs"]:
+                try:
+                    chans[out].close()
+                except Exception:
+                    pass
+        return "closed"
+
+
+class CompiledDAGRef:
+    """Result handle of one compiled execution; resolved by ray_tpu.get
+    (reference: CompiledDAGRef in dag/compiled_dag_node.py)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._read_result(self._seq, timeout)
+
+
 class CompiledDAG:
     """Static schedule + pinned actors (reference:
     dag/compiled_dag_node.py:694 — per-actor op schedules :1639,
-    execute :2118)."""
+    execute :2118).
 
-    def __init__(self, root: DAGNode):
+    When the whole graph is actor-method nodes, execution switches to
+    the zero-copy data plane: one mutable shared-memory channel per
+    cross-process edge, written in place every execution, with each
+    actor running its frozen op schedule in a resident loop — no task
+    submission, no object store, no RPC per call (reference:
+    experimental_mutable_object_manager.h:48).  Graphs containing
+    driver-side FunctionNodes fall back to per-node task submission."""
+
+    def __init__(
+        self,
+        root: DAGNode,
+        buffer_size_bytes: int = 8 * 1024 * 1024,
+        max_inflight: int = 4,
+    ):
         self._root = root
         self._order = root._topo()  # frozen schedule
         self._ctx: dict = {"actors": {}}
@@ -196,24 +291,227 @@ class CompiledDAG:
             if isinstance(node, ClassNode):
                 node._execute_one(cache, None, self._ctx)
         self._lock = threading.Lock()
+        self._seq = 0
+        self._results: Dict[int, Any] = {}
+        self._next_result = 1
+        self._partial: List[Any] = []
+        self._channels_on = False
+        self._buffer_size = buffer_size_bytes
+        # Flow control: channels hold one message each, so in-flight
+        # executions are bounded (reference: max_inflight_executions).
+        self._max_inflight = max_inflight
+        try:
+            self._build_channel_plan(cache)
+        except _NotChannelable:
+            pass
 
+    # -- channel compilation -------------------------------------------
+    def _build_channel_plan(self, actor_cache: Dict[str, Any]):
+        import os
+        import tempfile
+
+        method_nodes = []
+        for n in self._order:
+            if isinstance(n, (InputNode, InputAttributeNode, ClassNode, MultiOutputNode)):
+                continue
+            if isinstance(n, ClassMethodNode):
+                if n._bound_kwargs:
+                    raise _NotChannelable  # kwargs not in the op schedule
+                method_nodes.append(n)
+            else:
+                raise _NotChannelable  # FunctionNode etc: legacy path
+        if not method_nodes:
+            raise _NotChannelable
+        outputs = (
+            list(self._root._bound_args)
+            if isinstance(self._root, MultiOutputNode)
+            else [self._root]
+        )
+        if not all(isinstance(o, ClassMethodNode) for o in outputs):
+            raise _NotChannelable
+
+        chan_dir = tempfile.mkdtemp(prefix="ray_tpu_dag_", dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+        self._chan_dir = chan_dir
+        # tmpfs survives the process: reclaim even when the user never
+        # calls teardown (GC / interpreter exit).
+        import shutil
+        import weakref
+
+        self._chan_finalizer = weakref.finalize(
+            self, shutil.rmtree, chan_dir, ignore_errors=True
+        )
+        counter = [0]
+
+        def new_chan() -> str:
+            counter[0] += 1
+            return os.path.join(chan_dir, f"c{counter[0]}")
+
+        actor_of = {n._stable_uuid: n._bound_args[0]._stable_uuid for n in method_nodes}
+        ops_by_actor: Dict[str, list] = {}
+        actor_chans: Dict[str, set] = {}
+        # (chan_path, key-or-None) the driver writes each execute.
+        self._input_chans: List[tuple] = []
+
+        for n in method_nodes:
+            a_uuid = actor_of[n._stable_uuid]
+            op = {"uuid": n._stable_uuid, "method": n._method, "args": [], "outs": []}
+            for arg in n._bound_args[1:]:
+                if isinstance(arg, InputNode):
+                    p = new_chan()
+                    self._input_chans.append((p, None))
+                    op["args"].append(("chan", p))
+                    actor_chans.setdefault(a_uuid, set()).add(p)
+                elif isinstance(arg, InputAttributeNode):
+                    p = new_chan()
+                    self._input_chans.append((p, arg._key))
+                    op["args"].append(("chan", p))
+                    actor_chans.setdefault(a_uuid, set()).add(p)
+                elif isinstance(arg, ClassMethodNode):
+                    if actor_of[arg._stable_uuid] == a_uuid:
+                        op["args"].append(("local", arg._stable_uuid))
+                    else:
+                        p = new_chan()
+                        # producer writes, this actor reads
+                        prod_uuid = arg._stable_uuid
+                        for ops in ops_by_actor.values():
+                            for prod_op in ops:
+                                if prod_op["uuid"] == prod_uuid:
+                                    prod_op["outs"].append(p)
+                        actor_chans.setdefault(actor_of[prod_uuid], set()).add(p)
+                        op["args"].append(("chan", p))
+                        actor_chans.setdefault(a_uuid, set()).add(p)
+                elif isinstance(arg, DAGNode):
+                    raise _NotChannelable
+                else:
+                    op["args"].append(("const", arg))
+            ops_by_actor.setdefault(a_uuid, []).append(op)
+
+        # Output channels to the driver, in MultiOutput order.
+        self._output_chans = []
+        for o in outputs:
+            p = new_chan()
+            for ops in ops_by_actor.values():
+                for op in ops:
+                    if op["uuid"] == o._stable_uuid:
+                        op["outs"].append(p)
+            actor_chans.setdefault(actor_of[o._stable_uuid], set()).add(p)
+            self._output_chans.append(p)
+
+        from ray_tpu.experimental.channel import Channel
+
+        # Driver creates every channel file before the loops start.
+        all_paths = sorted({p for s in actor_chans.values() for p in s})
+        for p in all_paths:
+            Channel.create_file(p, self._buffer_size)
+        self._driver_in = [(Channel(p), key) for p, key in self._input_chans]
+        self._driver_out = [Channel(p) for p in self._output_chans]
+
+        # Kick off the resident loops.
+        self._loop_refs = []
+        for a_uuid, ops in ops_by_actor.items():
+            actor = self._ctx["actors"][a_uuid]
+            self._loop_refs.append(
+                actor.__ray_call__.remote(
+                    _actor_channel_loop, ops, sorted(actor_chans.get(a_uuid, ()))
+                )
+            )
+        self._channels_on = True
+
+    # -- execution ------------------------------------------------------
     def execute(self, *input_vals):
         input_val = input_vals[0] if len(input_vals) == 1 else (input_vals if input_vals else None)
+        if self._channels_on:
+            from ray_tpu._private import serialization
+
+            def extract(key):
+                if key is None:
+                    return input_val
+                if isinstance(key, str) and isinstance(input_val, dict):
+                    return input_val[key]
+                if isinstance(key, int):
+                    return input_val[key]
+                return getattr(input_val, key)
+
+            with self._lock:
+                if self._seq - self._next_result + 1 >= self._max_inflight:
+                    raise RuntimeError(
+                        f"{self._max_inflight} executions already in flight; "
+                        f"ray_tpu.get earlier results first (raise max_inflight "
+                        f"at experimental_compile if the pipeline is deeper)"
+                    )
+                self._seq += 1
+                blobs: Dict[Any, bytes] = {}
+                for chan, key in self._driver_in:
+                    if key not in blobs:
+                        blobs[key] = serialization.serialize_to_bytes(extract(key))
+                    chan.write(blobs[key])
+                return CompiledDAGRef(self, self._seq)
         cache: Dict[str, Any] = {}
         with self._lock:
             for node in self._order:
                 cache[node._stable_uuid] = node._execute_one(cache, input_val, self._ctx)
         return cache[self._root._stable_uuid]
 
+    def _read_result(self, seq: int, timeout: Optional[float]):
+        from ray_tpu import exceptions
+        from ray_tpu._private import serialization
+
+        with self._lock:
+            while self._next_result <= seq:
+                # _partial survives a ChannelTimeout partway through a
+                # multi-output read: already-consumed channels are not
+                # re-read on retry, so results can't cross executions.
+                while len(self._partial) < len(self._driver_out):
+                    chan = self._driver_out[len(self._partial)]
+                    self._partial.append(
+                        serialization.deserialize(memoryview(chan.read(timeout)))
+                    )
+                vals, self._partial = self._partial, []
+                if any(tag == serialization.TAG_ERROR for tag, _ in vals):
+                    out = next(v for tag, v in vals if tag == serialization.TAG_ERROR)
+                else:
+                    out = (
+                        [v for _, v in vals]
+                        if isinstance(self._root, MultiOutputNode)
+                        else vals[0][1]
+                    )
+                self._results[self._next_result] = out
+                self._next_result += 1
+            result = self._results.pop(seq)
+        if isinstance(result, exceptions.RayTaskError):
+            raise result.as_instanceof_cause()
+        return result
+
     def teardown(self):
+        import shutil
+
         import ray_tpu
 
+        if self._channels_on:
+            for chan, _ in self._driver_in:
+                try:
+                    chan.close()
+                except Exception:
+                    pass
+            for chan in self._driver_out:
+                try:
+                    chan.close()
+                except Exception:
+                    pass
+            self._channels_on = False
+            # The channel files live in tmpfs: they must be unlinked or
+            # the RAM survives this process.
+            shutil.rmtree(getattr(self, "_chan_dir", ""), ignore_errors=True)
         for actor in self._ctx.get("actors", {}).values():
             try:
                 ray_tpu.kill(actor)
             except Exception:
                 pass
         self._ctx["actors"] = {}
+
+
+class _NotChannelable(Exception):
+    pass
 
 
 def bind_function(remote_fn):

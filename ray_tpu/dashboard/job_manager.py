@@ -110,10 +110,8 @@ class JobManager:
         with self._lock:
             self._procs[submission_id] = proc
         # A stop may have landed between submit and the Popen above (its
-        # _procs lookup found nothing to kill): honor it now instead of
-        # reviving the record to RUNNING.
-        latest = self._get(submission_id) or info
-        if latest["status"] == "STOPPED":
+        # _procs lookup found nothing to kill): the tombstone decides.
+        if self._stop_requested(submission_id):
             try:
                 proc.kill()
             except OSError:
@@ -121,6 +119,7 @@ class JobManager:
             proc.wait()
             with self._lock:
                 self._procs.pop(submission_id, None)
+            self._finalize_stopped(submission_id, info)
             return
         info.update(status="RUNNING", message=f"pid {proc.pid}")
         self._put(info)
@@ -128,14 +127,21 @@ class JobManager:
         with self._lock:
             self._procs.pop(submission_id, None)
         latest = self._get(submission_id) or info
-        if latest["status"] == "STOPPED":
-            return  # stop_job already finalized it
+        if self._stop_requested(submission_id):
+            self._finalize_stopped(submission_id, latest)
+            return
         if rc == 0:
             latest.update(status="SUCCEEDED", message="exited with code 0")
         else:
             latest.update(status="FAILED", message=f"exited with code {rc}")
         latest["end_time"] = time.time()
         self._put(latest)
+
+    def _finalize_stopped(self, submission_id: str, info: Dict[str, Any]) -> None:
+        if info.get("status") != "STOPPED":
+            info.update(status="STOPPED", message="stopped by user")
+            info.setdefault("end_time", time.time())
+            self._put(info)
 
     def get_job_status(self, submission_id: str) -> Optional[Dict[str, Any]]:
         return self._get(submission_id)
@@ -147,13 +153,20 @@ class JobManager:
         except OSError:
             return ""
 
+    def _stop_requested(self, submission_id: str) -> bool:
+        return bool(
+            self._gcs.call("kv_exists", (JOB_KV_NS, f"stop:{submission_id}".encode()))
+        )
+
     def stop_job(self, submission_id: str) -> bool:
         info = self._get(submission_id)
         if info is None:
             return False
-        # Mark STOPPED BEFORE killing: the supervisor thread finalizes the
-        # record when the process exits, and must see the stop (writing
-        # after the kill races its FAILED write).
+        # A monotone tombstone decides every stop/start race: the
+        # supervisor consults it before marking RUNNING and when
+        # finalizing, so a stop can never be overwritten by a concurrent
+        # status transition.
+        self._gcs.call("kv_put", (JOB_KV_NS, f"stop:{submission_id}".encode(), b"1", True))
         if info["status"] not in TERMINAL:
             info.update(status="STOPPED", message="stopped by user", end_time=time.time())
             self._put(info)
@@ -186,6 +199,7 @@ class JobManager:
         if info["status"] not in TERMINAL:
             raise ValueError(f"job {submission_id} is {info['status']}; stop it first")
         self._gcs.call("kv_del", (JOB_KV_NS, submission_id.encode()))
+        self._gcs.call("kv_del", (JOB_KV_NS, f"stop:{submission_id}".encode()))
         try:
             os.remove(self._log_path(submission_id))
         except OSError:

@@ -1,0 +1,3 @@
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+__all__ = ["Channel", "ChannelClosed"]

@@ -1,0 +1,220 @@
+"""Compiled-DAG zero-copy channels (reference:
+experimental_mutable_object_manager.h:48, shared_memory_channel.py,
+per-actor schedules compiled_dag_node.py:1639)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import Channel, ChannelClosed, ChannelTimeout
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Channel primitive
+
+
+def test_channel_roundtrip(tmp_path):
+    p = str(tmp_path / "c1")
+    with open(p, "wb") as f:
+        f.truncate(32 + 1024)
+    w, r = Channel(p), Channel(p)
+    w.write(b"hello")
+    assert r.read() == b"hello"
+    w.write(b"world")  # ack allowed the second write
+    assert r.read() == b"world"
+
+
+def test_channel_flow_control(tmp_path):
+    p = str(tmp_path / "c2")
+    with open(p, "wb") as f:
+        f.truncate(32 + 1024)
+    w, r = Channel(p), Channel(p)
+    w.write(b"a")
+    with pytest.raises(ChannelTimeout):
+        w.write(b"b", timeout=0.3)  # reader hasn't consumed
+    assert r.read() == b"a"
+    w.write(b"b", timeout=5)
+    assert r.read() == b"b"
+
+
+def test_channel_poison(tmp_path):
+    p = str(tmp_path / "c3")
+    with open(p, "wb") as f:
+        f.truncate(32 + 1024)
+    w, r = Channel(p), Channel(p)
+    w.close()
+    with pytest.raises(ChannelClosed):
+        r.read(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Compiled DAG over channels
+
+
+def test_compiled_pipeline_two_actors():
+    """A 2-actor pipeline: data flows A -> B entirely over channels,
+    state persists, and results come back in submission order."""
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, inc):
+            self.inc = inc
+            self.count = 0
+
+        def step(self, x):
+            self.count += 1
+            return x + self.inc
+
+        def calls(self):
+            return self.count
+
+    a, b = Stage.bind(1), Stage.bind(10)
+    with InputNode() as inp:
+        dag = b.step.bind(a.step.bind(inp))
+    compiled = dag.experimental_compile(max_inflight=8)
+    assert compiled._channels_on  # really on the channel plane
+    refs = [compiled.execute(i) for i in range(5)]
+    assert [ray_tpu.get(r) for r in refs] == [i + 11 for i in range(5)]
+    compiled.teardown()
+
+
+def test_compiled_multi_output_fan():
+    @ray_tpu.remote
+    class Math:
+        def double(self, x):
+            return x * 2
+
+        def square(self, x):
+            return x * x
+
+    m1, m2 = Math.bind(), Math.bind()
+    with InputNode() as inp:
+        dag = MultiOutputNode([m1.double.bind(inp), m2.square.bind(inp)])
+    compiled = dag.experimental_compile()
+    assert compiled._channels_on
+    assert ray_tpu.get(compiled.execute(6)) == [12, 36]
+    assert ray_tpu.get(compiled.execute(3)) == [6, 9]
+    compiled.teardown()
+
+
+def test_compiled_channel_throughput_beats_task_path():
+    """The channel plane must clearly beat per-call task submission on a
+    tiny-payload pipeline (that's its reason to exist)."""
+
+    @ray_tpu.remote
+    class Echo:
+        def echo(self, x):
+            return x
+
+    with InputNode() as inp:
+        dag = Echo.bind().echo.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled._channels_on
+    ray_tpu.get(compiled.execute(0))  # warm
+    n = 200
+    t0 = time.monotonic()
+    for i in range(n):
+        ray_tpu.get(compiled.execute(i))
+    chan_rate = n / (time.monotonic() - t0)
+    compiled.teardown()
+
+    actor = Echo.remote()
+    ray_tpu.get(actor.echo.remote(0))
+    t0 = time.monotonic()
+    for i in range(n):
+        ray_tpu.get(actor.echo.remote(i))
+    task_rate = n / (time.monotonic() - t0)
+    ray_tpu.kill(actor)
+    assert chan_rate > task_rate * 1.5, (chan_rate, task_rate)
+
+
+def test_compiled_teardown_unblocks_actors():
+    @ray_tpu.remote
+    class S:
+        def f(self, x):
+            return x
+
+    with InputNode() as inp:
+        dag = S.bind().f.bind(inp)
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(1)) == 1
+    compiled.teardown()  # must not hang
+
+
+def test_compiled_error_propagates_and_dag_survives():
+    """An actor-method exception flows to the driver's get as the
+    original error, and the DAG keeps working afterwards."""
+
+    @ray_tpu.remote
+    class Fragile:
+        def f(self, x):
+            if x < 0:
+                raise ValueError("negative!")
+            return x * 2
+
+    with InputNode() as inp:
+        dag = Fragile.bind().f.bind(inp)
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(4)) == 8
+    with pytest.raises(ValueError):
+        ray_tpu.get(compiled.execute(-1))
+    assert ray_tpu.get(compiled.execute(5)) == 10  # still alive
+    compiled.teardown()
+
+
+def test_compiled_inflight_cap():
+    @ray_tpu.remote
+    class Slow:
+        def f(self, x):
+            time.sleep(0.3)
+            return x
+
+    with InputNode() as inp:
+        dag = Slow.bind().f.bind(inp)
+    compiled = dag.experimental_compile(max_inflight=2)
+    r1 = compiled.execute(1)
+    compiled.execute(2)
+    with pytest.raises(RuntimeError, match="in flight"):
+        compiled.execute(3)
+    assert ray_tpu.get(r1) == 1
+    compiled.teardown()
+
+
+def test_compiled_teardown_cleans_tmpfs():
+    import os
+
+    @ray_tpu.remote
+    class S:
+        def f(self, x):
+            return x
+
+    with InputNode() as inp:
+        dag = S.bind().f.bind(inp)
+    compiled = dag.experimental_compile()
+    chan_dir = compiled._chan_dir
+    assert os.path.isdir(chan_dir)
+    ray_tpu.get(compiled.execute(1))
+    compiled.teardown()
+    assert not os.path.exists(chan_dir)  # tmpfs reclaimed
+
+
+def test_function_node_falls_back_to_task_path():
+    @ray_tpu.remote
+    def plain(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = plain.bind(inp)
+    compiled = dag.experimental_compile()
+    assert not compiled._channels_on
+    assert ray_tpu.get(compiled.execute(41)) == 42
+    compiled.teardown()
