@@ -77,6 +77,16 @@ def init(
     global _node_processes
     from ray_tpu._private import node as node_mod
 
+    if address and address.startswith("ray://"):
+        # Remote-driver (Ray Client) mode: swap in a ClientWorker that
+        # proxies the Worker interface to the cluster's client server —
+        # the rest of the API layer works unchanged on top of it
+        # (reference: util/client/ARCHITECTURE.md).
+        from ray_tpu.util.client import connect as _client_connect
+
+        client = _client_connect(address)
+        return ClientContext(client, address)
+
     with _init_lock:
         worker = get_global_worker()
         if worker.connected:
@@ -86,6 +96,7 @@ def init(
         CONFIG.initialize(_system_config)
         if object_store_memory is not None:
             CONFIG._overrides["object_store_memory_cap"] = int(object_store_memory)
+        CONFIG._overrides["log_to_driver"] = bool(log_to_driver)
 
         if address is None and os.environ.get("RAY_TPU_ADDRESS"):
             address = os.environ["RAY_TPU_ADDRESS"]
@@ -129,6 +140,29 @@ def init(
         return RayContext(worker)
 
 
+class ClientContext:
+    """Returned by init("ray://..."); mirrors RayContext's surface."""
+
+    def __init__(self, client, address: str):
+        self._client = client
+        self.address_info = {"address": address, "mode": "client"}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+    def disconnect(self):
+        self._client.disconnect()
+        # Drop the shim so a later in-cluster init() builds a real Worker.
+        from ray_tpu._private import worker as worker_mod
+
+        with worker_mod._worker_lock:
+            if worker_mod._global_worker is self._client:
+                worker_mod._global_worker = None
+
+
 class RayContext:
     def __init__(self, worker):
         self._worker = worker
@@ -160,6 +194,13 @@ def shutdown():
         worker = global_worker_maybe()
         if worker is not None and worker.connected:
             worker.disconnect()
+        if getattr(worker, "mode", None) == "client":
+            # Drop the client shim so a later in-cluster init() builds a
+            # real Worker.
+            from ray_tpu._private import worker as worker_mod
+
+            with worker_mod._worker_lock:
+                worker_mod._global_worker = None
         if _node_processes is not None:
             _node_processes.terminate()
             _node_processes = None

@@ -31,6 +31,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "object_spilling_enabled": True,
     # Spill directory ("" = <store_dir>/spill).
     "object_spilling_dir": "",
+    # Background spilling starts above the high watermark and stops at
+    # the low one; file IO runs off the raylet loop.
+    "object_spill_high_watermark": 0.8,
+    "object_spill_low_watermark": 0.6,
+    "object_spill_check_period_ms": 200,
     # --- scheduling ---
     "worker_lease_timeout_ms": 30_000,
     # Top-k fraction of nodes considered by the hybrid scheduling policy.
@@ -51,6 +56,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # 0 picks a free port) ---
     "dashboard_host": "127.0.0.1",
     "dashboard_port": 0,
+    # Ray Client server (ray:// remote drivers); -1 disables (reference
+    # default port 10001 — enable with RAY_TPU_ray_client_server_port).
+    # Bind 0.0.0.0 to accept drivers from other hosts.
+    "ray_client_server_host": "127.0.0.1",
+    "ray_client_server_port": -1,
     # --- memory monitor / OOM killing (reference: memory_monitor.h:52,
     # worker_killing_policy_group_by_owner.cc) ---
     "memory_monitor_enabled": True,
@@ -120,6 +130,8 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "collective_chunk_bytes": 16 * 1024**2,
     # --- logging ---
     "log_to_driver": True,
+    # Worker-log tail period for the per-node log monitor.
+    "log_monitor_period_ms": 500,
 }
 
 

@@ -78,6 +78,28 @@ def main():
                     )
             except Exception:
                 logging.getLogger(__name__).exception("dashboard failed to start")
+        client_server_proc = None
+        if CONFIG.ray_client_server_port >= 0:
+            # ray:// remote-driver endpoint, its own driver process
+            # (reference: util/client/server launched by `ray start`).
+            import subprocess
+            import sys as _sys
+
+            from ray_tpu._private.node import child_env
+
+            with open(f"{args.session_dir}/logs/client_server.log", "ab") as cs_log:
+                client_server_proc = subprocess.Popen(
+                    [
+                        _sys.executable, "-m", "ray_tpu.util.client.server_main",
+                        "--gcs-address", args.gcs_address,
+                        "--listen",
+                        f"tcp:{CONFIG.ray_client_server_host}:"
+                        f"{CONFIG.ray_client_server_port or 10001}",
+                    ],
+                    env=child_env(),
+                    stdout=cs_log,
+                    stderr=subprocess.STDOUT,
+                )
         from ray_tpu._private.node import owner_watchdog
 
         watchdog_task = (
@@ -86,6 +108,8 @@ def main():
             else None
         )
         await stop_event.wait()
+        if client_server_proc is not None and client_server_proc.poll() is None:
+            client_server_proc.terminate()  # dies with the cluster, not after it
         try:
             await asyncio.wait_for(raylet.stop(), timeout=4)
             await asyncio.wait_for(gcs.stop(), timeout=2)

@@ -107,6 +107,8 @@ class ObjectStoreCore:
         self.spilled: Dict[ObjectID, Tuple[str, int]] = {}  # oid -> (path, size)
         self.spilled_bytes = 0
         self.num_spilled = 0
+        # Async spills in flight (excluded from LRU candidate scans).
+        self._spilling: set = set()
         self.num_restored = 0
         # In-progress chunked creates: oid -> ("arena", view) | ("file", mmap, path)
         self._creates: Dict[ObjectID, tuple] = {}
@@ -155,6 +157,72 @@ class ObjectStoreCore:
         self.num_spilled += 1
         return True
 
+    async def spill_pressure_async(self, loop) -> int:
+        """Background high-watermark spilling with the file IO off the
+        event loop (reference: local_object_manager.h:41 IO workers).
+        Keeps the synchronous reserve() path a rare fallback: by the time
+        an allocation needs room, LRU objects are already on disk."""
+        if not CONFIG.object_spilling_enabled or self.capacity <= 0:
+            return 0
+        hi = CONFIG.object_spill_high_watermark * self.capacity
+        lo = CONFIG.object_spill_low_watermark * self.capacity
+        if self.used <= hi:
+            return 0
+        n = 0
+        for e in self.lru_candidates():
+            if self.used <= lo:
+                break
+            if await self._spill_one_async(e, loop):
+                n += 1
+        return n
+
+    async def _spill_one_async(self, e: ObjectEntry, loop) -> bool:
+        """Like _spill_one, but each disk write runs in the default
+        executor so a multi-GB burst never stalls scheduling, heartbeats,
+        or pulls.  Store bookkeeping stays on the loop thread; the entry
+        is re-validated after every await (it can be deleted mid-spill),
+        and marked in-flight so the synchronous reserve-path spiller
+        doesn't duplicate the same disk write on the hot path."""
+        self._spilling.add(e.object_id)
+        try:
+            return await self._spill_one_async_inner(e, loop)
+        finally:
+            self._spilling.discard(e.object_id)
+
+    async def _spill_one_async_inner(self, e: ObjectEntry, loop) -> bool:
+        size = e.size
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, e.object_id.hex())
+        tmp = path + ".w"
+        slice_size = 8 * 1024 * 1024
+        try:
+            with open(tmp, "wb") as f:
+                off = 0
+                while off < size:
+                    r = self.read_chunk(e.object_id, off, min(slice_size, size - off))
+                    if r is None:
+                        raise OSError("object vanished mid-spill")
+                    data = bytes(r[1])  # copy: the view dies across awaits
+                    await loop.run_in_executor(None, f.write, data)
+                    off += len(data)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        if self.objects.get(e.object_id) is not e or not self.delete_in_memory(e.object_id):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        self.spilled[e.object_id] = (path, size)
+        self.spilled_bytes += size
+        self.num_spilled += 1
+        return True
+
     def _spill_until_fits(self, need: int) -> bool:
         if need > self.capacity:
             return False  # can never fit: don't drain the store trying
@@ -171,7 +239,9 @@ class ObjectStoreCore:
             (
                 e
                 for e in self.objects.values()
-                if e.state == SEALED and e.pin_count == 0
+                if e.state == SEALED
+                and e.pin_count == 0
+                and e.object_id not in self._spilling
             ),
             key=lambda e: e.last_access,
         )

@@ -40,7 +40,7 @@ class WorkerHandle:
         "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
         "running", "spawn_time", "idle_since", "resources_held", "bundle_key",
         "direct_address", "lease_owner", "lease_blocked", "reserved",
-        "env_hash",
+        "env_hash", "log_path",
     )
 
     def __init__(self, worker_id: WorkerID, proc, job_id: JobID):
@@ -72,6 +72,9 @@ class WorkerHandle:
         # workers whose environment matches (reference: worker_pool.h:216
         # keys its pools by runtime_env_hash too).
         self.env_hash = ""
+        # Worker stdout/stderr file; tailed by the log monitor and
+        # streamed to the job's driver (reference: log_monitor.py).
+        self.log_path: Optional[str] = None
 
 
 class Raylet:
@@ -165,7 +168,94 @@ class Raylet:
         self._bg.append(self.loop.create_task(self._idle_reaper_loop()))
         if CONFIG.memory_monitor_enabled:
             self._bg.append(self.loop.create_task(self._memory_monitor_loop()))
+        if CONFIG.object_spilling_enabled:
+            self._bg.append(self.loop.create_task(self._spill_pressure_loop()))
+        if CONFIG.log_to_driver:
+            self._bg.append(self.loop.create_task(self._log_monitor_loop()))
         logger.info("raylet %s listening on %s", self.node_id.hex()[:8], self.address)
+
+    async def _log_monitor_loop(self):
+        """Tail this node's worker logs and publish new lines to the
+        owning job's log channel (reference: log_monitor.py tailing →
+        pubsub → driver printing).  Infra-formatted lines are skipped —
+        the stream carries user prints/stderr.  Exited workers get one
+        final tail (their last prints matter most) before their state is
+        pruned."""
+        offsets: Dict[bytes, int] = {}
+        # key -> (log_path, job hex, pid, worker hex): survives the worker
+        # leaving self.workers for exactly one final tail.
+        tracked: Dict[bytes, tuple] = {}
+        while not self._stopping:
+            await asyncio.sleep(CONFIG.log_monitor_period_ms / 1000)
+            if self.gcs is None or not self.gcs._connected:
+                continue
+            live_keys = set()
+            for w in list(self.workers.values()):
+                if w.log_path:
+                    key = w.worker_id.binary()
+                    live_keys.add(key)
+                    tracked[key] = (
+                        w.log_path, w.job_id.hex(), w.pid, w.worker_id.hex()[:12]
+                    )
+            for key, (log_path, job_hex, pid, worker_hex) in list(tracked.items()):
+                final = key not in live_keys
+                await self._tail_one_log(offsets, key, log_path, job_hex, pid, worker_hex)
+                if final:
+                    tracked.pop(key, None)
+                    offsets.pop(key, None)
+
+    async def _tail_one_log(self, offsets, key, log_path, job_hex, pid, worker_hex):
+        try:
+            size = os.path.getsize(log_path)
+        except OSError:
+            return
+        off = offsets.get(key, 0)
+        if size <= off:
+            return
+        cap = 256 * 1024
+        try:
+            with open(log_path, "rb") as f:
+                f.seek(off)
+                chunk = f.read(min(size - off, cap))
+        except OSError:
+            return
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            if len(chunk) < cap:
+                return  # partial line: wait for its newline
+            nl = len(chunk) - 1  # one giant line: ship it split, keep moving
+        offsets[key] = off + nl + 1
+        lines = [
+            ln.decode("utf-8", "replace")
+            for ln in chunk[: nl + 1].splitlines()
+            if not ln.startswith(b"[worker ")  # infra log format
+        ]
+        if not lines:
+            return
+        try:
+            await self.gcs.push(
+                "publish",
+                (
+                    f"logs:{job_hex}",
+                    {
+                        "pid": pid,
+                        "worker": worker_hex,
+                        "node": os.uname().nodename,
+                        "lines": lines,
+                    },
+                ),
+            )
+        except rpc.RpcError:
+            pass
+
+    async def _spill_pressure_loop(self):
+        period = CONFIG.object_spill_check_period_ms / 1000
+        while not self._stopping:
+            await asyncio.sleep(period)
+            try:
+                await self.store.spill_pressure_async(self.loop)
+            except Exception:
+                logger.exception("background spill failed")
 
     # ------------------------------------------------------------------
     # memory monitor / OOM worker killing (reference:
@@ -486,6 +576,9 @@ class Raylet:
         env["RAY_TPU_JOB_ID"] = job_id.hex()
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
         env["RAY_TPU_STORE_DIR"] = self.store.store_dir
+        # Unbuffered so user prints reach the log file (and the driver's
+        # log stream) as they happen, not at process exit.
+        env["PYTHONUNBUFFERED"] = "1"
         if self.session_dir:
             env["RAY_TPU_SESSION_DIR"] = self.session_dir
         if runtime_env:
@@ -494,7 +587,8 @@ class Raylet:
             env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        log_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log")
+        out = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.default_worker"],
             env=env,
@@ -506,6 +600,7 @@ class Raylet:
         w = WorkerHandle(worker_id, proc, job_id)
         w.actor_id = actor_id
         w.env_hash = runtime_env_mod.env_hash(runtime_env)
+        w.log_path = log_path
         self.workers[worker_id] = w
         return w
 

@@ -210,7 +210,27 @@ class RpcServer:
                 return
             if conn.closed:
                 return
-            data = pickle.dumps(("rep", req_id, ok, result), protocol=5)
+            try:
+                data = pickle.dumps(("rep", req_id, ok, result), protocol=5)
+            except Exception:
+                # Dynamically-created exception classes (e.g. RayTaskError
+                # derived from the user's error type) need pickle-by-value.
+                try:
+                    import cloudpickle
+
+                    data = cloudpickle.dumps(("rep", req_id, ok, result), protocol=5)
+                except Exception as ser_err:
+                    # Truly unserializable: reply with an error instead of
+                    # leaving the caller to hit its full call timeout.
+                    data = pickle.dumps(
+                        (
+                            "rep",
+                            req_id,
+                            False,
+                            RpcError(f"unserializable {method} reply: {ser_err}"),
+                        ),
+                        protocol=5,
+                    )
             conn.writer.write(_LEN.pack(len(data)) + data)
             await conn.drain()
         elif msg[0] == "push":

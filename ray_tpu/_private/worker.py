@@ -397,6 +397,10 @@ class Worker:
         self.namespace = reply["namespace"]
         self.session_info = reply["session_info"]
         self.gcs_client.call("subscribe", "actors")
+        if CONFIG.log_to_driver:
+            # Worker stdout/stderr of this job streams here (reference:
+            # log_monitor.py → driver printing with worker prefixes).
+            self.gcs_client.call("subscribe", f"logs:{self.job_id.hex()}")
         self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push)
         # Workers mirror the driver's import paths (driver_sys_path, set
         # above) so functions pickled by reference resolve there too; the
@@ -603,6 +607,12 @@ class Worker:
             channel, msg = payload
             if channel == "actors":
                 self.actor_cache.on_update(msg)
+            elif channel.startswith("logs:"):
+                import sys as _sys
+
+                prefix = f"({msg.get('worker', '?')} pid={msg.get('pid', '?')})"
+                for line in msg.get("lines", ()):
+                    print(f"{prefix} {line}", file=_sys.stderr)
 
     def _on_gcs_reconnected(self):
         """The GCS restarted: re-subscribe and re-bind this driver's job so
@@ -610,6 +620,8 @@ class Worker:
         try:
             self.gcs_client.call("subscribe", "actors")
             if self.mode == "driver" and self.job_id is not None:
+                if CONFIG.log_to_driver:
+                    self.gcs_client.call("subscribe", f"logs:{self.job_id.hex()}")
                 self.gcs_client.call("reattach_driver", {"job_id": self.job_id.binary()})
         except Exception:
             pass

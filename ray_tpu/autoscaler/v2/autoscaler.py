@@ -1,0 +1,126 @@
+"""Autoscaler v2 reconciler (reference: python/ray/autoscaler/v2/
+autoscaler.py + scheduler.py).
+
+Each tick is a pure pipeline:
+
+    demands  = pending task shapes (GCS load metrics)
+             + declarative cluster constraints (sdk.request_cluster_resources)
+    desired  = bin-pack demands onto node types (shared with v1)
+    diff     = desired vs live instances  -> queue_launch / queue_terminate
+    reconcile the instance state machine against provider + Ray state
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+from ray_tpu.autoscaler.v2.instance_manager import InstanceManager
+from ray_tpu.autoscaler.v2.sdk import get_cluster_resource_constraints
+
+logger = logging.getLogger(__name__)
+
+
+class AutoscalerV2:
+    def __init__(
+        self,
+        provider,
+        node_types: Dict[str, dict],
+        *,
+        max_workers: int = 8,
+        idle_timeout_s: float = 60.0,
+        gcs_client=None,
+    ):
+        self.im = InstanceManager(provider, node_types)
+        self.node_types = node_types
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.gcs_client = gcs_client
+        self._idle_since: Dict[str, float] = {}
+
+    def update(self, load_metrics: Optional[dict] = None):
+        if load_metrics is None:
+            load_metrics = self.gcs_client.call("get_load_metrics")
+        demands = list(load_metrics.get("pending_demands", []))
+        if self.gcs_client is not None:
+            try:
+                demands += get_cluster_resource_constraints(self.gcs_client)
+            except Exception:  # noqa: BLE001 — constraints are advisory
+                pass
+        nodes_view: Dict[str, dict] = load_metrics.get("nodes", {})
+
+        # Ray nodes by cloud instance id (provider maps the address).
+        ray_by_cloud: Dict[str, dict] = {}
+        for cloud_id in self.im.provider.non_terminated_nodes({}):
+            addr = self.im.provider.raylet_address(cloud_id)
+            for rec in nodes_view.values():
+                if rec.get("raylet_address") == addr:
+                    ray_by_cloud[cloud_id] = rec
+
+        live = self.im.live()
+        pending_by_type: Dict[str, int] = {}
+        for inst in live:
+            if inst.status != "RAY_RUNNING":
+                pending_by_type[inst.node_type] = pending_by_type.get(inst.node_type, 0) + 1
+
+        existing_free = [dict(n["available"]) for n in nodes_view.values()]
+        to_launch = get_nodes_to_launch(
+            demands,
+            existing_free,
+            self.node_types,
+            pending_by_type,
+            self.max_workers,
+            len(live),
+        )
+        budget = self.max_workers - len(live)
+        for node_type, count in to_launch.items():
+            count = min(count, max(0, budget))
+            if count > 0:
+                budget -= count
+                logger.info("autoscaler_v2: queueing %d x %s", count, node_type)
+                self.im.queue_launch(node_type, count)
+
+        # Idle scale-down (never below the declarative constraints —
+        # those demands keep the packer wanting the node, and we only
+        # retire nodes that are fully free AND unneeded).
+        now = time.monotonic()
+        for inst in self.im.live():
+            if inst.status != "RAY_RUNNING":
+                continue
+            rec = ray_by_cloud.get(inst.cloud_instance_id)
+            if rec is None:
+                continue
+            fully_free = all(
+                abs(rec["available"].get(k, 0.0) - v) < 1e-9
+                for k, v in rec["total"].items()
+            )
+            if fully_free and not demands:
+                first = self._idle_since.setdefault(inst.instance_id, now)
+                if now - first > self.idle_timeout_s:
+                    logger.info("autoscaler_v2: retiring idle %s", inst.instance_id)
+                    self.im.queue_terminate(inst.instance_id)
+                    self._idle_since.pop(inst.instance_id, None)
+            else:
+                self._idle_since.pop(inst.instance_id, None)
+
+        self.im.reconcile(ray_by_cloud)
+
+    # -- introspection (reference: v2 get_cluster_status) ---------------
+    def status(self) -> dict:
+        by_state: Dict[str, int] = {}
+        for inst in self.im.instances.values():
+            by_state[inst.status] = by_state.get(inst.status, 0) + 1
+        return {
+            "instances": {
+                i.instance_id: {
+                    "type": i.node_type,
+                    "status": i.status,
+                    "cloud_id": i.cloud_instance_id,
+                    "transitions": len(i.history),
+                }
+                for i in self.im.instances.values()
+            },
+            "counts": by_state,
+        }

@@ -209,3 +209,28 @@ class TestActors:
         c = Counter.remote()
         assert ray_tpu.get(bump.remote(c)) == 1
         assert ray_tpu.get(c.incr.remote()) == 2
+
+
+def test_worker_logs_stream_to_driver(ray_start_regular, capfd):
+    """Worker prints reach the driver's stderr with worker prefixes
+    (reference: log_monitor.py -> pubsub -> driver printing)."""
+    import time
+
+    ray_tpu = ray_start_regular
+
+    @ray_tpu.remote
+    def shout():
+        print("HELLO-LOG-STREAM-42")
+        return 1
+
+    assert ray_tpu.get(shout.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline:
+        out, err = capfd.readouterr()
+        seen += err + out
+        if "HELLO-LOG-STREAM-42" in seen:
+            break
+        time.sleep(0.3)
+    assert "HELLO-LOG-STREAM-42" in seen
+    assert "pid=" in seen

@@ -1,0 +1,153 @@
+"""Ray Client: remote drivers over ray:// (reference:
+util/client/ARCHITECTURE.md — server is a normal driver; client holds
+stubs and the server does all bookkeeping)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+PORT = 25043
+
+
+@pytest.fixture(scope="module")
+def client_server():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    gcs = ctx.address_info["gcs_address"]
+    srv = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu.util.client.server_main",
+            "--gcs-address", gcs, "--listen", f"tcp:127.0.0.1:{PORT}",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    # Wait for it to listen.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            from ray_tpu._private import rpc
+
+            rpc.RpcClient(f"tcp:127.0.0.1:{PORT}").close()
+            break
+        except Exception:
+            time.sleep(0.3)
+    yield f"ray://127.0.0.1:{PORT}"
+    srv.terminate()
+    srv.wait(timeout=10)
+    ray_tpu.shutdown()
+
+
+def _run_client(code: str) -> str:
+    """Run a driver script in a FRESH interpreter (a true remote client:
+    no shared state with the cluster process)."""
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_client_tasks_actors_objects(client_server):
+    out = _run_client(
+        f'''
+import ray_tpu
+ray_tpu.init(address="{client_server}")
+
+@ray_tpu.remote
+def f(x):
+    return x * 2
+
+assert ray_tpu.get(f.remote(21)) == 42
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def incr(self, k):
+        self.n += k
+        return self.n
+
+c = Counter.remote()
+assert ray_tpu.get(c.incr.remote(5)) == 5
+assert ray_tpu.get(c.incr.remote(7)) == 12
+
+ref = ray_tpu.put({{"k": [1, 2, 3]}})
+assert ray_tpu.get(ref) == {{"k": [1, 2, 3]}}
+
+r1, r2 = f.remote(1), f.remote(2)
+ready, rest = ray_tpu.wait([r1, r2], num_returns=2, timeout=30)
+assert len(ready) == 2 and not rest
+
+# refs as args cross the wire by id
+big = ray_tpu.put(list(range(100)))
+@ray_tpu.remote
+def total(xs):
+    return sum(xs)
+assert ray_tpu.get(total.remote(big)) == 4950
+
+ray_tpu.shutdown()
+print("CLIENT-OK")
+'''
+    )
+    assert "CLIENT-OK" in out
+
+
+def test_client_errors_propagate(client_server):
+    out = _run_client(
+        f'''
+import ray_tpu
+ray_tpu.init(address="{client_server}")
+
+@ray_tpu.remote(max_retries=0)
+def boom():
+    raise ValueError("kapow")
+
+try:
+    ray_tpu.get(boom.remote(), timeout=60)
+    raise SystemExit("no raise")
+except ValueError:
+    print("ERROR-OK")
+ray_tpu.shutdown()
+'''
+    )
+    assert "ERROR-OK" in out
+
+
+def test_client_disconnect_releases_actors(client_server):
+    """Non-detached actors created by a client die with its connection
+    (reference: server release_all on disconnect)."""
+    _run_client(
+        f'''
+import ray_tpu
+ray_tpu.init(address="{client_server}")
+
+@ray_tpu.remote
+class Ghost:
+    def ping(self):
+        return 1
+
+g = Ghost.remote()
+assert ray_tpu.get(g.ping.remote()) == 1
+# exit WITHOUT killing: the server must clean up on disconnect
+'''
+    )
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        alive = [
+            a
+            for a in ray_tpu.util.state.list_actors()
+            if a["state"] == "ALIVE" and "Ghost" in a["class_name"]
+        ]
+        if not alive:
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"client's actors survived disconnect: {alive}")
