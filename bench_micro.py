@@ -55,15 +55,18 @@ class Client:
         ray_tpu.get(refs)
         return time.perf_counter() - start
 
+    def setup_sink(self) -> None:
+        self.sink = Sink.remote()
+        ray_tpu.get(self.sink.ping.remote())
+
     def run_actor_async(self, n: int) -> float:
-        sink = Sink.remote()
-        ray_tpu.get(sink.ping.remote())
         start = time.perf_counter()
-        refs = [sink.ping.remote() for _ in range(n)]
+        refs = [self.sink.ping.remote() for _ in range(n)]
         ray_tpu.get(refs)
-        elapsed = time.perf_counter() - start
-        ray_tpu.kill(sink)
-        return elapsed
+        return time.perf_counter() - start
+
+    def teardown_sink(self) -> None:
+        ray_tpu.kill(self.sink)
 
 
 def timeit(fn, warmup=1, repeat=3):
@@ -138,11 +141,16 @@ def bench_actor_async(n=2000) -> float:
 
 
 def bench_actor_nn(n_pairs=4, n=1000) -> float:
+    """n client actors each driving their own sink actor.  Actors are
+    created OUTSIDE the timed region, like the reference's ray_perf
+    (actors_async multi: the pairs exist before the measured calls)."""
     clients = [Client.remote() for _ in range(n_pairs)]
+    ray_tpu.get([c.setup_sink.remote() for c in clients])
     ray_tpu.get([c.run_actor_async.remote(10) for c in clients])  # warm
     start = time.perf_counter()
     ray_tpu.get([c.run_actor_async.remote(n) for c in clients])
     elapsed = time.perf_counter() - start
+    ray_tpu.get([c.teardown_sink.remote() for c in clients])
     for c in clients:
         ray_tpu.kill(c)
     return n_pairs * n / elapsed
