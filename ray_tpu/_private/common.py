@@ -76,14 +76,18 @@ class ResourceSet(dict):
 
 @dataclass
 class SchedulingStrategy:
-    """DEFAULT (hybrid), SPREAD, node-affinity, or placement group."""
+    """DEFAULT (hybrid), SPREAD, node-affinity, node-label, or placement
+    group (reference: label scheduling in scheduling_policy.h +
+    NodeLabelSchedulingStrategy)."""
 
-    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | NODE_LABEL | PLACEMENT_GROUP
     node_id: Optional[NodeID] = None
     soft: bool = False
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     capture_child_tasks: bool = False
+    # NODE_LABEL: every (key, value) must match the node's labels.
+    labels: Optional[Dict[str, str]] = None
 
 
 @dataclass

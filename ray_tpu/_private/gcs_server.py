@@ -616,9 +616,16 @@ class GcsServer:
                 return strategy.node_id
             if strategy is not None and not strategy.soft:
                 return None
+        required_labels = (
+            strategy.labels if strategy is not None and strategy.kind == "NODE_LABEL" else None
+        )
         candidates = []
         for node_id, info in self.nodes.items():
             if info.state != "ALIVE":
+                continue
+            if required_labels and any(
+                info.labels.get(k) != v for k, v in required_labels.items()
+            ):
                 continue
             avail = self.available.get(node_id, ResourceSet())
             if resources.fits_in(avail):

@@ -199,6 +199,7 @@ def start_worker_node(
     num_tpus=None,
     resources=None,
     memory=None,
+    labels=None,
     wait: bool = True,
     owner_pid: Optional[int] = None,
 ):
@@ -220,6 +221,7 @@ def start_worker_node(
             "--resources", json.dumps(res),
             "--config", CONFIG.dump(),
             "--owner-pid", str(os.getpid() if owner_pid is None else owner_pid),
+            "--labels", json.dumps(labels or {}),
         ],
         stdout=log,
         stderr=subprocess.STDOUT,

@@ -35,6 +35,10 @@ from ray_tpu._private.object_store import ObjectStoreCore
 logger = logging.getLogger(__name__)
 
 
+def _labels_match(required, node_labels) -> bool:
+    return all(node_labels.get(k) == v for k, v in (required or {}).items())
+
+
 class WorkerHandle:
     __slots__ = (
         "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
@@ -487,6 +491,7 @@ class Raylet:
                         "raylet_address": node["raylet_address"],
                         "available": node.get("available", {}),
                         "total": node.get("resources_total", {}),
+                        "labels": node.get("labels", {}),
                     }
                 elif state == "DEAD":
                     self.cluster_view.pop(nb, None)
@@ -855,6 +860,40 @@ class Raylet:
             if target is not None:
                 self.num_tasks_spilled += 1
                 self.loop.create_task(self._forward_task(spec, target))
+                return
+        elif allow_spill and strategy.kind == "NODE_AFFINITY":
+            if strategy.node_id != self.node_id:
+                view = self.cluster_view.get(strategy.node_id.binary())
+                if view is not None:
+                    self.loop.create_task(self._forward_task(spec, view["raylet_address"]))
+                    return
+                if not strategy.soft:
+                    from ray_tpu import exceptions
+
+                    self._fail_spec_with_error(
+                        spec,
+                        exceptions.RaySystemError(
+                            f"NODE_AFFINITY target {strategy.node_id.hex()[:8]} is not alive"
+                        ),
+                    )
+                    return
+                # soft: fall through and run wherever (here)
+        elif allow_spill and strategy.kind == "NODE_LABEL":
+            if not _labels_match(strategy.labels, self.labels):
+                for view in self.cluster_view.values():
+                    if _labels_match(strategy.labels, view.get("labels", {})):
+                        self.loop.create_task(
+                            self._forward_task(spec, view["raylet_address"])
+                        )
+                        return
+                from ray_tpu import exceptions
+
+                self._fail_spec_with_error(
+                    spec,
+                    exceptions.RaySystemError(
+                        f"no alive node matches labels {strategy.labels}"
+                    ),
+                )
                 return
         self.queue.append(spec)
         self._schedule_dispatch()

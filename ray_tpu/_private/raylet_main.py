@@ -25,6 +25,7 @@ def main():
     parser.add_argument("--resources", required=True)
     parser.add_argument("--config", default="")
     parser.add_argument("--owner-pid", type=int, default=0)
+    parser.add_argument("--labels", default="{}")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s")
@@ -40,6 +41,7 @@ def main():
         gcs_address=args.gcs_address,
         store_dir=args.store_dir,
         resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
         session_dir=args.session_dir,
         loop=loop,
     )

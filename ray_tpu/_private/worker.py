@@ -1929,6 +1929,10 @@ def _resolve_strategy(options: dict) -> SchedulingStrategy:
         return SchedulingStrategy(
             kind="NODE_AFFINITY", node_id=NodeID(bytes.fromhex(strategy.node_id)), soft=strategy.soft
         )
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return SchedulingStrategy(kind="NODE_LABEL", labels=dict(strategy.hard))
     raise ValueError(f"unknown scheduling strategy {strategy!r}")
 
 

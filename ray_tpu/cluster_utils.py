@@ -32,7 +32,8 @@ class Cluster:
     def address(self) -> str:
         return self.gcs_address
 
-    def add_node(self, num_cpus=None, num_tpus=None, resources=None, memory=None, wait: bool = True):
+    def add_node(self, num_cpus=None, num_tpus=None, resources=None, memory=None,
+                 labels=None, wait: bool = True):
         assert self.gcs_address, "no head node"
         proc, raylet_address = node_mod.start_worker_node(
             self.gcs_address,
@@ -41,6 +42,7 @@ class Cluster:
             num_tpus=num_tpus,
             resources=resources,
             memory=memory,
+            labels=labels,
             wait=wait,
         )
         handle = _NodeHandle(proc, raylet_address)

@@ -29,6 +29,7 @@ from ray_tpu.data.datasource import (
     ParquetDatasource,
     RangeDatasource,
     ReadTask,
+    TextDatasource,
     TFRecordsDatasource,
 )
 from ray_tpu.data.iterator import DataIterator
@@ -59,6 +60,7 @@ __all__ = [
     "read_images",
     "read_binary_files",
     "read_tfrecords",
+    "read_text",
 ]
 
 _builtin_range = range
@@ -131,6 +133,14 @@ def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
 
 def read_images(paths, *, parallelism: int = -1, size=None, mode=None) -> Dataset:
     return read_datasource(ImageDatasource(paths, size=size, mode=mode), parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, encoding: str = "utf-8",
+              drop_empty_lines: bool = True) -> Dataset:
+    return read_datasource(
+        TextDatasource(paths, encoding=encoding, drop_empty_lines=drop_empty_lines),
+        parallelism=parallelism,
+    )
 
 
 def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:

@@ -241,6 +241,19 @@ class NumpyDatasource(FileBasedDatasource):
         yield build_block({"data": arr})
 
 
+class TextDatasource(FileBasedDatasource):
+    """One row per line (reference: read_text)."""
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        encoding = self._read_args.get("encoding", "utf-8")
+        drop_empty = self._read_args.get("drop_empty_lines", True)
+        with open(path, encoding=encoding, errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if drop_empty:
+            lines = [ln for ln in lines if ln]
+        yield pa.table({"text": lines})
+
+
 class BinaryDatasource(FileBasedDatasource):
     def _read_file(self, path: str) -> Iterator[Block]:
         with open(path, "rb") as f:

@@ -1,10 +1,12 @@
 """ray_tpu.models — JAX/Flax model families for Train/RLlib/Serve.
 
 Flagship: GPT-2 (ray_tpu.models.gpt2) — the north-star pretraining target.
-Also: MLP (MNIST), ResNet (CIFAR), and RLlib policy/value nets.
+Also: Llama family (RoPE/GQA/SwiGLU), expert-parallel MoE, pipeline-
+parallel GPT-2 (gpt2_pp), MLP (MNIST), ResNet (CIFAR), and RLlib
+policy/value nets.
 """
 
-__all__ = ["gpt2", "mlp", "resnet"]
+__all__ = ["gpt2", "gpt2_pp", "llama", "mlp", "moe", "resnet"]
 
 
 def __getattr__(name):

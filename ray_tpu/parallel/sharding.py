@@ -53,7 +53,7 @@ def gpt_sharding_rules() -> ShardingRules:
         rules=[
             (r"(wte|token_embed|embedding)/(embedding|kernel)", P("tp", None)),
             (r"(wpe|pos_embed)/(embedding|kernel)", P(None, None)),
-            (r"(qkv|query|key|value|c_attn)/kernel", P("fsdp", "tp")),
+            (r"(qkv|query|key|value|c_attn|[qkv]_proj)/kernel", P("fsdp", "tp")),
             (r"(attn_out|c_proj|out_proj|o_proj)/kernel", P("tp", "fsdp")),
             (r"(mlp_up|up_proj|gate_proj|c_fc|fc_in)/kernel", P("fsdp", "tp")),
             (r"(mlp_down|down_proj|fc_out)/kernel", P("tp", "fsdp")),

@@ -20,5 +20,17 @@ class NodeAffinitySchedulingStrategy:
     soft: bool = False
 
 
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Run only on nodes whose labels contain every (key, value) in
+    `hard` (reference: NodeLabelSchedulingStrategy + label scheduling
+    policy).  Node labels come from `raylet --labels` / Cluster
+    add_node(labels=...); TPU nodes get accelerator labels automatically
+    (accelerators/tpu.py)."""
+
+    hard: dict
+    soft: Optional[dict] = None  # accepted for parity; hard rules decide
+
+
 SPREAD = "SPREAD"
 DEFAULT = "DEFAULT"

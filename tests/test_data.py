@@ -362,3 +362,13 @@ def test_block_order_preserved_under_skew(ray_cluster):
     ds = rd.range(4 * n_blocks, parallelism=n_blocks).map_batches(slow_early)
     out = [r["id"] for r in ds.take_all()]
     assert out == [i * 2 for i in range(4 * n_blocks)], out
+
+
+def test_read_text(ray_cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    (tmp_path / "a.txt").write_text("hello\nworld\n\nthree\n")
+    (tmp_path / "b.txt").write_text("four\n")
+    ds = rd.read_text([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
+    rows = sorted(r["text"] for r in ds.take_all())
+    assert rows == ["four", "hello", "three", "world"]

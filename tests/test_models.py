@@ -83,3 +83,123 @@ def test_param_sharding_rules_hit_tp_axes():
     assert qkv and all("tp" in str(s) for s in qkv), flat
     down = [s for p, s in flat.items() if "mlp_down/kernel" in p]
     assert down and all(str(s).startswith("PartitionSpec('tp'") for s in down)
+
+
+# ---------------------------------------------------------------------------
+# Llama family
+
+
+def test_llama_forward_and_loss():
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 33), dtype=np.int32))
+    logits = llama.Llama(cfg).apply({"params": params}, toks[:, :-1])
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    loss = float(llama.loss_fn(params, toks[:, :-1], toks[:, 1:], cfg))
+    assert np.isfinite(loss)
+    # Untrained loss should be near ln(vocab) for a random model.
+    assert abs(loss - np.log(cfg.vocab_size)) < 1.5
+
+
+def test_llama_gqa_kv_heads_smaller():
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg)
+    blk = params["h_0"]["attn"]
+    d_head = cfg.d_model // cfg.n_head
+    assert blk["q_proj"]["kernel"].shape[1] == cfg.n_head * d_head
+    assert blk["k_proj"]["kernel"].shape[1] == cfg.n_kv_head * d_head
+    assert cfg.n_kv_head < cfg.n_head
+
+
+def test_llama_sharded_train_step():
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import create_mesh
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = create_mesh({"dp": 2, "tp": 2}, devs[:4])
+    cfg = llama.LlamaConfig.tiny(mesh=mesh)
+    opt = __import__("optax").sgd(1e-2)
+    params, opt_state, specs = llama.make_sharded_train_state(cfg, mesh, opt)
+    step = llama.make_sharded_train_step(cfg, mesh, opt)
+    toks = np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 65), dtype=np.int32)
+    t, y = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, t, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # learns on the repeated batch
+    # tp layout hit the projections
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]}
+    assert any("q_proj/kernel" in p and "tp" in str(s) for p, s in flat.items())
+
+
+def test_llama_rope_rotation_properties():
+    from ray_tpu.models.llama import rope
+
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((1, 8, 2, 16)), dtype=jnp.float32)
+    r = rope(x, 10000.0)
+    # Norm-preserving per position...
+    assert np.allclose(np.linalg.norm(np.asarray(r), axis=-1),
+                       np.linalg.norm(np.asarray(x), axis=-1), atol=1e-4)
+    # ...and position 0 is the identity rotation.
+    assert np.allclose(np.asarray(r[:, 0]), np.asarray(x[:, 0]), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MoE / expert parallelism
+
+
+def test_moe_routes_and_learns():
+    from ray_tpu.models.moe import MoEConfig, MoEMLP
+
+    cfg = MoEConfig(d_model=32, d_ff=64, num_experts=4, top_k=2, dtype=jnp.float32)
+    mod = MoEMLP(cfg)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 16, 32)), dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    out, aux = mod.apply({"params": params}, x)
+    assert out.shape == x.shape
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+    def loss(p):
+        y, aux = mod.apply({"params": p}, x)
+        return ((y - x) ** 2).mean() + aux
+
+    grads = jax.grad(loss)(params)
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree_util.tree_leaves(grads)]
+    assert all(np.isfinite(n) for n in norms) and sum(norms) > 0
+
+
+def test_moe_expert_parallel_matches_single_device():
+    """ep-sharded execution must compute exactly what one device does."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models.moe import MoEConfig, MoEMLP, moe_sharding_rules
+    from ray_tpu.parallel import create_mesh
+    from ray_tpu.parallel.sharding import infer_param_spec, tree_shardings
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = create_mesh({"ep": 4}, devs[:4])
+    cfg = MoEConfig(d_model=32, d_ff=64, num_experts=8, top_k=2, dtype=jnp.float32)
+    mod = MoEMLP(cfg)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 16, 32)), dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(1), x)["params"]
+    ref_out, ref_aux = mod.apply({"params": params}, x)
+
+    specs = infer_param_spec(params, moe_sharding_rules(), mesh)
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]}
+    assert str(flat["experts_gate"]).startswith("PartitionSpec('ep'"), flat
+    sharded_params = jax.device_put(params, tree_shardings(mesh, specs))
+    x_sharded = jax.device_put(x, NamedSharding(mesh, P()))
+    out, aux = jax.jit(lambda p, v: mod.apply({"params": p}, v))(sharded_params, x_sharded)
+    assert np.allclose(np.asarray(out), np.asarray(ref_out), atol=1e-4)
+    assert abs(float(aux) - float(ref_aux)) < 1e-5

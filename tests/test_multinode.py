@@ -219,3 +219,38 @@ def test_chunked_cross_node_transfer_1gib(cluster):
     assert (int(out[0]), int(out[-1]), int(out[n // 2])) == (7, 9, 5)
     assert int(out.sum()) == 21
     del out, ref
+
+
+def test_node_label_scheduling(cluster):
+    """NODE_LABEL tasks run only on matching nodes; no match fails with a
+    clear error (reference: NodeLabelSchedulingStrategy)."""
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    cluster.add_node(num_cpus=1, labels={"tier": "gold", "zone": "a"})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(
+        scheduling_strategy=NodeLabelSchedulingStrategy(hard={"tier": "gold"})
+    )
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ran_on = {ray_tpu.get(where.remote(), timeout=60) for _ in range(3)}
+    gcs = ray_tpu._private.worker.get_global_worker().gcs_client
+    info = gcs.call("get_cluster_info")
+    gold = {
+        ray_tpu.NodeID(n["node_id"]).hex()
+        for n in info["nodes"].values()
+        if n.get("labels", {}).get("tier") == "gold"
+    }
+    assert gold and ran_on <= gold, (ran_on, gold)
+
+    @ray_tpu.remote(
+        scheduling_strategy=NodeLabelSchedulingStrategy(hard={"tier": "platinum"}),
+        max_retries=0,
+    )
+    def nowhere():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RaySystemError):
+        ray_tpu.get(nowhere.remote(), timeout=60)
