@@ -158,6 +158,8 @@ class Raylet:
         # Metrics
         self.num_tasks_dispatched = 0
         self.num_tasks_spilled = 0
+        self.event_loop_lag_ms = 0.0
+        self.event_loop_lag_max_ms = 0.0
         self._infeasible_tick = 0
         self._bg: List[asyncio.Task] = []
         self._stopping = False
@@ -176,6 +178,7 @@ class Raylet:
             self._bg.append(self.loop.create_task(self._spill_pressure_loop()))
         if CONFIG.log_to_driver:
             self._bg.append(self.loop.create_task(self._log_monitor_loop()))
+        self._bg.append(self.loop.create_task(self._event_loop_lag_loop()))
         logger.info("raylet %s listening on %s", self.node_id.hex()[:8], self.address)
 
     async def _log_monitor_loop(self):
@@ -1661,6 +1664,18 @@ class Raylet:
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
+    async def _event_loop_lag_loop(self):
+        """Sample the event loop's scheduling lag (reference: per-event-
+        loop stats in src/ray/stats — how late a sleep(period) wakes up
+        is a direct measure of loop congestion)."""
+        period = 0.5
+        while not self._stopping:
+            t0 = self.loop.time()
+            await asyncio.sleep(period)
+            lag_ms = max(0.0, (self.loop.time() - t0 - period) * 1000)
+            self.event_loop_lag_ms = 0.8 * self.event_loop_lag_ms + 0.2 * lag_ms
+            self.event_loop_lag_max_ms = max(self.event_loop_lag_max_ms, lag_ms)
+
     async def rpc_node_stats(self, payload, conn):
         return {
             "node_id": self.node_id.binary(),
@@ -1672,6 +1687,8 @@ class Raylet:
             "store": self.store.stats(),
             "num_tasks_dispatched": self.num_tasks_dispatched,
             "num_tasks_spilled": self.num_tasks_spilled,
+            "event_loop_lag_ms": round(self.event_loop_lag_ms, 3),
+            "event_loop_lag_max_ms": round(self.event_loop_lag_max_ms, 3),
             "running_tasks": [
                 {"task_id": tb, "name": s.name, "worker_pid": w.pid}
                 for w in self.workers.values()
