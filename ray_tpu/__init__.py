@@ -81,10 +81,37 @@ def init(
         # Remote-driver (Ray Client) mode: swap in a ClientWorker that
         # proxies the Worker interface to the cluster's client server —
         # the rest of the API layer works unchanged on top of it
-        # (reference: util/client/ARCHITECTURE.md).
-        from ray_tpu.util.client import connect as _client_connect
+        # (reference: util/client/ARCHITECTURE.md).  namespace and
+        # runtime_env are honored (packaged client-side); cluster-shaping
+        # args are meaningless from a remote driver and rejected rather
+        # than silently dropped.
+        unsupported = {
+            "num_cpus": num_cpus,
+            "num_tpus": num_tpus,
+            "resources": resources,
+            "object_store_memory": object_store_memory,
+            "_system_config": _system_config,
+        }
+        bad = sorted(k for k, v in unsupported.items() if v is not None)
+        bad += sorted(kwargs)  # unknown args, even explicit None
+        if bad:
+            raise ValueError(
+                f"init(address='ray://...') does not support {bad}: a remote "
+                "driver cannot reconfigure the cluster it connects to"
+            )
+        # log_to_driver: there is no log streaming over ray://, so False
+        # (the only honorable value) is accepted as a no-op.
+        with _init_lock:
+            existing = global_worker_maybe()
+            if existing is not None and existing.connected:
+                if ignore_reinit_error:
+                    return ClientContext(existing, address)
+                raise RuntimeError(
+                    "ray_tpu.init() called twice; pass ignore_reinit_error=True to ignore."
+                )
+            from ray_tpu.util.client import connect as _client_connect
 
-        client = _client_connect(address)
+            client = _client_connect(address, namespace=namespace, runtime_env=runtime_env)
         return ClientContext(client, address)
 
     with _init_lock:

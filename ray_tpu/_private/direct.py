@@ -247,6 +247,7 @@ class DirectTaskSubmitter:
         ms.resolve_stored(payload.get("stored", ()))
         self._worker._notify_stream_finished(payload["task_id"])
         self._worker.reference_counter.return_borrows(payload["task_id"])
+        self._worker._cancelled_tasks.discard(payload["task_id"])
         with self._lock:
             lease = ks.leases.get(wid)
             if lease is None:
@@ -299,6 +300,7 @@ class DirectTaskSubmitter:
         from ray_tpu import exceptions
 
         for spec in cancelled:
+            self._worker._cancelled_tasks.discard(spec.task_id.binary())
             try:
                 self._worker._store_error_returns(
                     spec, exceptions.TaskCancelledError(f"Task {spec.name} was cancelled")
@@ -351,6 +353,9 @@ class DirectTaskSubmitter:
                 if target is not None:
                     break
         if doomed is not None:
+            # Resolved right here — the task never runs, so no completion
+            # or lease-loss handler will ever prune the owner's entry.
+            self._worker._cancelled_tasks.discard(tid)
             try:
                 self._worker._store_error_returns(
                     doomed,
@@ -498,6 +503,7 @@ class ActorDirectChannel:
         ms.resolve_stored(payload.get("stored", ()))
         self.worker._notify_stream_finished(payload["task_id"])
         self.worker.reference_counter.return_borrows(payload["task_id"])
+        self.worker._cancelled_tasks.discard(payload["task_id"])
         self.inflight.pop(payload["task_id"], None)
 
     def _on_close(self) -> None:

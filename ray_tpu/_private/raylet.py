@@ -1036,20 +1036,31 @@ class Raylet:
                 # Make sure a worker with the right (job, env) is coming —
                 # a worker starting for a *different* env can never serve
                 # this task, so it must not suppress the spawn.
-                if not self._worker_starting_for(spec.job_id, eh):
+                # exclude_reserved: a STARTING worker claimed by a lease
+                # request will be LEASED on registration and never serve
+                # this queue — it must not suppress the spawn.
+                if not self._worker_starting_for(spec.job_id, eh, exclude_reserved=True):
                     self._spawn_worker(spec.job_id, runtime_env=spec.runtime_env)
                 continue
             self._push_task_to_worker(w, spec)
         self.queue = remaining
 
-    def _worker_starting_for(self, job_id: JobID, env_hash: str) -> bool:
-        return any(
-            w.state == "STARTING"
-            and w.actor_id is None  # dedicated actor workers don't count
-            and w.job_id == job_id
-            and w.env_hash == env_hash
-            for w in self.workers.values()
-        )
+    def _worker_starting_for(
+        self, job_id: JobID, env_hash: str, exclude_reserved: bool = False
+    ) -> Optional["WorkerHandle"]:
+        """The single STARTING-worker-matching predicate shared by the
+        dispatch loop (spawn suppression) and the lease path (reuse).
+        Returns a matching worker (truthy) or None."""
+        for w in self.workers.values():
+            if (
+                w.state == "STARTING"
+                and w.actor_id is None  # dedicated actor workers don't count
+                and w.job_id == job_id
+                and w.env_hash == env_hash
+                and not (exclude_reserved and w.reserved)
+            ):
+                return w
+        return None
 
     def _locally_feasible(self, spec: TaskSpec) -> bool:
         bk = self._bundle_key(spec)
@@ -1080,6 +1091,9 @@ class Raylet:
         if w is None:
             return False
         spec = w.running.pop(payload["task_id"], None)
+        # A non-force cancel that lost the race with completion leaves its
+        # entry behind; prune here so the set doesn't grow forever.
+        self.cancelled_tasks.discard(payload["task_id"])
         if spec is not None and w.actor_id is None:
             self._release_task_resources(spec)
             w.resources_held.subtract(self._task_resources(spec))
@@ -1147,6 +1161,14 @@ class Raylet:
         try:
             # Find or spawn a worker with a direct endpoint.
             w = self._pop_idle_worker_for_lease(job_id, lease_env_hash)
+            if w is None:
+                # Reuse a worker already STARTING for this (job, env) —
+                # during slow runtime_env staging (pip install) each ~30s
+                # lease retry would otherwise spawn another duplicate that
+                # just queues behind the same staging flock.
+                w = self._worker_starting_for(
+                    job_id, lease_env_hash, exclude_reserved=True
+                )
             if w is None:
                 w = self._spawn_worker(job_id, runtime_env=lease_env)
             w.reserved = True  # keep dispatch + concurrent grants off it

@@ -96,7 +96,11 @@ def prepare(env: Optional[dict]) -> Tuple[Optional[dict], List[Tuple[str, bytes]
     if mods:
         out = []
         for m in mods:
-            uri, blob = _to_uri(m)
+            # A py_modules entry is the package directory itself: zip it
+            # WITH its top-level name so the staged dir is a sys.path
+            # root from which ``import <basename>`` works (reference:
+            # packaging.py include_parent_dir=True for py_modules).
+            uri, blob = _to_uri(m, include_parent=True)
             out.append(uri)
             if blob is not None:
                 uploads.append((uri, blob))
@@ -110,7 +114,7 @@ def prepare(env: Optional[dict]) -> Tuple[Optional[dict], List[Tuple[str, bytes]
     return (norm or None, uploads)
 
 
-def _to_uri(path_or_uri: str) -> Tuple[str, Optional[bytes]]:
+def _to_uri(path_or_uri: str, include_parent: bool = False) -> Tuple[str, Optional[bytes]]:
     if path_or_uri.startswith(URI_PREFIX):
         return path_or_uri, None
     if not os.path.isdir(path_or_uri):
@@ -118,7 +122,7 @@ def _to_uri(path_or_uri: str) -> Tuple[str, Optional[bytes]]:
             f"runtime_env working_dir/py_modules entry {path_or_uri!r} is not "
             f"a local directory or {URI_PREFIX} URI"
         )
-    blob = _zip_dir(path_or_uri)
+    blob = _zip_dir(path_or_uri, include_parent=include_parent)
     limit = 200 * 1024 * 1024
     if len(blob) > limit:
         raise RuntimeEnvError(
@@ -129,10 +133,12 @@ def _to_uri(path_or_uri: str) -> Tuple[str, Optional[bytes]]:
     return f"{URI_PREFIX}{sha}.zip", blob
 
 
-def _zip_dir(path: str) -> bytes:
+def _zip_dir(path: str, include_parent: bool = False) -> bytes:
     """Deterministic zip (sorted names, zeroed timestamps) so equal trees
-    hash equal across hosts and runs."""
+    hash equal across hosts and runs.  With ``include_parent`` entries are
+    prefixed with the directory's own name (py_modules semantics)."""
     buf = io.BytesIO()
+    prefix = os.path.basename(os.path.normpath(path)) if include_parent else ""
     entries = []
     for root, dirs, files in os.walk(path):
         dirs[:] = sorted(d for d in dirs if d not in DEFAULT_EXCLUDES)
@@ -140,7 +146,8 @@ def _zip_dir(path: str) -> bytes:
             if f.endswith(".pyc"):
                 continue
             full = os.path.join(root, f)
-            entries.append((os.path.relpath(full, path), full))
+            rel = os.path.relpath(full, path)
+            entries.append((os.path.join(prefix, rel) if prefix else rel, full))
     entries.sort()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
         for rel, full in entries:
@@ -157,6 +164,18 @@ def finish_uploads(gcs_client, uploads: List[Tuple[str, bytes]]) -> None:
         key = uri[len(URI_PREFIX):].encode()
         if not gcs_client.call("kv_exists", (KV_NS, key)):
             gcs_client.call("kv_put", (KV_NS, key, blob, False))
+
+
+def normalize_uploaded(raw: Optional[dict], upload_fn) -> dict:
+    """prepare() + upload in one step: the single normalization sequence
+    shared by the in-cluster driver (uploads straight to the GCS KV) and
+    the ray:// client (uploads via the client server), so env semantics
+    can't silently diverge between the two.  Returns {} for an empty env
+    (cacheable sentinel)."""
+    prepared, uploads = prepare(raw)
+    for uri, blob in uploads:
+        upload_fn(uri, blob)
+    return prepared or {}
 
 
 def merge(job_env: Optional[dict], task_env: Optional[dict]) -> Optional[dict]:

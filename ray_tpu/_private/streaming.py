@@ -44,7 +44,7 @@ class StreamEnd:
 class _StreamState:
     """Owner-side arrival log for one streaming task."""
 
-    __slots__ = ("cond", "arrived", "finished")
+    __slots__ = ("cond", "arrived", "finished", "saw_push")
 
     def __init__(self):
         self.cond = threading.Condition()
@@ -52,14 +52,21 @@ class _StreamState:
         self.arrived: Dict[int, bool] = {}
         # task_finished seen (sentinel resolvable).
         self.finished = False
+        # Any stream_item/task_finished PUSH observed?  False means the
+        # raylet-mediated path (items sealed in the store by construction,
+        # no per-item existence check needed when draining after the end).
+        self.saw_push = False
 
     def on_item(self, index: int):
         with self.cond:
+            self.saw_push = True
             self.arrived[index] = True
             self.cond.notify_all()
 
-    def on_finished(self):
+    def on_finished(self, pushed: bool = True):
         with self.cond:
+            if pushed:
+                self.saw_push = True
             self.finished = True
             self.cond.notify_all()
 
@@ -79,6 +86,7 @@ class ObjectRefGenerator:
         self._error: Optional[Exception] = None
         self._state = worker._register_stream(spec)
         self._last_poll = time.monotonic()
+        self._fallback_deadline: Optional[float] = None
 
     # -- iteration ------------------------------------------------------
     def __iter__(self):
@@ -94,6 +102,56 @@ class ObjectRefGenerator:
         ref = ObjectRef(self._spec.stream_item_id(self._consumed), owned=True)
         self._consumed += 1
         return ref
+
+    def _fallback_item_ref(
+        self, block: bool = True, caller_deadline: Optional[float] = None
+    ) -> Optional[ObjectRef]:
+        """Sentinel says this item exists but its push never arrived.
+
+        On the raylet-mediated path (no pushes ever observed) every item
+        is sealed in the store by construction — hand the ref out for
+        free.  On the push path a missing item means its inline push was
+        lost (direct server loop stopped racing process exit): verify
+        before handing out a ref the consumer's get() would hang on, and
+        surface ObjectLostError if it truly never sealed.  With
+        ``block=False`` (try_next) a single probe is made per call;
+        None means "not confirmed yet, ask again"."""
+        if not self._state.saw_push:
+            return self._item_ref()
+        oid = self._spec.stream_item_id(self._consumed)
+        if self._fallback_deadline is None:
+            self._fallback_deadline = time.monotonic() + 2.0
+        while True:
+            with self._state.cond:
+                arrived = self._consumed in self._state.arrived
+                if arrived:
+                    del self._state.arrived[self._consumed]
+            if arrived:
+                # The push landed after all (e.g. shm promotion failed but
+                # the owner's memory store holds it) — resolvable locally.
+                self._fallback_deadline = None
+                return self._item_ref()
+            if self._store_has(oid):
+                self._fallback_deadline = None
+                return self._item_ref()
+            if time.monotonic() > self._fallback_deadline:
+                from ray_tpu import exceptions
+
+                self._worker._drop_stream(self._task_id)
+                raise exceptions.ObjectLostError(
+                    f"stream item {self._consumed} of {self._spec.name} was "
+                    "announced by the end-of-stream sentinel but never sealed "
+                    "(its inline push was lost)"
+                )
+            if caller_deadline is not None and time.monotonic() > caller_deadline:
+                from ray_tpu import exceptions
+
+                raise exceptions.GetTimeoutError(
+                    f"no stream item from {self._spec.name} before timeout"
+                )
+            if not block:
+                return None
+            time.sleep(0.1)
 
     def _resolve_sentinel(self):
         """Read return 0: StreamEnd(count) or raises the task error."""
@@ -118,9 +176,8 @@ class ObjectRefGenerator:
                     self._resolve_sentinel()  # raises the task's error
                 if self._consumed < self._count:
                     # Sentinel read but this item's push never arrived
-                    # (raylet-mediated path, or push raced shutdown): the
-                    # item is sealed in the store — hand out its ref.
-                    return self._item_ref()
+                    # (raylet-mediated path, or push raced shutdown).
+                    return self._fallback_item_ref(caller_deadline=deadline)
                 self._worker._drop_stream(self._task_id)
                 raise StopIteration
             # Raylet-mediated fallback: no pushes arrive at all — probe
@@ -132,7 +189,7 @@ class ObjectRefGenerator:
                 if self._store_has(self._spec.stream_item_id(self._consumed)):
                     return self._item_ref()
                 if self._store_has(self._spec.return_ids()[0]):
-                    state.on_finished()
+                    state.on_finished(pushed=False)
                     continue
             if deadline is not None and time.monotonic() > deadline:
                 from ray_tpu import exceptions
@@ -159,7 +216,7 @@ class ObjectRefGenerator:
             if self._count is None:
                 self._resolve_sentinel()  # raises the task's error
             if self._consumed < self._count:
-                return self._item_ref()
+                return self._fallback_item_ref(block=False)
             self._worker._drop_stream(self._task_id)
             raise StopIteration
         now = time.monotonic()
@@ -168,7 +225,7 @@ class ObjectRefGenerator:
             if self._store_has(self._spec.stream_item_id(self._consumed)):
                 return self._item_ref()
             if self._store_has(self._spec.return_ids()[0]):
-                state.on_finished()
+                state.on_finished(pushed=False)
         return None
 
     def _store_has(self, oid: ObjectID) -> bool:

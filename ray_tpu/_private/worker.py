@@ -593,6 +593,8 @@ class Worker:
         self._actor_send_inc.clear()
         self._runtime_env_norm_cache.clear()
         self._oom_worker_kills.clear()
+        self._cancelled_tasks.clear()
+        self._cancel_requested.clear()
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
         self.actor_cache = ActorStateCache(self)
@@ -960,9 +962,12 @@ class Worker:
         with self._lock:
             norm = self._runtime_env_norm_cache.get(key)
         if norm is None:
-            prepared, uploads = runtime_env_mod.prepare(raw)
-            runtime_env_mod.finish_uploads(self.gcs_client, uploads)
-            norm = prepared if prepared is not None else {}
+            norm = runtime_env_mod.normalize_uploaded(
+                raw,
+                lambda uri, blob: runtime_env_mod.finish_uploads(
+                    self.gcs_client, [(uri, blob)]
+                ),
+            )
             with self._lock:
                 self._runtime_env_norm_cache[key] = norm
         return runtime_env_mod.merge(self.job_runtime_env, norm or None)
@@ -1053,6 +1058,11 @@ class Worker:
                 except rpc.RpcError:
                     pass
                 return
+        # The set is only consulted by the direct-path lease/channel loss
+        # handlers (which also prune it on completion); the remaining
+        # branches resolve elsewhere, so keep the entry out of the set or
+        # it would leak one tid per cancel for the life of the driver.
+        self._cancelled_tasks.discard(tid)
         # Actor task parked waiting for a restarting/not-yet-alive actor.
         parked = self.actor_cache.cancel_pending(tid)
         if parked is not None:
@@ -1384,7 +1394,16 @@ class Worker:
         allowed_retries = cached.get("max_task_retries", 0)
         retriable = []
         for spec in inflight:
-            if allowed_retries == -1 or spec.attempt_number < allowed_retries:
+            tid = spec.task_id.binary()
+            if tid in self._cancelled_tasks:
+                # A force-cancel killed the actor worker mid-task: resolve
+                # as cancelled (not actor-death) and prune the entry.
+                self._cancelled_tasks.discard(tid)
+                self._store_error_returns(
+                    spec,
+                    exceptions.TaskCancelledError(f"Task {spec.name} was cancelled"),
+                )
+            elif allowed_retries == -1 or spec.attempt_number < allowed_retries:
                 spec.attempt_number += 1
                 retriable.append(spec)
             else:

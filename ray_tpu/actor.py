@@ -217,7 +217,10 @@ def _fetch_blob(spec) -> bytes:
     from ray_tpu._private.worker import FUNCTION_KV_NS, get_global_worker
 
     worker = get_global_worker()
-    blob = worker.gcs_client.call("kv_get", (FUNCTION_KV_NS, spec.function_key))
+    if getattr(worker, "mode", None) == "client":
+        blob = worker.fetch_function_blob(spec.function_key)
+    else:
+        blob = worker.gcs_client.call("kv_get", (FUNCTION_KV_NS, spec.function_key))
     if blob is None:
         raise ValueError("actor class definition missing from GCS")
     return blob

@@ -166,3 +166,45 @@ class ClientServer:
     async def rpc_client_cluster_info(self, payload, conn):
         info = self.worker.gcs_client.call("get_cluster_info")
         return {"num_nodes": len(info["nodes"])}
+
+    async def rpc_client_fetch_function(self, payload, conn):
+        import asyncio
+
+        from ray_tpu._private.worker import FUNCTION_KV_NS
+
+        # Class blobs can be MBs: keep the blocking KV get off the loop.
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self.worker.gcs_client.call("kv_get", (FUNCTION_KV_NS, payload))
+        )
+
+    async def rpc_client_package_exists(self, payload, conn):
+        import asyncio
+
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        key = payload[len(runtime_env_mod.URI_PREFIX):].encode()
+        return await asyncio.get_event_loop().run_in_executor(
+            None,
+            lambda: bool(
+                self.worker.gcs_client.call(
+                    "kv_exists", (runtime_env_mod.KV_NS, key)
+                )
+            ),
+        )
+
+    async def rpc_client_upload_package(self, payload, conn):
+        """Client-side-packaged runtime_env zip → the cluster's GCS KV
+        (reference: ray client uploads working_dir from the remote
+        driver's machine, not the server's)."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        uri, blob = payload
+        import asyncio
+
+        # A working_dir zip can be hundreds of MB: keep the blocking KV
+        # put off the server loop so other clients' RPCs keep flowing.
+        await asyncio.get_event_loop().run_in_executor(
+            None,
+            lambda: runtime_env_mod.finish_uploads(self.worker.gcs_client, [(uri, blob)]),
+        )
+        return True

@@ -63,16 +63,47 @@ class _ClientRefCounter:
 class ClientWorker:
     """mode="client" stand-in for the in-cluster Worker."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, namespace: Optional[str] = None,
+                 runtime_env: Optional[dict] = None):
         self.mode = "client"
         self.connected = True
         self._rpc = rpc.RpcClient(address)
         self.reference_counter = _ClientRefCounter(self)
-        self.namespace = "default"
+        self.namespace = namespace or "default"
         self.session_info: dict = {}
-        self.job_runtime_env = None
+        self._env_cache: Dict[str, dict] = {}
         info = self._rpc.call("client_cluster_info", None, timeout=30)
         self._num_nodes = info["num_nodes"]
+        # The job runtime_env is packaged on THIS machine (local
+        # working_dir/py_modules zip from the client's filesystem, like
+        # the reference Ray Client's upload-from-remote-driver) and the
+        # packages are shipped to the cluster's GCS KV via the server.
+        self.job_runtime_env = self._prepare_env(runtime_env)
+
+    def _prepare_env(self, raw: Optional[dict]) -> Optional[dict]:
+        """Normalize a runtime_env CLIENT-side: zip local dirs from the
+        client filesystem, upload packages through the server, return the
+        gcs://-only normalized env safe to evaluate anywhere."""
+        import json as _json
+
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        if not raw:
+            return None
+        key = _json.dumps(raw, sort_keys=True, default=str)
+        cached = self._env_cache.get(key)
+        if cached is not None:
+            return cached or None
+        def _upload(uri, blob):
+            # Content-addressed: skip shipping up to 200 MB over the WAN
+            # when the cluster already holds this sha (reference client
+            # checks package existence before upload).
+            if not self._rpc.call("client_package_exists", uri, timeout=30):
+                self._rpc.call("client_upload_package", (uri, blob), timeout=120)
+
+        norm = runtime_env_mod.normalize_uploaded(raw, _upload)
+        self._env_cache[key] = norm
+        return norm or None
 
     # -- arg packing (values inline, refs by id) ------------------------
     def _pack_args(self, args: Tuple, kwargs: Dict) -> list:
@@ -126,7 +157,7 @@ class ClientWorker:
                 "fn_blob": fn_blob,
                 "name": name,
                 "args": self._pack_args(args, kwargs),
-                "options": _plain_options(options),
+                "options": _client_options(self, options),
             },
         )
         return self._refs(ids)
@@ -138,7 +169,7 @@ class ClientWorker:
                 "cls_blob": cls_blob,
                 "name": class_name,
                 "args": self._pack_args(args, kwargs),
-                "options": _plain_options(options),
+                "options": _client_options(self, options),
             },
         )
         return ActorID(aid)
@@ -150,6 +181,8 @@ class ClientWorker:
                 "actor_id": actor_id.binary(),
                 "method": method_name,
                 "args": self._pack_args(args, kwargs),
+                # env + namespace are fixed at actor creation; plain
+                # options keep the per-call hot path cheap.
                 "options": _plain_options(options),
             },
         )
@@ -161,8 +194,15 @@ class ClientWorker:
     def cancel_task(self, object_id, force: bool = False):
         self._rpc.call("client_cancel", {"id": object_id.binary(), "force": force})
 
+    def fetch_function_blob(self, function_key: bytes) -> Optional[bytes]:
+        """Registered function/class blob from the cluster's GCS (used by
+        get_actor to rebuild a handle's method table client-side)."""
+        return self._rpc.call("client_fetch_function", function_key)
+
     def get_named_actor(self, name, namespace):
-        reply = self._rpc.call("client_get_named_actor", (name, namespace))
+        reply = self._rpc.call(
+            "client_get_named_actor", (name, namespace or self.namespace)
+        )
         if reply is None:
             raise ValueError(f"Failed to look up actor '{name}'")
         return reply
@@ -200,14 +240,34 @@ def _plain_options(options: dict) -> dict:
     return out
 
 
-def connect(address: str) -> ClientWorker:
+def _client_options(worker: ClientWorker, options: dict) -> dict:
+    """Resolve runtime_env and namespace on the CLIENT before shipping:
+    local working_dir paths must mean the client's filesystem, and named
+    actors must land in the client driver's namespace, not the client
+    server's."""
+    from ray_tpu._private import runtime_env as runtime_env_mod
+
+    out = _plain_options(options)
+    task_env = worker._prepare_env(out.get("runtime_env"))
+    merged = runtime_env_mod.merge(worker.job_runtime_env, task_env)
+    if merged:
+        out["runtime_env"] = merged
+    else:
+        out.pop("runtime_env", None)
+    if not out.get("namespace"):  # .options() ships namespace=None
+        out["namespace"] = worker.namespace
+    return out
+
+
+def connect(address: str, namespace: Optional[str] = None,
+            runtime_env: Optional[dict] = None) -> ClientWorker:
     """Install a ClientWorker as the process-global worker.  `address`
     is "ray://host:port" (or a raw tcp:/unix: RPC address)."""
     from ray_tpu._private import worker as worker_mod
 
     if address.startswith("ray://"):
         address = "tcp:" + address[len("ray://"):]
-    client = ClientWorker(address)
+    client = ClientWorker(address, namespace=namespace, runtime_env=runtime_env)
     with worker_mod._worker_lock:
         worker_mod._global_worker = client
     return client

@@ -122,6 +122,64 @@ ray_tpu.shutdown()
     assert "ERROR-OK" in out
 
 
+def test_client_runtime_env_and_namespace(client_server, tmp_path):
+    """runtime_env is packaged on the CLIENT machine (working_dir zip of
+    the client's filesystem, shipped via the server into the GCS KV) and
+    namespace is the client driver's, not the server's (reference: ray
+    client applies the job runtime_env from the remote driver)."""
+    wd = tmp_path / "client_wd"
+    wd.mkdir()
+    (wd / "client_data.txt").write_text("from-the-client-box")
+    out = _run_client(
+        f'''
+import ray_tpu
+ray_tpu.init(
+    address="{client_server}",
+    namespace="client-ns",
+    runtime_env={{"working_dir": r"{wd}", "env_vars": {{"CLIENT_RE": "yes"}}}},
+)
+
+@ray_tpu.remote
+def read():
+    import os
+    return open("client_data.txt").read(), os.environ.get("CLIENT_RE")
+
+data, ev = ray_tpu.get(read.remote(), timeout=60)
+assert data == "from-the-client-box", data
+assert ev == "yes", ev
+
+@ray_tpu.remote
+class Named:
+    def ping(self):
+        return "ns-ok"
+
+n = Named.options(name="client_named", lifetime="detached").remote()
+assert ray_tpu.get(n.ping.remote()) == "ns-ok"
+# Lookup without an explicit namespace must resolve in the client's.
+h = ray_tpu.get_actor("client_named")
+assert ray_tpu.get(h.ping.remote()) == "ns-ok"
+ray_tpu.kill(h)
+print("CLIENT-ENV-OK")
+'''
+    )
+    assert "CLIENT-ENV-OK" in out
+
+
+def test_client_rejects_cluster_shaping_args(client_server):
+    out = _run_client(
+        f'''
+import ray_tpu
+try:
+    ray_tpu.init(address="{client_server}", num_cpus=4)
+    raise SystemExit("no raise")
+except ValueError as e:
+    assert "num_cpus" in str(e)
+    print("REJECT-OK")
+'''
+    )
+    assert "REJECT-OK" in out
+
+
 def test_client_disconnect_releases_actors(client_server):
     """Non-detached actors created by a client die with its connection
     (reference: server release_all on disconnect)."""

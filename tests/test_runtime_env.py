@@ -117,12 +117,13 @@ def test_working_dir_on_actor(cluster, pkg_dir):
 
 
 def test_py_modules(cluster, tmp_path):
-    mod_dir = tmp_path / "mods"
-    pkg = mod_dir / "re_pkg_for_test"
+    # Reference semantics: each py_modules entry is the package directory
+    # itself and becomes importable by its own name on the worker.
+    pkg = tmp_path / "mods" / "re_pkg_for_test"
     pkg.mkdir(parents=True)
     (pkg / "__init__.py").write_text("NAME = 're_pkg'\n")
 
-    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
     def use_mod():
         import re_pkg_for_test
 
