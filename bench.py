@@ -120,6 +120,30 @@ def _run(snippet: str) -> dict:
     )
 
 
+def _run_ppo_bench() -> dict:
+    """North-star metric #2 (RLlib PPO env-steps/s) via bench_rllib.py in
+    its own subprocess (one chip owner at a time); absent on failure so a
+    wedged RL bench can't take down the headline number."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "bench_rllib.py"],
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=900,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                out = json.loads(line)
+                return {
+                    "ppo_cartpole_env_steps_per_sec": out["cartpole"]["env_steps_per_sec"],
+                    "ppo_pong_scale_env_steps_per_sec": out["pong_scale"]["env_steps_per_sec"],
+                }
+    except Exception:
+        pass
+    return {}
+
+
 def main():
     fw = _run(_FRAMEWORK_SNIPPET)
     raw = _run(_RAW_SNIPPET)
@@ -135,6 +159,7 @@ def main():
                 "raw_tokens_per_sec_per_chip": round(raw["tok_s_chip"], 1),
                 "framework_overhead_pct": round(100 * overhead, 2),
                 "on_tpu": fw["on_tpu"],
+                **_run_ppo_bench(),
             }
         )
     )

@@ -1,0 +1,208 @@
+"""North-star metric #2: RLlib PPO env-steps/sec on the TPU.
+
+BASELINE.json names two headline metrics; this measures the second
+("RLlib PPO env-steps/sec", ref: rllib/tuned_examples/ppo/atari_ppo.py +
+release/release_tests.yaml rllib throughput suites — the reference
+publishes no absolute TPU numbers, so the value stands on its own and
+vs_baseline is omitted).
+
+Two configs, both driven through the REAL Algorithm.training_step (not a
+stripped loop), single process owning the chip (num_env_runners=0 inline
+runner — the env-runner actor plane is benched separately in
+BENCH_micro.json's actor numbers):
+
+  cartpole   — CartPole-v1, 32 vector envs, MLP 64x64.  The classic
+               small-obs config: throughput is env-stepping + per-step
+               inference latency bound, the learner update is noise.
+  pong_scale — synthetic 84x84x4 uint8 image env (ALE isn't shipped in
+               this image; the env is a fixed-length random-pixel
+               stepper so the number isolates the FRAMEWORK + model
+               cost, not emulator speed), Nature-CNN torso, 32 envs.
+               Throughput is inference/update (MXU) bound.
+
+The phase split (env stepping vs policy inference vs learner update) is
+measured by instrumenting the inline runner's envs.step and explore_fn —
+the decomposition VERDICT r3 asked for; results land in PERF_ANALYSIS.md.
+
+Prints one JSON object with both configs + phase splits.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _make_cartpole_cfg():
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    return (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=0,
+            num_envs_per_env_runner=32,
+            rollout_fragment_length=128,
+        )
+        .training(lr=3e-4, train_batch_size=4096, minibatch_size=1024, num_epochs=4)
+    )
+
+
+class _RandomImageEnv:
+    """Pong-scale synthetic env: 84x84x4 uint8 observations, 6 discrete
+    actions, 512-step episodes.  Steps in O(1) (obs buffer reused with a
+    cheap in-place mutation) so the measurement isolates framework +
+    model throughput from emulator speed."""
+
+    metadata = {"render_modes": []}
+    render_mode = None
+    spec = None
+
+    def __init__(self):
+        import gymnasium as gym
+        import numpy as np
+
+        self.observation_space = gym.spaces.Box(0, 255, (84, 84, 4), np.uint8)
+        self.action_space = gym.spaces.Discrete(6)
+        self._rng = np.random.default_rng(0)
+        self._obs = self._rng.integers(0, 255, (84, 84, 4), np.uint8)
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return self._obs, {}
+
+    def step(self, action):
+        import numpy as np
+
+        self._t += 1
+        # cheap obs mutation: roll one row so consecutive frames differ
+        self._obs = np.roll(self._obs, 1, axis=0)
+        reward = float(action == 2)
+        terminated = False
+        truncated = self._t >= 512
+        return self._obs, reward, terminated, truncated, {}
+
+    def close(self):
+        pass
+
+
+def _make_pong_cfg():
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    return (
+        PPOConfig()
+        .environment(env_creator=lambda: _RandomImageEnv())
+        .env_runners(
+            num_env_runners=0,
+            num_envs_per_env_runner=32,
+            rollout_fragment_length=64,
+        )
+        .training(
+            lr=2.5e-4,
+            train_batch_size=2048,
+            minibatch_size=512,
+            num_epochs=2,
+            model={
+                # Nature-CNN (Mnih et al.) — the reference atari_ppo stack
+                "conv_filters": ((32, 8, 4), (64, 4, 2), (64, 3, 1)),
+                "hidden": (512,),
+                "vf_share_layers": True,
+            },
+        )
+    )
+
+
+def _instrument(runner, learner_group):
+    """Wrap the inline runner's env stepping + policy inference and the
+    learner update with accumulating timers; returns the timer dict."""
+    t = {"env": 0.0, "infer": 0.0, "update": 0.0}
+    real_update = learner_group.update_from_batch
+
+    def timed_update(batch, **kw):
+        t0 = time.perf_counter()
+        out = real_update(batch, **kw)
+        t["update"] += time.perf_counter() - t0
+        return out
+
+    learner_group.update_from_batch = timed_update
+    real_env_step = runner.envs.step
+    real_explore = runner._explore_fn
+    real_infer = runner._infer_fn
+
+    def timed_env_step(actions):
+        t0 = time.perf_counter()
+        out = real_env_step(actions)
+        t["env"] += time.perf_counter() - t0
+        return out
+
+    def timed_explore(params, obs, rng):
+        t0 = time.perf_counter()
+        out = real_explore(params, obs, rng)
+        # block so the timer captures device time, not dispatch time
+        out[0].block_until_ready()
+        t["infer"] += time.perf_counter() - t0
+        return out
+
+    def timed_infer(params, obs):
+        t0 = time.perf_counter()
+        out = real_infer(params, obs)
+        out[1].block_until_ready()
+        t["infer"] += time.perf_counter() - t0
+        return out
+
+    runner.envs.step = timed_env_step
+    runner._explore_fn = timed_explore
+    runner._infer_fn = timed_infer
+    return t
+
+
+def bench_config(name: str, cfg, iters: int = 3) -> dict:
+    import jax
+
+    algo = cfg.build()
+    runner = algo.env_runner_group.local_runner
+    # warmup: compiles explore/infer/update fns
+    algo.train()
+    timers = _instrument(runner, algo.learner_group)
+    t0 = time.perf_counter()
+    steps = 0
+    for _ in range(iters):
+        out = algo.train()
+        steps += out["num_env_steps_sampled"]
+    wall = time.perf_counter() - t0
+    algo.cleanup()
+    t_other = wall - timers["env"] - timers["infer"] - timers["update"]
+    return {
+        "config": name,
+        "env_steps_per_sec": round(steps / wall, 1),
+        "steps": steps,
+        "wall_s": round(wall, 3),
+        "pct_env_step": round(100 * timers["env"] / wall, 1),
+        "pct_inference": round(100 * timers["infer"] / wall, 1),
+        "pct_learner_update": round(100 * timers["update"] / wall, 1),
+        "pct_gae_and_bookkeeping": round(100 * t_other / wall, 1),
+    }
+
+
+def main() -> dict:
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "")
+    on_tpu = jax.default_backend() == "tpu"
+    out = {
+        "metric": "ppo_env_steps_per_sec",
+        "unit": "env_steps/s",
+        "on_tpu": on_tpu,
+        "cartpole": bench_config("cartpole", _make_cartpole_cfg()),
+        "pong_scale": bench_config("pong_scale", _make_pong_cfg()),
+    }
+    out["value"] = out["cartpole"]["env_steps_per_sec"]
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

@@ -6,13 +6,28 @@ Algorithm (a tune.Trainable).  Algorithms: PPO, DQN, IMPALA.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
-from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, LearnerThread
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    FlattenObservations,
+    NormalizeObservations,
+)
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import QModule, RLModule, RLModuleSpec
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.env.multi_agent_env import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentEnvRunnerGroup,
+)
 from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.utils.sample_batch import SampleBatch
 
@@ -25,6 +40,21 @@ __all__ = [
     "DQNConfig",
     "IMPALA",
     "IMPALAConfig",
+    "APPO",
+    "APPOConfig",
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
+    "LearnerThread",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentEnvRunnerGroup",
+    "ConnectorV2",
+    "ConnectorPipelineV2",
+    "FlattenObservations",
+    "NormalizeObservations",
+    "ClipActions",
     "Learner",
     "LearnerGroup",
     "RLModule",

@@ -43,6 +43,9 @@ class AlgorithmConfig:
         self.rollout_fragment_length = 200
         self.num_cpus_per_env_runner = 1.0
         self.restart_failed_env_runners = True
+        # Policy-inference device for env runners ("cpu" keeps per-step
+        # calls off the learner's chip; "" follows the JAX default).
+        self.inference_backend = "cpu"
         # training
         self.gamma = 0.99
         self.lr = 5e-5
@@ -55,6 +58,9 @@ class AlgorithmConfig:
         self.num_cpus_per_learner = 1.0
         # module
         self.model: Dict[str, Any] = {"hidden": (64, 64), "vf_share_layers": False}
+        # multi-agent (reference: algorithm_config.py multi_agent())
+        self.policies: Optional[Dict[str, Any]] = None  # policy_id -> spec | None
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
         # debug
         self.seed = 0
 
@@ -70,7 +76,9 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None, num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None, num_cpus_per_env_runner: Optional[float] = None,
-                    restart_failed_env_runners: Optional[bool] = None):
+                    restart_failed_env_runners: Optional[bool] = None, inference_backend: Optional[str] = None):
+        if inference_backend is not None:
+            self.inference_backend = inference_backend
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
@@ -99,6 +107,20 @@ class AlgorithmConfig:
         if num_cpus_per_learner is not None:
             self.num_cpus_per_learner = num_cpus_per_learner
         return self
+
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Callable[[str], str]] = None):
+        """Declare the policy set and the agent→policy routing
+        (reference: algorithm_config.py multi_agent())."""
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
 
     def debugging(self, *, seed: Optional[int] = None):
         if seed is not None:
@@ -142,6 +164,9 @@ class Algorithm(Trainable):
 
     config_class: Type[AlgorithmConfig] = AlgorithmConfig
     learner_class = None  # set by subclasses
+    supports_multi_agent = False  # PPO opts in
+    # V-trace-style algorithms keep autoreset rows for fixed batch shapes
+    mask_autoreset_rows = True
 
     def __init__(self, config=None, trial_dir: str = "."):
         # Accept AlgorithmConfig directly or a tune config dict (for
@@ -157,12 +182,15 @@ class Algorithm(Trainable):
     # -- Trainable hooks -------------------------------------------------
     def setup(self, config: Dict[str, Any]):
         cfg = self.algo_config
+        if cfg.is_multi_agent:
+            return self._setup_multi_agent()
         env_creator = cfg.make_env_creator()
         probe_env = env_creator()
         self.module_spec = RLModuleSpec.from_gym_env(
             probe_env,
             hidden=tuple(cfg.model.get("hidden", (64, 64))),
             vf_share_layers=cfg.model.get("vf_share_layers", False),
+            conv_filters=cfg.model.get("conv_filters"),
         )
         probe_env.close()
         self.env_runner_group = EnvRunnerGroup(
@@ -177,6 +205,8 @@ class Algorithm(Trainable):
             num_cpus_per_runner=cfg.num_cpus_per_env_runner,
             restart_failed=cfg.restart_failed_env_runners,
             seed=cfg.seed,
+            inference_backend=cfg.inference_backend,
+            mask_autoreset=type(self).mask_autoreset_rows,
         )
         self.learner_group = LearnerGroup(
             type(self).learner_class,
@@ -186,6 +216,72 @@ class Algorithm(Trainable):
             resources={"num_cpus": cfg.num_cpus_per_learner},
         )
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._timesteps_total = 0
+
+    def _setup_multi_agent(self):
+        """Multi-agent wiring: one RLModuleSpec + LearnerGroup per
+        policy, a MultiAgentEnvRunnerGroup routing agents to policies
+        (reference: algorithm.py setup() with config.policies)."""
+        from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnvRunnerGroup
+
+        cfg = self.algo_config
+        if not type(self).supports_multi_agent:
+            raise ValueError(
+                f"{type(self).__name__} does not support multi_agent() configs "
+                "(its training_step drives a single learner group)"
+            )
+        if cfg.env_creator is None:
+            raise ValueError("multi-agent configs require environment(env_creator=...)")
+        mapping = cfg.policy_mapping_fn or (lambda agent_id: agent_id)
+        probe = cfg.env_creator()
+        # Infer each policy's spec from the first agent that maps to it.
+        specs: Dict[str, RLModuleSpec] = {}
+        for pid, given in cfg.policies.items():
+            if given is not None:
+                specs[pid] = given
+                continue
+            agent = next(
+                (a for a in probe.possible_agents if mapping(a) == pid), None
+            )
+            if agent is None:
+                raise ValueError(f"no agent maps to policy {pid!r}")
+
+            class _SpaceView:  # minimal gym-like view for from_gym_env
+                observation_space = probe.observation_space_for(agent)
+                action_space = probe.action_space_for(agent)
+
+            specs[pid] = RLModuleSpec.from_gym_env(
+                _SpaceView,
+                hidden=tuple(cfg.model.get("hidden", (64, 64))),
+                vf_share_layers=cfg.model.get("vf_share_layers", False),
+            )
+        probe.close()
+        self.module_specs = specs
+        self.policy_mapping_fn = mapping
+        self.env_runner_group = MultiAgentEnvRunnerGroup(
+            cfg.env_creator,
+            specs,
+            mapping,
+            num_env_runners=cfg.num_env_runners,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            gamma=cfg.gamma,
+            lambda_=getattr(cfg, "lambda_", 0.95),
+            num_cpus_per_runner=cfg.num_cpus_per_env_runner,
+            seed=cfg.seed,
+            inference_backend=cfg.inference_backend,
+        )
+        self.learner_groups = {
+            pid: LearnerGroup(
+                type(self).learner_class,
+                spec,
+                config=self._learner_config(),
+                num_learners=0,
+            )
+            for pid, spec in specs.items()
+        }
+        self.env_runner_group.sync_weights(
+            {pid: lg.get_weights() for pid, lg in self.learner_groups.items()}
+        )
         self._timesteps_total = 0
 
     def _needs_advantages(self) -> bool:
@@ -216,8 +312,12 @@ class Algorithm(Trainable):
     # -- checkpoints (reference: rllib/utils/checkpoints.py
     # Checkpointable) ----------------------------------------------------
     def save_checkpoint(self, checkpoint_dir: str):
+        if self.algo_config.is_multi_agent:
+            learner_state = {pid: lg.get_state() for pid, lg in self.learner_groups.items()}
+        else:
+            learner_state = self.learner_group.get_state()
         state = {
-            "learner": self.learner_group.get_state(),
+            "learner": learner_state,
             "timesteps_total": self._timesteps_total,
             "config": self.algo_config.to_dict(),
         }
@@ -227,9 +327,16 @@ class Algorithm(Trainable):
     def load_checkpoint(self, checkpoint_dir: str):
         with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
             state = pickle.load(f)
-        self.learner_group.set_state(state["learner"])
+        if self.algo_config.is_multi_agent:
+            for pid, s in state["learner"].items():
+                self.learner_groups[pid].set_state(s)
+            self.env_runner_group.sync_weights(
+                {pid: lg.get_weights() for pid, lg in self.learner_groups.items()}
+            )
+        else:
+            self.learner_group.set_state(state["learner"])
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._timesteps_total = state.get("timesteps_total", 0)
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     @classmethod
     def from_checkpoint(cls, checkpoint_dir: str) -> "Algorithm":
@@ -241,10 +348,16 @@ class Algorithm(Trainable):
         return algo
 
     def get_policy_weights(self):
+        if self.algo_config.is_multi_agent:
+            return {pid: lg.get_weights() for pid, lg in self.learner_groups.items()}
         return self.learner_group.get_weights()
 
     def cleanup(self):
         self.env_runner_group.stop()
-        self.learner_group.shutdown()
+        if self.algo_config.is_multi_agent:
+            for lg in self.learner_groups.values():
+                lg.shutdown()
+        else:
+            self.learner_group.shutdown()
 
     stop = cleanup

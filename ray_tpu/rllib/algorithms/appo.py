@@ -1,0 +1,145 @@
+"""APPO — Asynchronous PPO (reference: rllib/algorithms/appo/appo.py +
+appo_torch_learner: IMPALA's async actor-learner architecture with PPO's
+clipped surrogate computed on V-trace advantages, plus a periodically
+synced target policy for the KL/clipping anchor).
+
+Inherits IMPALA's pipeline (learner thread, bounded queue, broadcast
+interval); only the loss and the target-network bookkeeping differ."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithms.impala import (
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+    vtrace_returns,
+)
+from ray_tpu.rllib.utils.sample_batch import (
+    ACTIONS,
+    LOGP,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.4
+        self.use_kl_loss = False
+        self.kl_coeff = 1.0
+        self.kl_target = 0.01
+        self.target_network_update_freq = 2  # learner batches
+
+    @property
+    def algo_class(self):
+        return APPO
+
+
+class APPOLearner(IMPALALearner):
+    """Clipped-surrogate V-trace loss (reference: appo_torch_learner
+    compute_loss_for_module)."""
+
+    def __init__(self, module_spec, config=None):
+        import jax
+
+        super().__init__(module_spec, config)
+        # target (old) policy anchors the KL term; a REAL copy — the
+        # update donates self.params, so aliased buffers would be deleted
+        import jax.numpy as jnp
+
+        self.old_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self._batches_since_target_sync = 0
+        self._old_logp_fn = None
+
+    def compute_loss(self, params, batch: Dict[str, Any], rng):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        clip = cfg.get("clip_param", 0.4)
+        logp, entropy, values = self.module.forward_train(params, batch[OBS], batch[ACTIONS])
+        discounts = gamma * (1.0 - batch[TERMINATEDS].astype(jnp.float32))
+        # Two-policy decomposition (reference appo_torch_learner):
+        # V-trace corrects behaviour→TARGET staleness (its clipped-rho is
+        # already inside pg_adv); the PPO clip then anchors on the slowly
+        # moving target policy, ratio = π_current / π_target.  Using the
+        # behaviour policy for both double-counts the correction (rho²)
+        # and stalls learning.
+        target_logp = batch["target_logp"]
+        vs, pg_adv, rhos = vtrace_returns(
+            target_logp, batch[LOGP], values, batch[REWARDS], discounts,
+            cfg.get("vtrace_clip_rho", 1.0), cfg.get("vtrace_clip_c", 1.0),
+        )
+        ratio = jnp.exp(logp - target_logp)
+        surrogate = jnp.minimum(
+            ratio * pg_adv, jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv
+        )
+        pi_loss = -surrogate.mean()
+        vf_loss = 0.5 * jnp.square(values - vs).mean()
+        ent = entropy.mean()
+        total = (
+            pi_loss
+            + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+            - cfg.get("entropy_coeff", 0.01) * ent
+        )
+        metrics = {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "mean_rho": rhos.mean(),
+        }
+        if cfg.get("use_kl_loss"):
+            kl = (target_logp - logp).mean()
+            total = total + cfg.get("kl_coeff", 1.0) * kl
+            metrics["mean_kl"] = kl
+        return total, metrics
+
+    def before_update(self, batch):
+        import jax
+        import numpy as np
+
+        # target-policy logp is computed OUTSIDE the jitted loss and
+        # shipped as a batch column — closing over self.old_params would
+        # bake a stale constant into the compiled program.
+        if self._old_logp_fn is None:
+            self._old_logp_fn = jax.jit(
+                lambda p, obs, act: self.module.forward_train(p, obs, act)[0]
+            )
+        batch["target_logp"] = np.asarray(
+            self._old_logp_fn(self.old_params, batch[OBS], batch[ACTIONS])
+        )
+
+    def after_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        self._batches_since_target_sync += 1
+        if self._batches_since_target_sync >= self.config.get(
+            "target_network_update_freq", 2
+        ):
+            self.old_params = jax.tree_util.tree_map(jnp.copy, self.params)
+            self._batches_since_target_sync = 0
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+    learner_class = APPOLearner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        out = super()._learner_config()
+        out.update(
+            vtrace_clip_rho=cfg.vtrace_clip_rho,
+            vtrace_clip_c=cfg.vtrace_clip_c,
+            vf_loss_coeff=cfg.vf_loss_coeff,
+            entropy_coeff=cfg.entropy_coeff,
+            clip_param=cfg.clip_param,
+            use_kl_loss=cfg.use_kl_loss,
+            kl_coeff=cfg.kl_coeff,
+            target_network_update_freq=cfg.target_network_update_freq,
+        )
+        return out

@@ -1,0 +1,208 @@
+"""BC — offline Behavior Cloning (reference: rllib/algorithms/bc/bc.py +
+bc_catalog / MARWIL's beta=0 special case: supervised -logp(a|s) on a
+recorded dataset, no environment interaction).
+
+Offline data flows through ray_tpu.data: ``config.offline_data(input_=...)``
+accepts a Dataset, a list of SampleBatch-like dicts, or a path of JSON
+rows (reference: rllib/offline/offline_data.py reading via Ray Data).
+The learner is the standard jitted Learner with a log-likelihood loss,
+so the fused epoch/minibatch scan applies unchanged."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.utils.sample_batch import ACTIONS, OBS, SampleBatch
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 2048
+        self.minibatch_size = 256
+        self.num_epochs = 1
+        self.input_: Any = None  # Dataset | list[dict] | path
+        self.num_env_runners = 0
+        # evaluation rollouts (optional; BC itself never touches the env)
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_num_episodes = 5
+
+    def offline_data(self, *, input_: Any = None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class BCLearner(Learner):
+    def compute_loss(self, params, batch: Dict[str, Any], rng):
+        logp, entropy, _ = self.module.forward_train(params, batch[OBS], batch[ACTIONS])
+        loss = -logp.mean()
+        return loss, {"bc_logp": logp.mean(), "entropy": entropy.mean()}
+
+
+class BC(Algorithm):
+    config_class = BCConfig
+    learner_class = BCLearner
+
+    def _needs_advantages(self) -> bool:
+        return False
+
+    def setup(self, config: Dict[str, Any]):
+        from ray_tpu.rllib.core.learner import LearnerGroup
+
+        cfg = self.algo_config
+        self._dataset = _load_offline(cfg.input_)
+        if self._dataset.count == 0:
+            raise ValueError("BC offline input is empty")
+        # module spec from the data or from the (optional) env
+        if cfg.env is not None or cfg.env_creator is not None:
+            probe = cfg.make_env_creator()()
+            self.module_spec = RLModuleSpec.from_gym_env(
+                probe, hidden=tuple(cfg.model.get("hidden", (64, 64)))
+            )
+            probe.close()
+        else:
+            obs = np.asarray(self._dataset[OBS])
+            acts = np.asarray(self._dataset[ACTIONS])
+            discrete = np.issubdtype(acts.dtype, np.integer)
+            self.module_spec = RLModuleSpec(
+                observation_dim=int(np.prod(obs.shape[1:])),
+                action_dim=int(acts.max()) + 1 if discrete else int(np.prod(acts.shape[1:])),
+                discrete=discrete,
+                hidden=tuple(cfg.model.get("hidden", (64, 64))),
+            )
+        self.learner_group = LearnerGroup(
+            BCLearner, self.module_spec, config=self._learner_config(), num_learners=cfg.num_learners
+        )
+        self._timesteps_total = 0
+        self._epoch_rng = np.random.default_rng(cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        # one pass: sample train_batch_size rows from the dataset
+        n = self._dataset.count
+        idx = self._epoch_rng.integers(0, n, min(cfg.train_batch_size, n))
+        batch = SampleBatch({k: np.asarray(v)[idx] for k, v in self._dataset.items()})
+        metrics = self.learner_group.update_from_batch(
+            batch, minibatch_size=cfg.minibatch_size, num_epochs=cfg.num_epochs
+        )
+        self._timesteps_total += batch.count
+        metrics["num_env_steps_trained"] = self._timesteps_total
+        if (
+            cfg.evaluation_interval
+            and (cfg.env is not None or cfg.env_creator is not None)
+            and self.iteration % cfg.evaluation_interval == 0
+        ):
+            metrics["evaluation_return_mean"] = self.evaluate()
+        return metrics
+
+    def step(self) -> Dict[str, Any]:
+        import time
+
+        t0 = time.time()
+        out = self.training_step()  # no env runner group: offline only
+        out.setdefault("timesteps_total", self._timesteps_total)
+        out["time_this_iter_s"] = time.time() - t0
+        return out
+
+    def evaluate(self) -> float:
+        """Greedy rollouts of the cloned policy (reference: BC eval via
+        evaluation env runners)."""
+        import jax
+
+        cfg = self.algo_config
+        env = cfg.make_env_creator()()
+        module = self.module_spec.build()
+        params = module.set_weights(self.learner_group.get_weights())
+        infer = jax.jit(module.forward_inference)
+        total = 0.0
+        for ep in range(cfg.evaluation_num_episodes):
+            obs, _ = env.reset(seed=cfg.seed + ep)
+            done = False
+            while not done:
+                a, _ = infer(params, obs[None])
+                a = np.asarray(a)[0]
+                if self.module_spec.discrete:
+                    a = int(a)
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+        env.close()
+        return total / cfg.evaluation_num_episodes
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        state = {
+            "learner": self.learner_group.get_state(),
+            "timesteps_total": self._timesteps_total,
+            "config": {
+                k: v for k, v in self.algo_config.to_dict().items() if k != "input_"
+            },
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self._timesteps_total = state.get("timesteps_total", 0)
+
+    def cleanup(self):
+        self.learner_group.shutdown()
+
+    stop = cleanup
+
+
+def _load_offline(input_: Any) -> SampleBatch:
+    """Materialize offline input into one flat SampleBatch."""
+    if input_ is None:
+        raise ValueError("BCConfig.offline_data(input_=...) is required")
+    if isinstance(input_, SampleBatch):
+        return input_
+    # ray_tpu.data Dataset
+    if hasattr(input_, "take_all"):
+        rows: List[dict] = input_.take_all()
+        return _rows_to_batch(rows)
+    if isinstance(input_, (list, tuple)):
+        return _rows_to_batch(list(input_))
+    if isinstance(input_, str):
+        import json
+        import os
+
+        rows = []
+        paths = (
+            [os.path.join(input_, f) for f in sorted(os.listdir(input_))]
+            if os.path.isdir(input_)
+            else [input_]
+        )
+        for p in paths:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+        return _rows_to_batch(rows)
+    raise TypeError(f"unsupported offline input type {type(input_).__name__}")
+
+
+def _rows_to_batch(rows: List[dict]) -> SampleBatch:
+    if not rows:
+        return SampleBatch({OBS: np.zeros((0, 1)), ACTIONS: np.zeros((0,))})
+    cols = {k: np.asarray([r[k] for r in rows]) for k in rows[0].keys()}
+    return SampleBatch(cols)

@@ -130,24 +130,29 @@ class DQNLearner(Learner):
 
 
 class _EpsilonGreedySampler:
-    """Inline sampler: epsilon-greedy over Q-values with transition
-    collection into (obs, action, reward, next_obs, terminated)."""
+    """Inline sampler: epsilon-greedy over Q-values; transition
+    collection delegated to the shared VectorEnvCollector."""
 
     def __init__(self, env_creator, qmodule: QModule, cfg: "DQNConfig"):
         import gymnasium as gym
         import jax
 
+        from ray_tpu.rllib.utils.collector import VectorEnvCollector
+
         self.envs = gym.vector.SyncVectorEnv([env_creator for _ in range(cfg.num_envs_per_env_runner)])
         self.qmodule = qmodule
         self.cfg = cfg
         self._q_fn = jax.jit(qmodule.q_values)
-        obs, _ = self.envs.reset(seed=cfg.seed)
-        self._obs = obs
         self._rng = np.random.default_rng(cfg.seed)
-        self._episode_returns = np.zeros(self.envs.num_envs)
-        self._episode_lens = np.zeros(self.envs.num_envs, dtype=np.int64)
-        self.completed_returns = []
-        self.completed_lens = []
+        self._collector = VectorEnvCollector(self.envs, seed=cfg.seed)
+
+    @property
+    def completed_returns(self):
+        return self._collector.completed_returns
+
+    @property
+    def completed_lens(self):
+        return self._collector.completed_lens
 
     def epsilon(self, t: int) -> float:
         c = self.cfg
@@ -155,31 +160,15 @@ class _EpsilonGreedySampler:
         return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
 
     def sample(self, params, num_steps: int, t: int) -> SampleBatch:
-        cols = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS)}
         n_envs = self.envs.num_envs
-        for _ in range(num_steps):
-            eps = self.epsilon(t)
-            q = np.asarray(self._q_fn(params, self._obs))
+
+        def act(obs, t_now):
+            q = np.asarray(self._q_fn(params, obs))
             greedy = q.argmax(axis=-1)
             rand = self._rng.integers(0, q.shape[-1], n_envs)
-            actions = np.where(self._rng.random(n_envs) < eps, rand, greedy)
-            next_obs, rewards, term, trunc, info = self.envs.step(actions)
-            real_next = next_obs.copy()
-            cols[OBS].append(self._obs.copy())
-            cols[ACTIONS].append(actions)
-            cols[REWARDS].append(np.asarray(rewards, np.float32))
-            cols[NEXT_OBS].append(real_next)
-            cols[TERMINATEDS].append(term.copy())
-            self._episode_returns += rewards
-            self._episode_lens += 1
-            for i in np.where(term | trunc)[0]:
-                self.completed_returns.append(float(self._episode_returns[i]))
-                self.completed_lens.append(int(self._episode_lens[i]))
-                self._episode_returns[i] = 0.0
-                self._episode_lens[i] = 0
-            self._obs = next_obs
-            t += n_envs
-        return SampleBatch({k: np.concatenate(v, axis=0) for k, v in cols.items()})
+            return np.where(self._rng.random(n_envs) < self.epsilon(t_now), rand, greedy)
+
+        return self._collector.collect(num_steps, act)
 
 
 class DQN(Algorithm):

@@ -20,7 +20,15 @@ logger = logging.getLogger(__name__)
 
 
 class Learner:
-    """Owns params + optimizer state; subclasses define the loss."""
+    """Owns params + optimizer state; subclasses define the loss.
+
+    Losses that treat the batch's row axis as a TIME axis (V-trace's
+    lax.scan in IMPALA/APPO) must set ``preserve_time_order = True``:
+    it routes updates through the order-preserving single-step path and
+    disables pad-by-cycling — both the fused-epoch permutation and
+    cycled padding rows would silently corrupt temporal targets."""
+
+    preserve_time_order = False
 
     def __init__(self, module_spec, config: Optional[Dict[str, Any]] = None):
         import jax
@@ -41,12 +49,22 @@ class Learner:
         self.optimizer = optax.chain(*chain)
         self.opt_state = self.optimizer.init(self.params)
         self._update_fn = None
+        # (batch_count, minibatch_size, num_epochs) -> fused jitted fn
+        self._epochs_fns: Dict[tuple, Callable] = {}
         self._metrics: Dict[str, float] = {}
 
     # -- subclass API ----------------------------------------------------
     def compute_loss(self, params, batch: Dict[str, Any], rng) -> Any:
         """Return (loss_scalar, metrics_dict) — pure/jittable."""
         raise NotImplementedError
+
+    def before_update(self, batch) -> None:
+        """Hook run before EVERY update dispatch (single or fused
+        epochs): mutate `batch` to attach derived columns (e.g. APPO's
+        target-policy logp).  Runs outside jit."""
+
+    def after_update(self) -> None:
+        """Hook run after every update dispatch (target syncs etc.)."""
 
     # -- update ----------------------------------------------------------
     def _build_update_fn(self) -> Callable:
@@ -66,22 +84,131 @@ class Learner:
             ) ** 0.5
             return params, opt_state, metrics
 
-        return jax.jit(update, donate_argnums=(0, 1))
+        # opt_state only: params are concurrently read by weight
+        # broadcasts (learner thread vs driver) — donating them lets the
+        # update delete buffers mid-read.
+        return jax.jit(update, donate_argnums=(1,))
 
     def update_from_batch(self, batch) -> Dict[str, float]:
         """One gradient step on one (mini)batch (reference:
-        learner.py:948)."""
+        learner.py:948).
+
+        Rows are padded (cycling) up to a multiple of 32 so fragments of
+        slightly varying length (episode-boundary drops) reuse one
+        compiled program instead of recompiling per batch — on a stream
+        of rollout fragments that recompile would dominate wall time."""
         import jax
         import jax.numpy as jnp
 
         if self._update_fn is None:
             self._update_fn = self._build_update_fn()
+        self.before_update(batch)
         self._rng, step_rng = jax.random.split(self._rng)
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        count = batch.count
+        padded = count if self.preserve_time_order else ((count + 31) // 32) * 32
+        if padded != count:
+            idx = np.arange(padded) % count
+            jbatch = {k: jnp.asarray(np.asarray(v)[idx]) for k, v in batch.items()}
+        else:
+            jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, jbatch, step_rng
         )
-        self._metrics = {k: float(v) for k, v in metrics.items()}
+        self._metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        self.after_update()
+        return self._metrics
+
+    # -- fused epoch/minibatch update (TPU-first) -----------------------
+    def _build_epochs_fn(self, count: int, minibatch_size: int, num_epochs: int) -> Callable:
+        """The reference drives epochs × minibatches as a Python loop of
+        individual update calls (learner.py minibatch loop) — one device
+        dispatch per minibatch.  Here the WHOLE schedule is one jitted
+        program: lax.scan over epochs, each a fresh in-jit permutation
+        scanned over minibatches.  One dispatch per training_step, which
+        is the difference between RTT-bound and compute-bound when the
+        chip sits behind any nonzero link latency."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        n_mb = max(1, count // minibatch_size)
+        take = n_mb * minibatch_size
+
+        def epochs(params, opt_state, batch, rng):
+            def minibatch_step(carry, scanned):
+                mb_idx, mb_rng = scanned
+                params, opt_state = carry
+                mb = jax.tree_util.tree_map(lambda v: v[mb_idx], batch)
+
+                def loss_wrapper(p):
+                    return self.compute_loss(p, mb, mb_rng)
+
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_wrapper, has_aux=True
+                )(params)
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+                metrics["total_loss"] = loss
+                metrics["grad_norm"] = (
+                    jax.tree_util.tree_reduce(
+                        lambda a, g: a + (g ** 2).sum(), grads, 0.0
+                    )
+                    ** 0.5
+                )
+                return (params, opt_state), metrics
+
+            def epoch_step(carry, ep_rng):
+                perm_rng, loss_rng = jax.random.split(ep_rng)
+                perm = jax.random.permutation(perm_rng, count)[:take]
+                idx = perm.reshape(n_mb, minibatch_size)
+                return lax.scan(
+                    minibatch_step, carry, (idx, jax.random.split(loss_rng, n_mb))
+                )
+
+            rngs = jax.random.split(rng, num_epochs)
+            (params, opt_state), metrics = lax.scan(
+                epoch_step, (params, opt_state), rngs
+            )
+            # report the final minibatch's metrics (matches the Python
+            # loop's "last update wins" semantics)
+            last = jax.tree_util.tree_map(lambda m: m[-1, -1], metrics)
+            return params, opt_state, last
+
+        # opt_state only — see _build_update_fn on the params/broadcast race
+        return jax.jit(epochs, donate_argnums=(1,))
+
+    def update_from_batch_epochs(
+        self, batch, minibatch_size: int, num_epochs: int
+    ) -> Dict[str, float]:
+        """Full epoch×minibatch SGD schedule in one device dispatch.
+
+        The batch is padded (row-cycling) up to a multiple of
+        minibatch_size so consecutive iterations with slightly different
+        row counts (episode-boundary drops) hit the SAME compiled
+        program instead of recompiling — static shapes are the contract
+        that keeps XLA fast."""
+        import jax
+        import jax.numpy as jnp
+
+        self.before_update(batch)
+        count = batch.count
+        mb = min(minibatch_size, count)
+        padded = ((count + mb - 1) // mb) * mb
+        key = (padded, mb, num_epochs)
+        fn = self._epochs_fns.get(key)
+        if fn is None:
+            fn = self._epochs_fns[key] = self._build_epochs_fn(padded, mb, num_epochs)
+        self._rng, step_rng = jax.random.split(self._rng)
+        if padded != count:
+            idx = np.arange(padded) % count
+            jbatch = {k: jnp.asarray(np.asarray(v)[idx]) for k, v in batch.items()}
+        else:
+            jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = fn(
+            self.params, self.opt_state, jbatch, step_rng
+        )
+        self._metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        self.after_update()
         return self._metrics
 
     # -- weights / checkpoints ------------------------------------------
@@ -150,18 +277,19 @@ class LearnerGroup:
     def update_from_batch(self, batch, minibatch_size: Optional[int] = None, num_epochs: int = 1) -> Dict[str, float]:
         """Epoch/minibatch SGD driver (reference: Learner minibatch loop)."""
         import ray_tpu
-        from ray_tpu.rllib.utils.sample_batch import SampleBatch
 
-        rng = np.random.default_rng(0)
-        last: Dict[str, float] = {}
         if self._local is not None:
-            for _ in range(num_epochs):
-                if minibatch_size and minibatch_size < batch.count:
-                    for mb in batch.minibatches(minibatch_size, rng):
-                        last = self._local.update_from_batch(mb)
-                else:
+            if self._local.preserve_time_order:
+                # temporal losses: no permutation, no minibatching
+                last: Dict[str, float] = {}
+                for _ in range(num_epochs):
                     last = self._local.update_from_batch(batch)
-            return last
+                return last
+            # One fused dispatch for the whole epoch×minibatch schedule
+            # (see _build_epochs_fn) instead of a Python minibatch loop.
+            return self._local.update_from_batch_epochs(
+                batch, minibatch_size or batch.count, num_epochs
+            )
         # remote: shard the batch across learner actors
         n = len(self._workers)
         shard = max(1, batch.count // n)

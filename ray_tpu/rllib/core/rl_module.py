@@ -30,17 +30,34 @@ class RLModuleSpec:
     hidden: Tuple[int, ...] = (64, 64)
     vf_share_layers: bool = False
     dtype: Any = jnp.float32
+    # Image observations: original (H, W, C) shape + conv torso spec of
+    # (out_channels, kernel, stride) triples (reference: the rllib model
+    # catalog's conv_filters; default stack below = the Nature-CNN).
+    obs_shape: Optional[Tuple[int, ...]] = None
+    conv_filters: Optional[Tuple[Tuple[int, int, int], ...]] = None
 
     @classmethod
-    def from_gym_env(cls, env, hidden=(64, 64), vf_share_layers=False) -> "RLModuleSpec":
+    def from_gym_env(
+        cls, env, hidden=(64, 64), vf_share_layers=False, conv_filters=None
+    ) -> "RLModuleSpec":
         import gymnasium as gym
 
         obs_space = env.single_observation_space if hasattr(env, "single_observation_space") else env.observation_space
         act_space = env.single_action_space if hasattr(env, "single_action_space") else env.action_space
         obs_dim = int(np.prod(obs_space.shape))
+        obs_shape = None
+        if conv_filters is not None:
+            if len(obs_space.shape) != 3:
+                raise ValueError(
+                    f"conv_filters requires (H, W, C) observations, got {obs_space.shape}"
+                )
+            obs_shape = tuple(int(s) for s in obs_space.shape)
+            conv_filters = tuple(tuple(f) for f in conv_filters)
         if isinstance(act_space, gym.spaces.Discrete):
-            return cls(obs_dim, int(act_space.n), True, tuple(hidden), vf_share_layers)
-        return cls(obs_dim, int(np.prod(act_space.shape)), False, tuple(hidden), vf_share_layers)
+            return cls(obs_dim, int(act_space.n), True, tuple(hidden), vf_share_layers,
+                       obs_shape=obs_shape, conv_filters=conv_filters)
+        return cls(obs_dim, int(np.prod(act_space.shape)), False, tuple(hidden), vf_share_layers,
+                   obs_shape=obs_shape, conv_filters=conv_filters)
 
     def build(self) -> "RLModule":
         return RLModule(self)
@@ -52,10 +69,25 @@ class _PiVfNet(nn.Module):
     @nn.compact
     def __call__(self, obs):
         spec = self.spec
-        x = obs.reshape(obs.shape[0], -1).astype(spec.dtype)
+        if spec.conv_filters:
+            # uint8 images → [0,1] floats in (B, H, W, C); convs map
+            # straight onto the MXU as implicit matmuls.
+            x = (
+                obs.reshape((obs.shape[0],) + spec.obs_shape).astype(spec.dtype)
+                / 255.0
+            )
+        else:
+            x = obs.reshape(obs.shape[0], -1).astype(spec.dtype)
 
         def torso(tag):
             h = x
+            for i, (ch, k, s) in enumerate(spec.conv_filters or ()):
+                h = nn.relu(
+                    nn.Conv(ch, (k, k), strides=(s, s), padding="VALID",
+                            dtype=spec.dtype, name=f"{tag}_conv_{i}")(h)
+                )
+            if spec.conv_filters:
+                h = h.reshape(h.shape[0], -1)
             for i, w in enumerate(spec.hidden):
                 h = nn.tanh(nn.Dense(w, dtype=spec.dtype, name=f"{tag}_dense_{i}")(h))
             return h

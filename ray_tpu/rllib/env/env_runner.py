@@ -37,6 +37,10 @@ class SingleAgentEnvRunner:
         compute_advantages: bool = True,
         worker_index: int = 0,
         seed: int = 0,
+        inference_backend: str = "cpu",
+        env_to_module=None,
+        module_to_env=None,
+        mask_autoreset: bool = True,
     ):
         import gymnasium as gym
         import jax
@@ -51,10 +55,39 @@ class SingleAgentEnvRunner:
         self.module = module_spec.build()
         self._rng = jax.random.PRNGKey(seed * 100003 + worker_index)
         self.params = None
+        # Env runners default to CPU inference: per-step policy calls are
+        # latency-bound (one small batch per vector-env step), and the
+        # TPU belongs to the learner — shipping every step's obs over the
+        # device link would serialize rollouts on RTT (the reference's
+        # architecture is the same: env runners are CPU actors).
+        self._device = None
+        if inference_backend:
+            try:
+                self._device = jax.local_devices(backend=inference_backend)[0]
+            except RuntimeError:
+                self._device = None  # backend absent: follow the default
+        if self._device is not None:
+            # The per-step rng split must live on the inference device
+            # too, or every env step pays a dispatch to the default
+            # (possibly remote) accelerator just to split a key.
+            self._rng = jax.device_put(self._rng, self._device)
+        # connector pipelines (reference: env_to_module / module_to_env
+        # insertion points in single_agent_env_runner.sample)
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
         self._explore_fn = jax.jit(self.module.forward_exploration)
         self._infer_fn = jax.jit(self.module.forward_inference)
         obs, _ = self.envs.reset(seed=seed * 17 + worker_index)
         self._obs = obs
+        # gymnasium >= 1.0 next-step autoreset: the step after a done is
+        # a reset step — its recorded transition is dropped below when
+        # mask_autoreset is set.  Temporal-loss consumers (V-trace) keep
+        # the rows instead: dropping them varies the batch shape (jit
+        # recompiles per fragment) while the preceding row's
+        # terminated=True already zeroes the discount, so the garbage
+        # row's influence can't propagate through the time scan.
+        self.mask_autoreset = mask_autoreset
+        self._prev_done = np.zeros(num_envs, bool)
         self._eps_id = np.arange(num_envs, dtype=np.int64) + worker_index * 1_000_000
         self._next_eps = num_envs + worker_index * 1_000_000
         self._episode_returns = np.zeros(num_envs)
@@ -63,7 +96,13 @@ class SingleAgentEnvRunner:
         self._completed_lens: List[int] = []
 
     def set_weights(self, weights):
+        import jax
+
         self.params = self.module.set_weights(weights)
+        if self._device is not None:
+            # Committed params pin the jitted forward passes to this
+            # device (computation follows the committed operand).
+            self.params = jax.device_put(self.params, self._device)
 
     def get_weights(self):
         return self.module.get_weights(self.params)
@@ -77,17 +116,19 @@ class SingleAgentEnvRunner:
         steps = num_steps or self.fragment_length
         cols: Dict[str, List[np.ndarray]] = {k: [] for k in
             (OBS, ACTIONS, REWARDS, TERMINATEDS, TRUNCATEDS, LOGP, VF_PREDS, EPS_ID)}
+        valid_rows: List[np.ndarray] = []
         for _ in range(steps):
             self._rng, step_rng = jax.random.split(self._rng)
+            mod_obs = self._obs if self.env_to_module is None else self.env_to_module(self._obs)
             if explore:
-                actions, logp, value = self._explore_fn(self.params, self._obs, step_rng)
+                actions, logp, value = self._explore_fn(self.params, mod_obs, step_rng)
             else:
-                actions, value = self._infer_fn(self.params, self._obs)
+                actions, value = self._infer_fn(self.params, mod_obs)
                 logp = np.zeros(self.num_envs, np.float32)
             actions = np.asarray(actions)
-            env_actions = actions
+            env_actions = actions if self.module_to_env is None else self.module_to_env(actions)
             next_obs, rewards, term, trunc, _ = self.envs.step(env_actions)
-            cols[OBS].append(self._obs.copy())
+            cols[OBS].append(np.asarray(mod_obs).copy())
             cols[ACTIONS].append(actions)
             cols[REWARDS].append(np.asarray(rewards, np.float32))
             cols[TERMINATEDS].append(term.copy())
@@ -95,10 +136,15 @@ class SingleAgentEnvRunner:
             cols[LOGP].append(np.asarray(logp, np.float32))
             cols[VF_PREDS].append(np.asarray(value, np.float32))
             cols[EPS_ID].append(self._eps_id.copy())
-            # episode bookkeeping
-            self._episode_returns += rewards
-            self._episode_lens += 1
-            done = term | trunc
+            keep = ~self._prev_done if self.mask_autoreset else np.ones(self.num_envs, bool)
+            valid_rows.append(keep)
+            if not self.mask_autoreset:
+                keep = ~self._prev_done  # bookkeeping still skips reset rows
+            # episode bookkeeping (reset rows carry no reward/length)
+            self._episode_returns[keep] += rewards[keep]
+            self._episode_lens[keep] += 1
+            done = (term | trunc) & keep
+            self._prev_done = term | trunc
             for i in np.where(done)[0]:
                 self._completed_returns.append(float(self._episode_returns[i]))
                 self._completed_lens.append(int(self._episode_lens[i]))
@@ -109,13 +155,20 @@ class SingleAgentEnvRunner:
             self._obs = next_obs
 
         # bootstrap values for the still-running episodes
-        _, last_values = self._infer_fn(self.params, self._obs)
+        final_obs = self._obs if self.env_to_module is None else self.env_to_module(self._obs)
+        _, last_values = self._infer_fn(self.params, final_obs)
         last_values = np.asarray(last_values, np.float32)
 
         # [T, N, ...] -> per-env episode fragments -> flat batch
+        # (autoreset rows dropped: their obs is the previous episode's
+        # terminal frame and the env ignored the recorded action)
+        valid = np.stack(valid_rows)  # [T, N]
         batches = []
         for i in range(self.num_envs):
-            env_batch = SampleBatch({k: np.stack([row[i] for row in v]) for k, v in cols.items()})
+            vi = valid[:, i]
+            env_batch = SampleBatch(
+                {k: np.stack([row[i] for row in v])[vi] for k, v in cols.items()}
+            )
             if self.compute_advantages:
                 for frag in env_batch.split_by_episode():
                     terminated_end = bool(frag[TERMINATEDS][-1])

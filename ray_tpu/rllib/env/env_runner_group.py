@@ -26,11 +26,18 @@ class EnvRunnerGroup:
         num_cpus_per_runner: float = 1,
         restart_failed: bool = True,
         seed: int = 0,
+        inference_backend: str = "cpu",
+        env_to_module=None,
+        module_to_env=None,
+        mask_autoreset: bool = True,
     ):
         import ray_tpu
 
         self._ray = ray_tpu
         self._make_runner_args = dict(
+            env_to_module=env_to_module,
+            module_to_env=module_to_env,
+            mask_autoreset=mask_autoreset,
             env_creator=env_creator,
             module_spec=module_spec,
             num_envs=num_envs_per_runner,
@@ -39,6 +46,7 @@ class EnvRunnerGroup:
             lambda_=lambda_,
             compute_advantages=compute_advantages,
             seed=seed,
+            inference_backend=inference_backend,
         )
         self.restart_failed = restart_failed
         self._remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_runner, max_restarts=3)(
