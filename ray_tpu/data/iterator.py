@@ -205,7 +205,16 @@ class DataIterator:
                 if dtypes and k in dtypes:
                     v = v.astype(dtypes[k])
                 if sharding is not None:
-                    out[k] = jax.device_put(v, sharding)
+                    if not sharding.is_fully_addressable:
+                        # Multi-host SPMD: this process holds only ITS
+                        # rows (one streaming_split shard per rank); the
+                        # global batch is assembled across processes —
+                        # the device_put path would reject a sharding
+                        # spanning non-addressable devices (reference:
+                        # train/data ingest shards per worker rank).
+                        out[k] = jax.make_array_from_process_local_data(sharding, v)
+                    else:
+                        out[k] = jax.device_put(v, sharding)
                 elif device is not None:
                     out[k] = jax.device_put(v, device)
                 else:

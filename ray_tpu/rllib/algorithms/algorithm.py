@@ -46,6 +46,11 @@ class AlgorithmConfig:
         # Policy-inference device for env runners ("cpu" keeps per-step
         # calls off the learner's chip; "" follows the JAX default).
         self.inference_backend = "cpu"
+        # Connector pipelines applied in every env runner (reference:
+        # config.env_runners(env_to_module_connector=...)).  Stateful
+        # connector state lives per-runner and is not checkpointed.
+        self.env_to_module = None
+        self.module_to_env = None
         # training
         self.gamma = 0.99
         self.lr = 5e-5
@@ -76,9 +81,14 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None, num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None, num_cpus_per_env_runner: Optional[float] = None,
-                    restart_failed_env_runners: Optional[bool] = None, inference_backend: Optional[str] = None):
+                    restart_failed_env_runners: Optional[bool] = None, inference_backend: Optional[str] = None,
+                    env_to_module=None, module_to_env=None):
         if inference_backend is not None:
             self.inference_backend = inference_backend
+        if env_to_module is not None:
+            self.env_to_module = env_to_module
+        if module_to_env is not None:
+            self.module_to_env = module_to_env
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
@@ -207,6 +217,8 @@ class Algorithm(Trainable):
             seed=cfg.seed,
             inference_backend=cfg.inference_backend,
             mask_autoreset=type(self).mask_autoreset_rows,
+            env_to_module=cfg.env_to_module,
+            module_to_env=cfg.module_to_env,
         )
         self.learner_group = LearnerGroup(
             type(self).learner_class,
@@ -316,10 +328,18 @@ class Algorithm(Trainable):
             learner_state = {pid: lg.get_state() for pid, lg in self.learner_groups.items()}
         else:
             learner_state = self.learner_group.get_state()
+        import cloudpickle
+
         state = {
             "learner": learner_state,
             "timesteps_total": self._timesteps_total,
             "config": self.algo_config.to_dict(),
+            # to_dict strips callables (env_creator, policy_mapping_fn) —
+            # without them a restored multi-agent config cannot rebuild
+            # its runners; the cloudpickled config object is the source
+            # of truth for from_checkpoint (reference: rllib checkpoints
+            # cloudpickle the whole AlgorithmConfig).
+            "config_blob": cloudpickle.dumps(self.algo_config),
         }
         with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
             pickle.dump(state, f)
@@ -342,7 +362,13 @@ class Algorithm(Trainable):
     def from_checkpoint(cls, checkpoint_dir: str) -> "Algorithm":
         with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
             state = pickle.load(f)
-        cfg = cls.config_class().update_from_dict(state["config"])
+        blob = state.get("config_blob")
+        if blob is not None:
+            import cloudpickle
+
+            cfg = cloudpickle.loads(blob)
+        else:
+            cfg = cls.config_class().update_from_dict(state["config"])
         algo = cls(cfg)
         algo.load_checkpoint(checkpoint_dir)
         return algo

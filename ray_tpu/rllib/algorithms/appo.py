@@ -62,7 +62,9 @@ class APPOLearner(IMPALALearner):
         gamma = cfg.get("gamma", 0.99)
         clip = cfg.get("clip_param", 0.4)
         logp, entropy, values = self.module.forward_train(params, batch[OBS], batch[ACTIONS])
-        discounts = gamma * (1.0 - batch[TERMINATEDS].astype(jnp.float32))
+        from ray_tpu.rllib.algorithms.impala import vtrace_discounts_and_mask
+
+        discounts, mask = vtrace_discounts_and_mask(batch, gamma)
         # Two-policy decomposition (reference appo_torch_learner):
         # V-trace corrects behaviour→TARGET staleness (its clipped-rho is
         # already inside pg_adv); the PPO clip then anchors on the slowly
@@ -78,9 +80,10 @@ class APPOLearner(IMPALALearner):
         surrogate = jnp.minimum(
             ratio * pg_adv, jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv
         )
-        pi_loss = -surrogate.mean()
-        vf_loss = 0.5 * jnp.square(values - vs).mean()
-        ent = entropy.mean()
+        denom = mask.sum() + 1e-8
+        pi_loss = -(surrogate * mask).sum() / denom
+        vf_loss = 0.5 * (jnp.square(values - vs) * mask).sum() / denom
+        ent = (entropy * mask).sum() / denom
         total = (
             pi_loss
             + cfg.get("vf_loss_coeff", 0.5) * vf_loss
@@ -93,7 +96,7 @@ class APPOLearner(IMPALALearner):
             "mean_rho": rhos.mean(),
         }
         if cfg.get("use_kl_loss"):
-            kl = (target_logp - logp).mean()
+            kl = ((target_logp - logp) * mask).sum() / denom
             total = total + cfg.get("kl_coeff", 1.0) * kl
             metrics["mean_kl"] = kl
         return total, metrics

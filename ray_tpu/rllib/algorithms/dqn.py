@@ -250,6 +250,9 @@ class DQN(Algorithm):
             state = pickle.load(f)
         self.learner.set_state(state["learner"])
         self._timesteps_total = state.get("timesteps_total", 0)
+        # The epsilon schedule anneals on the collector's step counter:
+        # resume it or a restored run explores at epsilon_start again.
+        self.sampler._collector.t = self._timesteps_total
 
     def cleanup(self):
         self.sampler.envs.close()

@@ -51,6 +51,27 @@ class IMPALAConfig(AlgorithmConfig):
         return IMPALA
 
 
+def vtrace_discounts_and_mask(batch, gamma: float):
+    """Per-row discounts + loss mask for a flat time-sequence batch.
+
+    Both terminations AND truncations cut the bootstrap chain — the
+    reference bootstraps truncations with the final obs's value, but in
+    the flat fixed-shape layout cutting (slightly pessimistic at time
+    limits) is what keeps garbage from crossing episode boundaries.
+    Rows kept only for shape stability (autoreset rows, LOSS_MASK=0)
+    contribute nothing to the losses."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.utils.sample_batch import LOSS_MASK, TRUNCATEDS as _TR
+
+    done = batch[TERMINATEDS].astype(jnp.float32)
+    if _TR in batch:
+        done = jnp.maximum(done, batch[_TR].astype(jnp.float32))
+    discounts = gamma * (1.0 - done)
+    mask = batch[LOSS_MASK] if LOSS_MASK in batch else jnp.ones_like(discounts)
+    return discounts, mask
+
+
 def vtrace_returns(logp, behaviour_logp, values, rewards, discounts,
                    rho_clip: float, c_clip: float):
     """V-trace targets (Espeholt et al. 2018, eqs. 1-2), fully in-jit
@@ -90,14 +111,15 @@ class IMPALALearner(Learner):
         cfg = self.config
         gamma = cfg.get("gamma", 0.99)
         logp, entropy, values = self.module.forward_train(params, batch[OBS], batch[ACTIONS])
-        discounts = gamma * (1.0 - batch[TERMINATEDS].astype(jnp.float32))
+        discounts, mask = vtrace_discounts_and_mask(batch, gamma)
         vs, pg_adv, rhos = vtrace_returns(
             logp, batch[LOGP], values, batch[REWARDS], discounts,
             cfg.get("vtrace_clip_rho", 1.0), cfg.get("vtrace_clip_c", 1.0),
         )
-        pi_loss = -(logp * pg_adv).mean()
-        vf_loss = 0.5 * jnp.square(values - vs).mean()
-        ent = entropy.mean()
+        denom = mask.sum() + 1e-8
+        pi_loss = -((logp * pg_adv) * mask).sum() / denom
+        vf_loss = 0.5 * (jnp.square(values - vs) * mask).sum() / denom
+        ent = (entropy * mask).sum() / denom
         total = pi_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss - cfg.get("entropy_coeff", 0.01) * ent
         return total, {"policy_loss": pi_loss, "vf_loss": vf_loss, "entropy": ent, "mean_rho": rhos.mean()}
 

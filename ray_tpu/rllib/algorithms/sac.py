@@ -370,6 +370,8 @@ class SAC(Algorithm):
             state = pickle.load(f)
         self.learner.set_state(state["learner"])
         self._timesteps_total = state.get("timesteps_total", 0)
+        # resume the warmup/exploration counter with the run
+        self.sampler._collector.t = self._timesteps_total
 
     def get_policy_weights(self):
         return self.learner.get_state()["pi"]

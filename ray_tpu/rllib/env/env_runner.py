@@ -136,10 +136,8 @@ class SingleAgentEnvRunner:
             cols[LOGP].append(np.asarray(logp, np.float32))
             cols[VF_PREDS].append(np.asarray(value, np.float32))
             cols[EPS_ID].append(self._eps_id.copy())
-            keep = ~self._prev_done if self.mask_autoreset else np.ones(self.num_envs, bool)
+            keep = ~self._prev_done
             valid_rows.append(keep)
-            if not self.mask_autoreset:
-                keep = ~self._prev_done  # bookkeeping still skips reset rows
             # episode bookkeeping (reset rows carry no reward/length)
             self._episode_returns[keep] += rewards[keep]
             self._episode_lens[keep] += 1
@@ -165,10 +163,20 @@ class SingleAgentEnvRunner:
         valid = np.stack(valid_rows)  # [T, N]
         batches = []
         for i in range(self.num_envs):
-            vi = valid[:, i]
-            env_batch = SampleBatch(
-                {k: np.stack([row[i] for row in v])[vi] for k, v in cols.items()}
-            )
+            if self.mask_autoreset:
+                vi = valid[:, i]
+                env_batch = SampleBatch(
+                    {k: np.stack([row[i] for row in v])[vi] for k, v in cols.items()}
+                )
+            else:
+                # fixed-shape consumer (V-trace): keep every row, mark
+                # the autoreset garbage for the loss to exclude
+                env_batch = SampleBatch(
+                    {k: np.stack([row[i] for row in v]) for k, v in cols.items()}
+                )
+                from ray_tpu.rllib.utils.sample_batch import LOSS_MASK
+
+                env_batch[LOSS_MASK] = valid[:, i].astype(np.float32)
             if self.compute_advantages:
                 for frag in env_batch.split_by_episode():
                     terminated_end = bool(frag[TERMINATEDS][-1])

@@ -42,7 +42,12 @@ class VectorEnvCollector:
 
     def collect(self, num_steps: int, action_fn: Callable[[np.ndarray, int], np.ndarray]) -> SampleBatch:
         cols = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS)}
-        for _ in range(num_steps):
+        steps_left = num_steps
+        # Keep stepping until at least one VALID transition exists: a
+        # window of only masked autoreset steps (num_envs=1 right after
+        # an episode end) would otherwise produce an empty batch.
+        while steps_left > 0 or not cols[OBS]:
+            steps_left -= 1
             actions = action_fn(self._obs, self.t)
             next_obs, rewards, term, trunc, _ = self.envs.step(actions)
             keep = ~self._prev_done

@@ -19,6 +19,9 @@ VF_PREDS = "vf_preds"
 ADVANTAGES = "advantages"
 VALUE_TARGETS = "value_targets"
 EPS_ID = "eps_id"
+# 0.0 on rows kept only for shape stability (autoreset rows in V-trace
+# batches); losses must exclude them.
+LOSS_MASK = "loss_mask"
 
 
 class SampleBatch(dict):

@@ -170,7 +170,12 @@ class DataParallelTrainer(BaseTrainer):
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):
-                its = ds.streaming_split(n)
+                # equal=True: every rank sees the SAME number of rows.
+                # Rank shards drive collective train steps — one starved
+                # rank (e.g. a single-block dataset dealt whole to rank
+                # 0) deadlocks the others inside the first collective
+                # (reference: data_parallel_trainer's equal splitting).
+                its = ds.streaming_split(n, equal=True)
                 for i in range(n):
                     shards[i][name] = its[i]
             elif hasattr(ds, "split"):
