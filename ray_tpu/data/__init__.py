@@ -22,6 +22,7 @@ from ray_tpu.data.datasource import (
     Datasink,
     Datasource,
     FileBasedDatasource,
+    HuggingFaceDatasource,
     ImageDatasource,
     ItemsDatasource,
     JSONDatasource,
@@ -29,8 +30,10 @@ from ray_tpu.data.datasource import (
     ParquetDatasource,
     RangeDatasource,
     ReadTask,
+    SQLDatasource,
     TextDatasource,
     TFRecordsDatasource,
+    WebDatasetDatasource,
 )
 from ray_tpu.data.iterator import DataIterator
 
@@ -60,6 +63,9 @@ __all__ = [
     "read_images",
     "read_binary_files",
     "read_tfrecords",
+    "read_sql",
+    "from_huggingface",
+    "read_webdataset",
     "read_text",
 ]
 
@@ -149,3 +155,22 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
 
 def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(TFRecordsDatasource(paths), parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1) -> Dataset:
+    """Rows of a DBAPI-2 query (reference: read_api.py read_sql).
+    ``connection_factory()`` must return a fresh connection per call —
+    each read task opens its own."""
+    return read_datasource(SQLDatasource(sql, connection_factory), parallelism=parallelism)
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    """A `datasets.Dataset` (or streaming IterableDataset) as a Dataset
+    (reference: read_api.py from_huggingface)."""
+    return read_datasource(HuggingFaceDatasource(hf_dataset), parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    """WebDataset-style .tar sample archives: files sharing a basename
+    prefix become one row (reference: read_api.py read_webdataset)."""
+    return read_datasource(WebDatasetDatasource(paths), parallelism=parallelism)

@@ -1,0 +1,111 @@
+"""Backpressure policies + resource manager for the streaming executor
+(reference: data/_internal/execution/backpressure_policy/
+{concurrency_cap,streaming_output}_backpressure_policy.py and
+execution/resource_manager.py, compressed to the two decision points our
+scheduling loop actually has: "may this op receive another input bundle"
+and "may this op launch more tasks").
+
+Policies are consulted every scheduling tick; returning False is always
+safe (work is retried next tick), so policies compose with AND."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class BackpressurePolicy:
+    """Base policy (reference: backpressure_policy.py)."""
+
+    def __init__(self, ctx, topology):
+        self._ctx = ctx
+        self._topology = topology
+
+    def can_add_input(self, op) -> bool:
+        """May the scheduling loop route another bundle INTO `op`?"""
+        return True
+
+    def can_run_tasks(self, op) -> bool:
+        """May `op` launch more tasks this tick?"""
+        return True
+
+
+class ConcurrencyCapBackpressurePolicy(BackpressurePolicy):
+    """Per-operator in-flight task cap (reference:
+    concurrency_cap_backpressure_policy.py)."""
+
+    def can_run_tasks(self, op) -> bool:
+        return op.num_active_tasks() < self._ctx.max_in_flight_tasks_per_op
+
+
+class StreamingOutputBackpressurePolicy(BackpressurePolicy):
+    """Bound each task-running operator's input inventory (pending
+    bundles + reorder buffer) so fast producers can't flood a slow
+    consumer (reference: streaming_output_backpressure_policy.py)."""
+
+    def can_add_input(self, op) -> bool:
+        if op.num_active_tasks() == 0 and op.internal_queue_size() == 0:
+            return True  # idle op always accepts (forward progress)
+        return op.internal_queue_size() < self._ctx.op_output_queue_max_blocks
+
+
+class ObjectStoreMemoryBackpressurePolicy(BackpressurePolicy):
+    """Global cap on bytes parked in operator queues (reference:
+    resource_manager.py object-store budget accounting).  When the
+    outstanding inventory exceeds the budget, task launches pause until
+    consumers drain it."""
+
+    def __init__(self, ctx, topology):
+        super().__init__(ctx, topology)
+        self._manager = ResourceManager(topology)
+
+    def can_run_tasks(self, op) -> bool:
+        budget = self._ctx.streaming_memory_budget_bytes
+        if budget is None:
+            return True
+        if self._manager.outstanding_bytes() < budget:
+            return True
+        # Over budget: every op still gets ONE task at a time if it has
+        # parked inputs — consuming pending inventory is the only way
+        # the inventory ever drains, so a hard stop would deadlock on
+        # the very bytes it is trying to shed (reference: resource
+        # manager's reserved minimum per op).
+        return op.num_active_tasks() == 0 and op.internal_queue_size() > 0
+
+
+class ResourceManager:
+    """Tracks the streaming topology's outstanding object inventory
+    (reference: execution/resource_manager.py, reduced to the byte
+    accounting the policies consume)."""
+
+    def __init__(self, topology):
+        self._topology = topology
+
+    def outstanding_bytes(self) -> int:
+        total = 0
+        for op in self._topology.ops:
+            for bundle in op._output_queue:
+                total += bundle.metadata.size_bytes or 0
+            reorder = getattr(op, "_reorder", None)
+            if reorder:
+                for bundle in reorder.values():
+                    total += bundle.metadata.size_bytes or 0
+            # bundles routed into a consumer but not yet picked up by a
+            # task are still parked inventory — without this, every
+            # block escapes the budget the instant routing moves it
+            for bundle in getattr(op, "_pending_inputs", ()):
+                total += bundle.metadata.size_bytes or 0
+        return total
+
+    def outstanding_blocks(self) -> int:
+        return sum(
+            len(op._output_queue) + len(getattr(op, "_reorder", ()) or ())
+            for op in self._topology.ops
+        )
+
+
+# The executor's fallback when DataContext.backpressure_policies is empty.
+DEFAULT_BACKPRESSURE_POLICIES = (
+    ConcurrencyCapBackpressurePolicy,
+    StreamingOutputBackpressurePolicy,
+    ObjectStoreMemoryBackpressurePolicy,
+)

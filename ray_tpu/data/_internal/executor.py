@@ -239,6 +239,13 @@ class PhysicalOperator:
     def dispatch(self, ctx: DataContext) -> None:
         pass
 
+    def poll(self) -> None:
+        """Ungated per-tick progress work (consume stream items, reap
+        finished state).  Unlike dispatch(), this MUST run even when
+        backpressure policies refuse new task launches — otherwise an
+        op at its concurrency cap can never observe its own completions
+        (launch gating must not stall progress observation)."""
+
     def completed(self) -> bool:
         return (
             self.all_inputs_done()
@@ -370,9 +377,9 @@ class StreamingReadOperator(PhysicalOperator):
             bundle = self._pending_inputs.pop(0)
             self._tasks[self._task_idx] = self._TaskState(self._submit(bundle))
             self._task_idx += 1
-        self._poll()
+        self.poll()
 
-    def _poll(self) -> None:
+    def poll(self) -> None:
         from ray_tpu import exceptions
 
         for st in self._tasks.values():
@@ -561,6 +568,128 @@ class UnionOperator(PhysicalOperator):
         return sum(len(b) for b in self._buffers)
 
 
+class PushBasedShuffleOperator(PhysicalOperator):
+    """Pipelined shuffle (reference: planner/exchange/
+    push_based_shuffle_task_scheduler.py:400 — the map/merge overlap).
+
+    Each arriving map block is split into n partitions IMMEDIATELY; each
+    partition's pieces are pre-merged whenever merge_factor of them
+    accumulate, so merge work overlaps the still-running reads/maps
+    instead of waiting for a global barrier, and the unmerged-piece
+    inventory stays bounded by ~merge_factor pieces per partition rather
+    than map_blocks × n for the whole dataset.  The final per-partition
+    merge applies the row shuffle."""
+
+    def __init__(self, name: str, input_op: PhysicalOperator, n_outputs: int,
+                 seed: Optional[int] = None, merge_factor: int = 8):
+        super().__init__(name, [input_op])
+        self._n = max(1, n_outputs)
+        self._seed = seed
+        self._merge_factor = max(2, merge_factor)
+        self._pending_inputs: List[RefBundle] = []
+        self._split_idx = 0
+        # waitable ref (first split return) -> list of n split refs
+        self._splits_active: Dict[Any, List[Any]] = {}
+        # partition -> accumulated piece refs awaiting (pre-)merge
+        self._pieces: List[List[Any]] = [[] for _ in range(self._n)]
+        # meta_ref -> (block_ref, partition, final?)
+        self._merges_active: Dict[Any, Tuple[Any, int, bool]] = {}
+        self._finalized = [False] * self._n
+        # observability (asserted by tests): pipelining + memory bound
+        self.merges_started_before_input_done = 0
+        self.max_outstanding_pieces = 0
+
+    def add_input(self, bundle: RefBundle, input_index: int) -> None:
+        self._pending_inputs.append(bundle)
+
+    def dispatch(self, ctx: DataContext) -> None:
+        # 1) split arriving blocks (bounded in-flight)
+        while (
+            self._pending_inputs
+            and len(self._splits_active) + len(self._merges_active)
+            < ctx.max_in_flight_tasks_per_op
+        ):
+            bundle = self._pending_inputs.pop(0)
+            seed = None if self._seed is None else self._seed + self._split_idx
+            self._split_idx += 1
+            out = _submit(_split_task, bundle.block_ref, self._n, seed,
+                          num_returns=self._n, name="shuffle_split")
+            refs = out if isinstance(out, list) else [out]
+            self._splits_active[refs[0]] = refs
+        # 2) pre-merge partitions whose piece count reached merge_factor
+        for j in range(self._n):
+            while (
+                len(self._pieces[j]) >= self._merge_factor
+                and len(self._splits_active) + len(self._merges_active)
+                < ctx.max_in_flight_tasks_per_op + self._n  # merges may exceed
+            ):
+                parts, self._pieces[j] = (
+                    self._pieces[j][: self._merge_factor],
+                    self._pieces[j][self._merge_factor:],
+                )
+                self._start_merge(j, parts, final=False)
+                if not self.all_inputs_done():
+                    self.merges_started_before_input_done += 1
+        # 3) final merges once everything upstream landed
+        if self.all_inputs_done() and not self._pending_inputs and not self._splits_active:
+            for j in range(self._n):
+                if self._finalized[j]:
+                    continue
+                # wait for this partition's pre-merges to drain first
+                if any(p == j and not fin for _, p, fin in self._merges_active.values()):
+                    continue
+                self._finalized[j] = True
+                if self._pieces[j]:  # empty partition: nothing to emit
+                    self._start_merge(j, self._pieces[j], final=True)
+                    self._pieces[j] = []
+
+    def _start_merge(self, partition: int, parts: List[Any], final: bool) -> None:
+        seed = None
+        if final and self._seed is not None:
+            seed = self._seed * 7919 + partition
+        merge = ray_tpu.remote(_merge_task).options(num_returns=2, name="shuffle_merge")
+        block_ref, meta_ref = merge.remote(*parts, seed=seed)
+        self._merges_active[meta_ref] = (block_ref, partition, final)
+
+    def num_active_tasks(self) -> int:
+        return len(self._splits_active) + len(self._merges_active)
+
+    def waitable_refs(self) -> List[Any]:
+        return list(self._splits_active.keys()) + list(self._merges_active.keys())
+
+    def process_ready(self, ready_refs: set) -> None:
+        for ref in [r for r in self._splits_active if r in ready_refs]:
+            refs = self._splits_active.pop(ref)
+            for j, piece in enumerate(refs):
+                self._pieces[j].append(piece)
+        outstanding = sum(len(p) for p in self._pieces)
+        self.max_outstanding_pieces = max(self.max_outstanding_pieces, outstanding)
+        for meta_ref in [r for r in self._merges_active if r in ready_refs]:
+            block_ref, j, final = self._merges_active.pop(meta_ref)
+            if final:
+                meta = ray_tpu.get(meta_ref)
+                if meta.num_rows:
+                    self._output_queue.append(RefBundle(block_ref, meta))
+            else:
+                self._pieces[j].append(block_ref)
+
+    def completed(self) -> bool:
+        return (
+            self.all_inputs_done()
+            and not self._pending_inputs
+            and self.num_active_tasks() == 0
+            and all(self._finalized)
+            and not self._output_queue
+        )
+
+    def internal_queue_size(self) -> int:
+        # Pending inputs only: unmerged pieces are self-bounded (each
+        # partition pre-merges at merge_factor), and counting them here
+        # would trip upstream routing backpressure permanently before
+        # any partition could reach its merge threshold.
+        return len(self._pending_inputs)
+
+
 class AllToAllOperator(PhysicalOperator):
     """Barrier op: buffers every input bundle, then runs bulk_fn once.
 
@@ -615,6 +744,12 @@ def execute_streaming(
     available (reference: StreamingExecutor._scheduling_loop_step)."""
     ctx = ctx or DataContext.get_current()
     topo = Topology(sink)
+    from ray_tpu.data._internal.backpressure_policy import (
+        DEFAULT_BACKPRESSURE_POLICIES,
+    )
+
+    policy_classes = ctx.backpressure_policies or DEFAULT_BACKPRESSURE_POLICIES
+    policies = [cls(ctx, topo) for cls in policy_classes]
     for op in topo.ops:
         op.start(ctx)
 
@@ -637,14 +772,16 @@ def execute_streaming(
                         yield op.get_next()
                     continue
                 while op.has_next():
-                    # Backpressure: stop routing if every consumer is full.
-                    if all(
-                        c.internal_queue_size() >= ctx.op_output_queue_max_blocks
-                        for c, _ in outs
-                        if isinstance(c, (TaskPoolMapOperator, ActorPoolMapOperator))
-                    ) and any(
-                        isinstance(c, (TaskPoolMapOperator, ActorPoolMapOperator))
-                        for c, _ in outs
+                    # Backpressure: stop routing when every task-running
+                    # consumer refuses input (policy layer).
+                    _bp_types = (
+                        TaskPoolMapOperator,
+                        ActorPoolMapOperator,
+                        PushBasedShuffleOperator,
+                    )
+                    gated = [c for c, _ in outs if isinstance(c, _bp_types)]
+                    if gated and all(
+                        not all(p.can_add_input(c) for p in policies) for c in gated
                     ):
                         break
                     bundle = op.get_next()
@@ -658,10 +795,13 @@ def execute_streaming(
                     for consumer, idx in outs:
                         consumer.input_done(idx)
 
-            # 2) Dispatch new work.
+            # 2) Dispatch new work (policy-gated); progress polling is
+            # NEVER gated (see PhysicalOperator.poll).
             for op in topo.ops:
                 before = op.num_active_tasks()
-                op.dispatch(ctx)
+                op.poll()
+                if all(p.can_run_tasks(op) for p in policies):
+                    op.dispatch(ctx)
                 if op.num_active_tasks() != before or op.has_next():
                     progressed = True
 

@@ -347,6 +347,16 @@ class Planner:
             upstream = self._lower(op.inputs[0])
             seed = op.seed if op.seed is not None else 0
             num_outputs = op.num_outputs
+            if self._ctx.shuffle_strategy == "push":
+                from ray_tpu.data._internal.executor import PushBasedShuffleOperator
+
+                return PushBasedShuffleOperator(
+                    "RandomShuffle[push]",
+                    upstream,
+                    num_outputs or self._ctx.default_shuffle_output_blocks,
+                    seed=seed,
+                    merge_factor=self._ctx.push_shuffle_merge_factor,
+                )
 
             def bulk(buffers):
                 n = num_outputs or max(1, len(buffers[0]))

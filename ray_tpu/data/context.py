@@ -32,8 +32,15 @@ class DataContext:
     op_output_queue_max_blocks: int = 16
     # Resource request attached to each data task.
     task_num_cpus: float = 1.0
-    # Shuffle strategy: "pull" (1-stage) or "push" (2-stage).
-    shuffle_strategy: str = "pull"
+    # Shuffle strategy: "push" (pipelined map/merge overlap, the
+    # default — reference push_based_shuffle_task_scheduler) or "pull"
+    # (barrier two-stage bulk exchange).
+    shuffle_strategy: str = "push"
+    # Pieces per partition accumulated before an incremental pre-merge
+    # fires (bounds the push shuffle's unmerged inventory).
+    push_shuffle_merge_factor: int = 8
+    # Output partition count for push shuffles when the user gave none.
+    default_shuffle_output_blocks: int = 16
     # Reads run as streaming-generator tasks: each file/row-group block
     # flows downstream the moment it is read (num_returns="streaming").
     streaming_read_enabled: bool = True
@@ -43,6 +50,11 @@ class DataContext:
     scheduling_strategy: Optional[str] = None
     # Verbose progress logging from the streaming executor.
     verbose_progress: bool = False
+    # Global cap on bytes parked in operator queues (None = unlimited);
+    # enforced by ObjectStoreMemoryBackpressurePolicy.
+    streaming_memory_budget_bytes: Optional[int] = None
+    # Backpressure policy classes consulted by the streaming executor.
+    backpressure_policies: tuple = ()
     execution_options: dict = field(default_factory=dict)
 
     _current = None
