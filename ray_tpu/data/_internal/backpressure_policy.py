@@ -10,7 +10,7 @@ safe (work is retried next tick), so policies compose with AND."""
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 
 class BackpressurePolicy:
@@ -75,12 +75,23 @@ class ObjectStoreMemoryBackpressurePolicy(BackpressurePolicy):
 class ResourceManager:
     """Tracks the streaming topology's outstanding object inventory
     (reference: execution/resource_manager.py, reduced to the byte
-    accounting the policies consume)."""
+    accounting the policies consume).  The walk is memoized for a short
+    window: the policy queries it once per OPERATOR per tick, and an
+    O(ops × bundles) walk per query would make the scheduler tick
+    itself the bottleneck on deep pipelines."""
+
+    MEMO_S = 0.05
 
     def __init__(self, topology):
         self._topology = topology
+        self._memo: Tuple[float, int] = (-1.0, 0)
 
     def outstanding_bytes(self) -> int:
+        import time
+
+        now = time.monotonic()
+        if now - self._memo[0] < self.MEMO_S:
+            return self._memo[1]
         total = 0
         for op in self._topology.ops:
             for bundle in op._output_queue:
@@ -94,13 +105,8 @@ class ResourceManager:
             # block escapes the budget the instant routing moves it
             for bundle in getattr(op, "_pending_inputs", ()):
                 total += bundle.metadata.size_bytes or 0
+        self._memo = (now, total)
         return total
-
-    def outstanding_blocks(self) -> int:
-        return sum(
-            len(op._output_queue) + len(getattr(op, "_reorder", ()) or ())
-            for op in self._topology.ops
-        )
 
 
 # The executor's fallback when DataContext.backpressure_policies is empty.

@@ -588,12 +588,19 @@ class PushBasedShuffleOperator(PhysicalOperator):
         self._merge_factor = max(2, merge_factor)
         self._pending_inputs: List[RefBundle] = []
         self._split_idx = 0
-        # waitable ref (first split return) -> list of n split refs
-        self._splits_active: Dict[Any, List[Any]] = {}
-        # partition -> accumulated piece refs awaiting (pre-)merge
-        self._pieces: List[List[Any]] = [[] for _ in range(self._n)]
-        # meta_ref -> (block_ref, partition, final?)
-        self._merges_active: Dict[Any, Tuple[Any, int, bool]] = {}
+        # waitable ref (first split return) -> (split_seq, n split refs)
+        self._splits_active: Dict[Any, Tuple[int, List[Any]]] = {}
+        # Determinism: pieces are keyed by their source split's sequence
+        # number and only CONTIGUOUS seq runs pre-merge, so the final
+        # concatenation order per partition is the input order no matter
+        # how task completions interleave — a seeded shuffle reproduces
+        # bit-for-bit across runs (the barrier implementation's
+        # guarantee, kept under pipelining).
+        self._pieces: List[Dict[int, Any]] = [dict() for _ in range(self._n)]
+        self._merged: List[List[Tuple[int, Any]]] = [[] for _ in range(self._n)]
+        self._next_seq = [0] * self._n
+        # meta_ref -> (block_ref, partition, final?, start_seq)
+        self._merges_active: Dict[Any, Tuple[Any, int, bool, int]] = {}
         self._finalized = [False] * self._n
         # observability (asserted by tests): pipelining + memory bound
         self.merges_started_before_input_done = 0
@@ -611,23 +618,29 @@ class PushBasedShuffleOperator(PhysicalOperator):
         ):
             bundle = self._pending_inputs.pop(0)
             seed = None if self._seed is None else self._seed + self._split_idx
-            self._split_idx += 1
             out = _submit(_split_task, bundle.block_ref, self._n, seed,
                           num_returns=self._n, name="shuffle_split")
             refs = out if isinstance(out, list) else [out]
-            self._splits_active[refs[0]] = refs
-        # 2) pre-merge partitions whose piece count reached merge_factor
+            self._splits_active[refs[0]] = (self._split_idx, refs)
+            self._split_idx += 1
+        # 2) pre-merge contiguous seq runs that reached merge_factor
         for j in range(self._n):
             while (
-                len(self._pieces[j]) >= self._merge_factor
-                and len(self._splits_active) + len(self._merges_active)
+                len(self._splits_active) + len(self._merges_active)
                 < ctx.max_in_flight_tasks_per_op + self._n  # merges may exceed
             ):
-                parts, self._pieces[j] = (
-                    self._pieces[j][: self._merge_factor],
-                    self._pieces[j][self._merge_factor:],
-                )
-                self._start_merge(j, parts, final=False)
+                start = self._next_seq[j]
+                run = []
+                while start + len(run) in self._pieces[j]:
+                    run.append(self._pieces[j][start + len(run)])
+                    if len(run) == self._merge_factor:
+                        break
+                if len(run) < self._merge_factor:
+                    break
+                for s in range(start, start + len(run)):
+                    del self._pieces[j][s]
+                self._next_seq[j] = start + len(run)
+                self._start_merge(j, run, final=False, start_seq=start)
                 if not self.all_inputs_done():
                     self.merges_started_before_input_done += 1
         # 3) final merges once everything upstream landed
@@ -636,20 +649,25 @@ class PushBasedShuffleOperator(PhysicalOperator):
                 if self._finalized[j]:
                     continue
                 # wait for this partition's pre-merges to drain first
-                if any(p == j and not fin for _, p, fin in self._merges_active.values()):
+                if any(p == j and not fin for _, p, fin, _s in self._merges_active.values()):
                     continue
                 self._finalized[j] = True
-                if self._pieces[j]:  # empty partition: nothing to emit
-                    self._start_merge(j, self._pieces[j], final=True)
-                    self._pieces[j] = []
+                # pre-merged runs in seq order, then leftover pieces
+                parts = [ref for _s, ref in sorted(self._merged[j])]
+                parts += [self._pieces[j][s] for s in sorted(self._pieces[j])]
+                self._merged[j] = []
+                self._pieces[j] = {}
+                if parts:  # empty partition: nothing to emit
+                    self._start_merge(j, parts, final=True, start_seq=0)
 
-    def _start_merge(self, partition: int, parts: List[Any], final: bool) -> None:
+    def _start_merge(self, partition: int, parts: List[Any], final: bool,
+                     start_seq: int) -> None:
         seed = None
         if final and self._seed is not None:
             seed = self._seed * 7919 + partition
         merge = ray_tpu.remote(_merge_task).options(num_returns=2, name="shuffle_merge")
         block_ref, meta_ref = merge.remote(*parts, seed=seed)
-        self._merges_active[meta_ref] = (block_ref, partition, final)
+        self._merges_active[meta_ref] = (block_ref, partition, final, start_seq)
 
     def num_active_tasks(self) -> int:
         return len(self._splits_active) + len(self._merges_active)
@@ -659,19 +677,19 @@ class PushBasedShuffleOperator(PhysicalOperator):
 
     def process_ready(self, ready_refs: set) -> None:
         for ref in [r for r in self._splits_active if r in ready_refs]:
-            refs = self._splits_active.pop(ref)
+            seq, refs = self._splits_active.pop(ref)
             for j, piece in enumerate(refs):
-                self._pieces[j].append(piece)
+                self._pieces[j][seq] = piece
         outstanding = sum(len(p) for p in self._pieces)
         self.max_outstanding_pieces = max(self.max_outstanding_pieces, outstanding)
         for meta_ref in [r for r in self._merges_active if r in ready_refs]:
-            block_ref, j, final = self._merges_active.pop(meta_ref)
+            block_ref, j, final, start_seq = self._merges_active.pop(meta_ref)
             if final:
                 meta = ray_tpu.get(meta_ref)
                 if meta.num_rows:
                     self._output_queue.append(RefBundle(block_ref, meta))
             else:
-                self._pieces[j].append(block_ref)
+                self._merged[j].append((start_seq, block_ref))
 
     def completed(self) -> bool:
         return (

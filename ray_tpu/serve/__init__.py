@@ -19,8 +19,11 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
+    "multiplexed",
+    "get_multiplexed_model_id",
     "deployment",
     "Deployment",
     "Application",

@@ -11,12 +11,21 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.serve._private.long_poll import LongPollHost
+
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+# long-poll keys (reference: long_poll.py LongPollNamespace)
+LP_ROUTE_TABLE = "route_table"
 
-class ServeController:
+
+def lp_replicas_key(deployment: str) -> str:
+    return f"replicas::{deployment}"
+
+
+class ServeController(LongPollHost):
     def __init__(self):
         import ray_tpu
 
@@ -26,6 +35,9 @@ class ServeController:
         self._stopped = False
         self._last_scale_action: Dict[str, float] = {}
         self._load_history: Dict[str, List[float]] = {}
+        # replica-set snapshot per deployment, pushed to long-poll
+        # listeners whenever membership changes
+        self._last_pushed: Dict[str, Any] = {}
 
     async def _ensure_loop(self):
         if self._loop_task is None:
@@ -47,6 +59,7 @@ class ServeController:
             # mark old-version replicas for replacement (rolling)
             for r in self.deployments[name]["replicas"]:
                 r["stale"] = True
+        self._push_route_table()
         await self._reconcile_once()
         return True
 
@@ -55,6 +68,8 @@ class ServeController:
         if dep:
             for r in dep["replicas"]:
                 self._stop_replica(r)
+        self._push_route_table()
+        self.notify_changed(lp_replicas_key(name), [])
         return True
 
     async def get_replicas(self, name: str) -> List[dict]:
@@ -139,6 +154,25 @@ class ServeController:
                         r["state"] = "RUNNING"
                     except Exception:
                         r["state"] = "DEAD"
+        # push replica-set changes to long-poll listeners (routers)
+        for name, dep in self.deployments.items():
+            snapshot = [
+                {"replica_id": r["replica_id"], "actor_name": r["actor_name"]}
+                for r in dep["replicas"]
+                if r["state"] == "RUNNING" and not r.get("stale")
+            ]
+            if self._last_pushed.get(name) != snapshot:
+                self._last_pushed[name] = snapshot
+                self.notify_changed(lp_replicas_key(name), snapshot)
+
+    def _push_route_table(self):
+        self.notify_changed(
+            LP_ROUTE_TABLE,
+            {
+                (dep["config"].get("route_prefix") or f"/{name}"): name
+                for name, dep in self.deployments.items()
+            },
+        )
 
     def _start_replica(self, name: str, cfg: dict, init) -> dict:
         from ray_tpu.serve._private.replica import Replica

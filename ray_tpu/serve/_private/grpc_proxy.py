@@ -1,0 +1,130 @@
+"""gRPC proxy actor (reference: serve/_private/proxy.py:538 gRPCProxy +
+grpc_util.py).
+
+The reference generates per-application protobuf services; here a
+GENERIC handler serves any unary method of the form
+``/ray_tpu.serve.UserDefinedService/<DeploymentName>`` with raw-bytes
+request/response.  The wire contract deliberately avoids pickle — the
+reference uses protobuf precisely so the proxy never deserializes
+executable payloads from the network:
+
+- request bytes parsed as JSON ``{"args": [...], "kwargs": {...}}``
+  (or any JSON value, passed as the single positional argument);
+  non-JSON bytes pass through untouched as one positional ``bytes`` arg
+- response: ``bytes`` results pass through; anything else is
+  JSON-encoded
+
+Metadata keys: ``multiplexed_model_id`` (model routing) and ``method``
+(non-__call__ dispatch)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict
+
+logger = logging.getLogger(__name__)
+
+SERVICE_PREFIX = "/ray_tpu.serve.UserDefinedService/"
+
+
+class GrpcProxyActor:
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._handles: Dict[str, Any] = {}
+        self._started = False
+        from concurrent.futures import ThreadPoolExecutor
+
+        # same rationale as the HTTP proxy: routing may block on cold
+        # starts, so it runs in a dedicated pool
+        self._route_pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="grpc-route")
+
+    async def ready(self) -> bool:
+        if not self._started:
+            await self._start()
+            self._started = True
+        return True
+
+    async def _start(self):
+        import grpc
+
+        import ray_tpu
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+        self._controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if not method.startswith(SERVICE_PREFIX):
+                    return None
+                deployment = method[len(SERVICE_PREFIX):]
+                return grpc.unary_unary_rpc_method_handler(
+                    proxy._make_handler(deployment)
+                    # no (de)serializers: raw bytes on the wire
+                )
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        logger.info("serve gRPC proxy listening on %s:%d", self.host, self.port)
+
+    def _make_handler(self, deployment: str):
+        import json
+
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        def parse_request(request_bytes: bytes):
+            try:
+                payload = json.loads(request_bytes)
+            except Exception:
+                return (request_bytes,), {}  # opaque bytes: one positional arg
+            if (
+                isinstance(payload, dict)
+                and set(payload) <= {"args", "kwargs"}
+                and isinstance(payload.get("args", []), list)
+                and isinstance(payload.get("kwargs", {}), dict)
+            ):
+                return tuple(payload.get("args", ())), dict(payload.get("kwargs", {}))
+            return (payload,), {}
+
+        async def handler(request_bytes: bytes, context) -> bytes:
+            import grpc as _grpc
+
+            import ray_tpu
+
+            md = {k: v for k, v in (context.invocation_metadata() or ())}
+            model_id = md.get("multiplexed_model_id", "")
+            method = md.get("method", "__call__")
+            handle = self._handles.get(deployment)
+            if handle is None:
+                handle = DeploymentHandle(deployment, self._controller)
+                self._handles[deployment] = handle
+            if model_id:
+                handle = handle.options(multiplexed_model_id=model_id)
+            args, kwargs = parse_request(request_bytes)
+            loop = asyncio.get_event_loop()
+            response = None
+            try:
+                response = await loop.run_in_executor(
+                    self._route_pool,
+                    lambda: handle._call(method, args, kwargs),
+                )
+                result = await loop.run_in_executor(
+                    None, ray_tpu.get, response.object_ref
+                )
+            except Exception as e:  # noqa: BLE001 — surfaced as gRPC status
+                logger.exception("grpc proxy request failed")
+                await context.abort(_grpc.StatusCode.INTERNAL, str(e))
+                return b""
+            finally:
+                if response is not None:
+                    response._router.done(response._replica_id)
+            if isinstance(result, (bytes, bytearray)):
+                return bytes(result)
+            return json.dumps(result).encode()
+
+        return handler

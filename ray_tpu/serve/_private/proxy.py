@@ -31,9 +31,15 @@ class ProxyActor:
         from aiohttp import web
 
         import ray_tpu
-        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME, LP_ROUTE_TABLE
+        from ray_tpu.serve._private.long_poll import LongPollClient
 
         self._controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+        # route-table changes PUSH via long-poll (one RTT after deploy);
+        # the lazy refresh below remains a fallback for cold misses
+        self._long_poll = LongPollClient(
+            self._controller, {LP_ROUTE_TABLE: self._on_routes_pushed}
+        )
 
         app = web.Application()
         app.router.add_route("*", "/-/routes", self._routes_endpoint)
@@ -51,6 +57,9 @@ class ProxyActor:
             await self._start()
             self._started = True
         return True
+
+    def _on_routes_pushed(self, table):
+        self._routes = dict(table)
 
     async def _refresh_routes(self):
         import ray_tpu

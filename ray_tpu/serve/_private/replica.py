@@ -39,10 +39,15 @@ class Replica:
         if fn is not None:
             fn(user_config)
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request(
+        self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""
+    ):
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
         async with self._sem:
             self._ongoing += 1
             self._total += 1
+            _set_request_model_id(multiplexed_model_id)
             try:
                 target = self.callable if method == "__call__" else getattr(self.callable, method)
                 if method == "__call__" and not callable(target):

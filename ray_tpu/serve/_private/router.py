@@ -28,6 +28,41 @@ class Router:
         self._lock = threading.Lock()
         self._rng = random.Random()
         self._reported = 0.0
+        # multiplexing: soft model→replica affinity learned from routing
+        # decisions (reference: multiplexed model id routing)
+        self._model_locations: Dict[str, set] = {}
+        # long-poll push: replica-set changes arrive in one RTT instead
+        # of the REFRESH_S polling interval (the poll stays as fallback)
+        from ray_tpu.serve._private.controller import lp_replicas_key
+        from ray_tpu.serve._private.long_poll import LongPollClient
+
+        self._long_poll = LongPollClient(
+            controller, {lp_replicas_key(deployment_name): self._on_replicas_pushed}
+        )
+
+    def _on_replicas_pushed(self, snapshot: List[dict]):
+        """Apply a pushed replica-set snapshot."""
+        with self._lock:
+            by_id = {r["replica_id"]: r for r in self._replicas}
+        new = []
+        for rinfo in snapshot:
+            cur = by_id.get(rinfo["replica_id"])
+            if cur is not None:
+                new.append(cur)
+            else:
+                try:
+                    actor = self._ray.get_actor(rinfo["actor_name"], "serve")
+                    new.append({"replica_id": rinfo["replica_id"], "actor": actor})
+                except Exception:
+                    pass
+        live = {r["replica_id"] for r in new}
+        with self._lock:
+            self._replicas = new
+            self._last_refresh = time.monotonic()
+            for mid, rids in list(self._model_locations.items()):
+                rids &= live
+                if not rids:
+                    del self._model_locations[mid]
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -62,7 +97,7 @@ class Router:
             except Exception:
                 pass
 
-    def pick(self) -> dict:
+    def pick(self, multiplexed_model_id: str = "") -> dict:
         self._refresh()
         deadline = time.monotonic() + 30
         while not self._replicas:
@@ -70,6 +105,19 @@ class Router:
                 raise RuntimeError(f"no running replicas for deployment {self.deployment_name}")
             time.sleep(0.1)
             self._refresh(force=True)
+        if multiplexed_model_id:
+            # soft affinity: among replicas that already hold the model,
+            # pick the shortest queue; fall through when none do
+            with self._lock:
+                holders = [
+                    r
+                    for r in self._replicas
+                    if r["replica_id"] in self._model_locations.get(multiplexed_model_id, ())
+                ]
+            if holders:
+                return min(
+                    holders, key=lambda r: self._queue_estimate.get(r["replica_id"], 0)
+                )
         if len(self._replicas) == 1:
             return self._replicas[0]
         a, b = self._rng.sample(self._replicas, 2)
@@ -77,19 +125,50 @@ class Router:
         qb = self._queue_estimate.get(b["replica_id"], 0)
         return a if qa <= qb else b
 
-    def route(self, method: str, args: tuple, kwargs: dict):
+    def route(self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""):
         """Dispatch to the chosen replica; returns (ObjectRef, replica_id).
         Callers MUST call `done(replica_id)` when the response resolves so
         the in-flight estimate stays honest."""
-        r = self.pick()
+        r = self.pick(multiplexed_model_id)
         rid = r["replica_id"]
         # route()/done() run concurrently from proxy executor threads:
         # the read-modify-write must be atomic or increments get lost.
         with self._lock:
             self._queue_estimate[rid] = self._queue_estimate.get(rid, 0) + 1
-        ref = r["actor"].handle_request.remote(method, args, kwargs)
+            if multiplexed_model_id:
+                self._model_locations.setdefault(multiplexed_model_id, set()).add(rid)
+        ref = r["actor"].handle_request.remote(
+            method, args, kwargs, multiplexed_model_id
+        )
         return ref, rid
 
     def done(self, replica_id: str):
         with self._lock:
             self._queue_estimate[replica_id] = max(0, self._queue_estimate.get(replica_id, 1) - 1)
+
+    def close(self):
+        self._long_poll.stop()
+
+
+# One router (→ one long-poll thread) per deployment per process, shared
+# by every handle targeting it — per-handle routers would each hold a
+# blocking listen_for_change slot on the controller and leak a thread
+# per handle (reference: handles share the router keyed by deployment).
+_routers: Dict[str, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def get_or_create_router(controller, deployment_name: str) -> Router:
+    with _routers_lock:
+        r = _routers.get(deployment_name)
+        if r is None:
+            r = _routers[deployment_name] = Router(controller, deployment_name)
+        return r
+
+
+def shutdown_routers():
+    with _routers_lock:
+        routers = dict(_routers)
+        _routers.clear()
+    for r in routers.values():
+        r.close()

@@ -83,9 +83,9 @@ def deployment(
     return wrap
 
 
-def start(http_port: Optional[int] = None) -> Any:
+def start(http_port: Optional[int] = None, grpc_port: Optional[int] = None) -> Any:
     """Start (or connect to) the Serve controller; optionally the HTTP
-    proxy (reference: serve.start + proxy bring-up)."""
+    and/or gRPC proxies (reference: serve.start + proxy bring-up)."""
     global _started
     import ray_tpu
 
@@ -104,6 +104,8 @@ def start(http_port: Optional[int] = None) -> Any:
     _started = True
     if http_port is not None:
         _ensure_proxy(controller, http_port)
+    if grpc_port is not None:
+        _ensure_grpc_proxy(controller, grpc_port)
     return controller
 
 
@@ -122,12 +124,28 @@ def _ensure_proxy(controller, port: int):
         ray_tpu.get(proxy.ready.remote())
 
 
+def _ensure_grpc_proxy(controller, port: int):
+    import ray_tpu
+
+    from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
+
+    name = "SERVE_GRPC_PROXY"
+    try:
+        ray_tpu.get_actor(name, "serve")
+    except Exception:
+        proxy = ray_tpu.remote(
+            name=name, namespace="serve", num_cpus=0.1, max_concurrency=1000
+        )(GrpcProxyActor).remote(port)
+        ray_tpu.get(proxy.ready.remote())
+
+
 def run(
     app: Union[Application, Deployment],
     *,
     name: str = "default",
     route_prefix: Optional[str] = None,
     http_port: Optional[int] = None,
+    grpc_port: Optional[int] = None,
     _blocking: bool = False,
 ) -> DeploymentHandle:
     """Deploy an application and return a handle (reference:
@@ -135,7 +153,7 @@ def run(
     import ray_tpu
     import time
 
-    controller = start(http_port=http_port)
+    controller = start(http_port=http_port, grpc_port=grpc_port)
     if isinstance(app, Deployment):
         app = app.bind()
     dep = app.deployment
@@ -187,9 +205,13 @@ def shutdown():
         ray_tpu.kill(controller)
     except Exception:
         pass
-    try:
-        proxy = ray_tpu.get_actor("SERVE_PROXY", "serve")
-        ray_tpu.kill(proxy)
-    except Exception:
-        pass
+    for proxy_name in ("SERVE_PROXY", "SERVE_GRPC_PROXY"):
+        try:
+            proxy = ray_tpu.get_actor(proxy_name, "serve")
+            ray_tpu.kill(proxy)
+        except Exception:
+            pass
+    from ray_tpu.serve._private.router import shutdown_routers
+
+    shutdown_routers()  # stop this process's long-poll threads
     _started = False

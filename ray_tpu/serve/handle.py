@@ -43,33 +43,46 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, controller=None):
+    def __init__(self, deployment_name: str, controller=None,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._controller = controller
         self._router = None
+        self._multiplexed_model_id = multiplexed_model_id
 
     def _ensure_router(self):
         if self._router is None:
             from ray_tpu.serve._private.controller import CONTROLLER_NAME
-            from ray_tpu.serve._private.router import Router
+            from ray_tpu.serve._private.router import get_or_create_router
 
             import ray_tpu
 
             controller = self._controller or ray_tpu.get_actor(CONTROLLER_NAME, "serve")
             self._controller = controller
-            self._router = Router(controller, self.deployment_name)
+            self._router = get_or_create_router(controller, self.deployment_name)
         return self._router
 
     def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
         router = self._ensure_router()
-        ref, rid = router.route(method, args, kwargs)
+        ref, rid = router.route(method, args, kwargs, self._multiplexed_model_id)
         return DeploymentResponse(ref, router, rid)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._call("__call__", args, kwargs)
 
-    def options(self, **kwargs) -> "DeploymentHandle":
-        return self
+    def options(self, *, multiplexed_model_id: Optional[str] = None, **kwargs) -> "DeploymentHandle":
+        """A derived handle with per-call options (reference:
+        serve/handle.py options — multiplexed_model_id routes to a
+        replica already holding that model).  The derived handle SHARES
+        this handle's router so queue estimates and model affinity stay
+        coherent."""
+        if multiplexed_model_id is None:
+            return self
+        h = DeploymentHandle(
+            self.deployment_name, self._controller, multiplexed_model_id
+        )
+        h._router = self._ensure_router()
+        return h
 
     def __getattr__(self, name: str) -> _MethodCaller:
         if name.startswith("_"):
@@ -77,5 +90,6 @@ class DeploymentHandle:
         return _MethodCaller(self, name)
 
     def __reduce__(self):
-        # handles cross process boundaries by name; the router re-resolves
-        return (DeploymentHandle, (self.deployment_name,))
+        # handles cross process boundaries by name (the router
+        # re-resolves); per-call options like the model id must survive
+        return (DeploymentHandle, (self.deployment_name, None, self._multiplexed_model_id))

@@ -438,6 +438,10 @@ def test_push_shuffle_through_dataset_api(ray_cluster):
     out = [r["id"] for r in ds.take_all()]
     assert sorted(out) == list(range(200))
     assert out != list(range(200))
+    # determinism: the same seed reproduces the SAME order even though
+    # merges overlap maps in nondeterministic task-completion order
+    out2 = [r["id"] for r in rd.range(200, parallelism=20).random_shuffle(seed=3).take_all()]
+    assert out == out2
 
 
 def test_read_sql_sqlite(ray_cluster, tmp_path):

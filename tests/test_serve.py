@@ -163,3 +163,109 @@ def test_rolling_update(serve_cluster):
             return
         time.sleep(0.3)
     raise AssertionError("rolling update never converged to v2")
+
+
+def test_grpc_proxy(serve_cluster):
+    """Generic-bytes gRPC route through the full serve stack (reference:
+    proxy.py:538 gRPCProxy)."""
+    import grpc
+
+    @serve.deployment
+    class GrpcModel:
+        def __call__(self, x):
+            return {"doubled": x * 2}
+
+        def describe(self):
+            return "grpc-model"
+
+    serve.run(GrpcModel.bind(), grpc_port=19456)
+    channel = grpc.insecure_channel("127.0.0.1:19456")
+    call = channel.unary_unary("/ray_tpu.serve.UserDefinedService/GrpcModel")
+    deadline = time.time() + 15
+    last = None
+    while time.time() < deadline:
+        try:
+            out = json.loads(call(json.dumps({"args": [21]}).encode(), timeout=10))
+            assert out == {"doubled": 42}
+            break
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.5)
+    else:
+        raise AssertionError(f"grpc proxy never became reachable: {last}")
+    # non-__call__ dispatch via metadata
+    out = json.loads(
+        call(json.dumps({"args": []}).encode(), timeout=10,
+             metadata=(("method", "describe"),))
+    )
+    assert out == "grpc-model"
+    channel.close()
+
+
+def test_multiplexed_model_swap(serve_cluster):
+    """LRU model multiplexing on one replica + handle model routing
+    (reference: serve/multiplex.py + handle multiplexed_model_id)."""
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"weights": f"model-{model_id}"}
+
+        async def __call__(self, payload):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model["weights"], "loads": list(self.loads)}
+
+    handle = serve.run(MultiModel.bind())
+    # same model id repeatedly: ONE load (cache hit + replica affinity)
+    outs = [
+        handle.options(multiplexed_model_id="a").remote(None).result(timeout=30)
+        for _ in range(4)
+    ]
+    assert all(o["model"] == "model-a" for o in outs)
+    assert outs[-1]["loads"].count("a") == 1, outs[-1]["loads"]
+    # third model on the same replica evicts the LRU (max 2)
+    for mid in ("b", "c", "a"):
+        out = handle.options(multiplexed_model_id=mid).remote(None).result(timeout=30)
+        assert out["model"] == f"model-{mid}"
+    loads = out["loads"]
+    # "a" was evicted by b/c (capacity 2) and re-loaded on this replica
+    # if all routed to one replica; across 2 replicas affinity may have
+    # spread them — either way every answer was correct and total loads
+    # stayed bounded
+    assert 1 <= loads.count("a") <= 2
+
+
+def test_long_poll_pushes_replica_set(serve_cluster):
+    """Routers learn replica-set changes via long-poll push, not just
+    the 1s polling fallback (reference: long_poll.py)."""
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME, lp_replicas_key
+    from ray_tpu.serve._private.long_poll import LongPollClient
+
+    @serve.deployment(num_replicas=1, version="v1")
+    def pushed(payload):
+        return "v1"
+
+    serve.run(pushed.bind())
+    controller = ray_tpu.get_actor(CONTROLLER_NAME, "serve")
+
+    seen = []
+    client = LongPollClient(
+        controller, {lp_replicas_key("pushed"): lambda snap: seen.append(snap)}
+    )
+    # scale up: the push must arrive without any poll from us
+    serve.run(pushed.options(num_replicas=2, version="v1").bind())
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if any(len(s) == 2 for s in seen):
+            break
+        time.sleep(0.2)
+    client.stop()
+    assert any(len(s) == 2 for s in seen), f"no 2-replica snapshot pushed: {seen}"
