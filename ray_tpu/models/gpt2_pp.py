@@ -49,6 +49,27 @@ def merge_pipeline_params(stage_params: Any, rest: Any, cfg: GPT2Config) -> Any:
     return params
 
 
+def _embed(wte: Any, wpe: Any, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """Token + position embedding (shared by both pipeline variants)."""
+    T = tokens.shape[1]
+    x = wte["embedding"][tokens].astype(cfg.dtype)
+    return x + wpe["embedding"][jnp.arange(T)[None, :]].astype(cfg.dtype)
+
+
+def _head_loss(ln_f: Any, lm_head: Any, x: jax.Array, targets: jax.Array,
+               cfg: GPT2Config) -> jax.Array:
+    """Final LN + lm head + fused-logsumexp mean loss (shared)."""
+    import flax.linen as nn
+
+    x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype).apply(
+        {"params": ln_f}, x
+    )
+    logits = x @ lm_head["kernel"].astype(cfg.dtype)
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - tgt.astype(jnp.float32)).mean()
+
+
 def make_pp_loss_fn(cfg: GPT2Config, mesh: Mesh, n_micro: int, axis: str = "pp"):
     """loss(stage_params, rest, tokens, targets) — differentiable w.r.t.
     both parameter trees."""
@@ -65,19 +86,71 @@ def make_pp_loss_fn(cfg: GPT2Config, mesh: Mesh, n_micro: int, axis: str = "pp")
 
     def loss(stage_params, rest, tokens, targets):
         B, T = tokens.shape
-        x = rest["wte"]["embedding"][tokens].astype(cfg.dtype)
-        x = x + rest["wpe"]["embedding"][jnp.arange(T)[None, :]].astype(cfg.dtype)
+        x = _embed(rest["wte"], rest["wpe"], tokens, cfg)
         mbs = microbatch(x, n_micro)
         x = pipe(stage_params, mbs).reshape(B, T, -1)
         # final LN + head (replicated over pp).
-        import flax.linen as nn
+        return _head_loss(rest["ln_f"], rest["lm_head"], x, targets, cfg)
 
-        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype).apply(
-            {"params": rest["ln_f"]}, x
-        )
-        logits = x @ rest["lm_head"]["kernel"].astype(cfg.dtype)
-        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        return (lse - tgt.astype(jnp.float32)).mean()
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Interleaved schedule with embed/head as TRUE pipeline stages
+# (VERDICT r4 #8: non-uniform stage shapes + 1F1B-style schedule)
+
+
+def split_pipeline_params_interleaved(
+    params: Any, cfg: GPT2Config, pp: int, v: int
+) -> Tuple[Any, Any, Any]:
+    """(first_params, chunk_params, last_params): blocks split into
+    S = pp*v chunks with the interleaved device assignment; wte/wpe go
+    to the FIRST stage, ln_f/lm_head to the LAST (they are pipeline
+    stages now, not replicated pre/post work)."""
+    from ray_tpu.parallel.pipeline import stack_stage_params_interleaved
+
+    if cfg.n_layer % (pp * v):
+        raise ValueError(f"n_layer {cfg.n_layer} not divisible by pp*v={pp * v}")
+    per = cfg.n_layer // (pp * v)
+    blocks = [params[f"h_{i}"] for i in range(cfg.n_layer)]
+    chunks = []
+    for s in range(pp * v):
+        chunk_layers = blocks[s * per : (s + 1) * per]
+        chunks.append(jax.tree.map(lambda *xs: jnp.stack(xs), *chunk_layers))
+    chunk_params = stack_stage_params_interleaved(chunks, pp, v)
+    first = {"wte": params["wte"], "wpe": params["wpe"]}
+    last = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+    return first, chunk_params, last
+
+
+def make_pp_loss_fn_interleaved(
+    cfg: GPT2Config, mesh: Mesh, n_micro: int, n_virtual: int = 1, axis: str = "pp"
+):
+    """loss(first_params, chunk_params, last_params, tokens, targets) —
+    the full model staged over the pipeline: embed enters on device 0,
+    per-token loss exits on device pp-1, blocks interleave v chunks per
+    device (bubble (pp-1)/(pp-1+M*v))."""
+    from ray_tpu.parallel.pipeline import microbatch, pipeline_interleaved
+
+    def first_fn(first, tokens_mb):
+        return _embed(first["wte"], first["wpe"], tokens_mb, cfg)
+
+    def mid_fn(chunk_layers, x):
+        def body(h, layer):
+            return Block(cfg).apply({"params": layer}, h), None
+
+        out, _ = lax.scan(body, x, chunk_layers)
+        return out
+
+    def last_fn(last, x, targets_mb):
+        return _head_loss(last["ln_f"], last["lm_head"], x, targets_mb, cfg)
+
+    pipe = pipeline_interleaved(first_fn, mid_fn, last_fn, mesh, n_virtual, axis)
+
+    def loss(first_params, chunk_params, last_params, tokens, targets):
+        tok_mbs = microbatch(tokens, n_micro)
+        tgt_mbs = microbatch(targets, n_micro)
+        per_mb = pipe(first_params, chunk_params, last_params, tok_mbs, tgt_mbs)
+        return per_mb.mean()
 
     return loss
