@@ -93,6 +93,8 @@ def load_library():
         lib.arena_can_fit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.arena_release_create.restype = ctypes.c_int
         lib.arena_release_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.arena_prefault.restype = None
+        lib.arena_prefault.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -132,6 +134,11 @@ class NativeArena:
         if not h:
             return None
         return cls(h, lib)
+
+    def prefault(self):
+        """Touch every data page (see arena_prefault in shm_arena.cpp);
+        ctypes releases the GIL, so run it from a background thread."""
+        self._lib.arena_prefault(self._h)
 
     def close(self):
         if not self._closed:

@@ -92,6 +92,15 @@ class ObjectStoreCore:
         self.num_evictions = 0
         # Native arena backend (plasma-equivalent); None → file fallback.
         self.arena = _try_native_arena(store_dir, capacity_bytes, create=True)
+        if self.arena is not None:
+            # Background prefault: puts that land before it finishes just
+            # fault their own pages; everything after runs at warm-page
+            # memcpy speed (~4x on this class of box — PERF_ANALYSIS.md).
+            import threading
+
+            threading.Thread(
+                target=self.arena.prefault, daemon=True, name="arena-prefault"
+            ).start()
         # --- spilling (reference: external_storage.py FileSystemStorage +
         # raylet/local_object_manager.h SpillObjects) ---
         # Under memory pressure, LRU sealed objects are written to disk and
@@ -305,7 +314,9 @@ class ObjectStoreCore:
         if self.contains(object_id):
             return False
         e = self.objects.get(object_id) or ObjectEntry(object_id)
-        e.inline_data = bytes(data)
+        # the server owns `data` after unpickling the request frame:
+        # keep bytes/bytearray as-is instead of paying another full copy
+        e.inline_data = data if isinstance(data, (bytes, bytearray)) else bytes(data)
         e.size = len(data)
         e.state = INLINE
         e.is_error = is_error
@@ -653,7 +664,8 @@ class StoreClient:
     def put_blob(self, object_id: ObjectID, blob: bytes) -> int:
         """Store an already-flattened serialized blob."""
         if len(blob) <= CONFIG.max_direct_call_object_size:
-            self._raylet.call("store_put_inline", (object_id.binary(), bytes(blob)))
+            # bytearray ships as-is; the raylet's put_inline owns the copy
+            self._raylet.call("store_put_inline", (object_id.binary(), blob))
             return len(blob)
         path = os.path.join(self.store_dir, object_id.hex())
         tmp = path + ".w"
@@ -670,7 +682,10 @@ class StoreClient:
         if total <= CONFIG.max_direct_call_object_size:
             blob = bytearray(total)
             serialization.write_into(memoryview(blob), meta, buffers)
-            self._raylet.call("store_put_inline", (object_id.binary(), bytes(blob)))
+            # no bytes(blob): the frame pickler copies the bytearray once
+            # into the wire frame; a bytes() conversion would add a
+            # second full copy of every small put
+            self._raylet.call("store_put_inline", (object_id.binary(), blob))
             return total
         if self.arena is not None:
             code, view = self.arena.alloc_status(object_id.binary(), total)
