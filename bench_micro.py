@@ -235,8 +235,16 @@ def main():
         print(json.dumps(rec), flush=True)
     ray_tpu.shutdown()
 
+    # merge-preserve keys this run didn't produce (stress_* entries come
+    # from tests/test_stress.py runs)
+    try:
+        with open(args.out) as f:
+            prev = json.load(f)
+    except Exception:
+        prev = {}
+    prev.update(results)
     with open(args.out, "w") as f:
-        json.dump(results, f, indent=2)
+        json.dump(prev, f, indent=2)
 
 
 if __name__ == "__main__":

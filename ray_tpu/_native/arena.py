@@ -93,8 +93,10 @@ def load_library():
         lib.arena_can_fit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.arena_release_create.restype = ctypes.c_int
         lib.arena_release_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.arena_prefault.restype = None
-        lib.arena_prefault.argtypes = [ctypes.c_void_p]
+        lib.arena_prefault_range.restype = ctypes.c_int
+        lib.arena_prefault_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ]
         _lib = lib
         return _lib
 
@@ -135,10 +137,27 @@ class NativeArena:
             return None
         return cls(h, lib)
 
-    def prefault(self):
-        """Touch every data page (see arena_prefault in shm_arena.cpp);
-        ctypes releases the GIL, so run it from a background thread."""
-        self._lib.arena_prefault(self._h)
+    def prefault(self, max_bytes: Optional[int] = None,
+                 chunk: int = 32 << 20, duty: float = 0.25):
+        """Populate up to max_bytes of the data region (kernel-side via
+        MADV_POPULATE_WRITE — see shm_arena.cpp) from a background
+        thread (ctypes releases the GIL).  Pacing is adaptive: after
+        each chunk we sleep (1-duty)/duty × the time the chunk took, so
+        population consumes at most ~duty of one core/memory lane no
+        matter how slow the box is — startup work (registrations,
+        heartbeats) keeps running."""
+        import time as _time
+
+        limit = min(max_bytes, self.capacity) if max_bytes is not None else self.capacity
+        off = 0
+        while off < limit:
+            t0 = _time.monotonic()
+            step = min(chunk, limit - off)
+            if self._lib.arena_prefault_range(self._h, off, step) != 0:
+                return  # kernel lacks MADV_POPULATE_WRITE: skip
+            off += step
+            took = _time.monotonic() - t0
+            _time.sleep(took * (1.0 - duty) / duty)
 
     def close(self):
         if not self._closed:

@@ -583,16 +583,17 @@ int arena_test_lock_and_abandon(void* handle) {
 #ifndef MADV_POPULATE_WRITE
 #define MADV_POPULATE_WRITE 23
 #endif
-void arena_prefault(void* handle) {
+// Populate [off, off+len) of the data region; returns 0 on success.
+// The caller (Python, trickling in a background thread) bounds the
+// range and paces the calls — a raw full-capacity sweep would both
+// saturate the memory bus at startup and make the entire arena
+// resident at once (capacity × raylets on a multi-raylet box).
+int arena_prefault_range(void* handle, uint64_t off, uint64_t len) {
   Arena* a = (Arena*)handle;
-  uint8_t* p = a->base + a->hdr->data_start;
   uint64_t cap = a->hdr->data_capacity;
-  // chunked so huge arenas don't pin the kernel in one syscall
-  const uint64_t kChunk = 64ull << 20;
-  for (uint64_t off = 0; off < cap; off += kChunk) {
-    uint64_t len = cap - off < kChunk ? cap - off : kChunk;
-    if (madvise(p + off, len, MADV_POPULATE_WRITE) != 0) return;
-  }
+  if (off >= cap) return 0;
+  if (len > cap - off) len = cap - off;
+  return madvise(a->base + a->hdr->data_start + off, len, MADV_POPULATE_WRITE);
 }
 
 uint64_t arena_used(void* handle) { return ((Arena*)handle)->hdr->used; }

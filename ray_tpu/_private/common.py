@@ -203,3 +203,20 @@ class PlacementGroupInfo:
     bundles: List[Bundle]
     state: str = "PENDING"  # PENDING | CREATED | REMOVED | RESCHEDULING
     creator_job: Optional[JobID] = None
+
+
+async def event_loop_lag_loop(obj, loop, stop_pred=None, period: float = 0.5):
+    """Shared control-plane congestion gauge (used by both the raylet
+    and the GCS): how late a sleep(period) wakes up measures event-loop
+    saturation.  Writes EWMA + max onto ``obj.event_loop_lag_ms`` /
+    ``obj.event_loop_lag_max_ms``."""
+    import asyncio
+
+    obj.event_loop_lag_ms = getattr(obj, "event_loop_lag_ms", 0.0)
+    obj.event_loop_lag_max_ms = getattr(obj, "event_loop_lag_max_ms", 0.0)
+    while stop_pred is None or not stop_pred():
+        t0 = loop.time()
+        await asyncio.sleep(period)
+        lag_ms = max(0.0, (loop.time() - t0 - period) * 1000)
+        obj.event_loop_lag_ms = 0.8 * obj.event_loop_lag_ms + 0.2 * lag_ms
+        obj.event_loop_lag_max_ms = max(obj.event_loop_lag_max_ms, lag_ms)

@@ -22,6 +22,9 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "object_store_memory_fraction": 0.3,
     # Absolute cap on default object store size (bytes).
     "object_store_memory_cap": 8 * 1024**3,
+    # Low-region arena bytes populated at startup (0 disables); capped so
+    # multi-raylet boxes don't make capacity x raylets resident.
+    "arena_prefault_bytes": 2 * 1024**3,
     # Chunk size for node-to-node object transfer.
     "object_manager_chunk_size": 4 * 1024**2,
     # Parallel in-flight chunks per object pull.
@@ -49,6 +52,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # A spawned worker that hasn't registered within this window (runtime
     # env staging included) is presumed wedged and killed.
     "worker_register_timeout_s": 900,
+    # Cap on concurrently-STARTING workers per node: a burst of actor
+    # creations must queue at the spawn gate instead of forking more
+    # interpreters than the box can register within the lease window.
+    # 0 = auto (2 x cpu count, min 2).
+    "max_concurrent_worker_starts": 0,
     # Max idle workers kept around per node.
     "idle_worker_pool_size": 8,
     "idle_worker_killing_time_ms": 300_000,

@@ -112,7 +112,23 @@ class GcsServer:
         self._bg_tasks.append(self.loop.create_task(self._health_loop()))
         if CONFIG.gcs_storage == "file":
             self._bg_tasks.append(self.loop.create_task(self._snapshot_loop()))
+        from ray_tpu._private.common import event_loop_lag_loop
+
+        self._bg_tasks.append(
+            self.loop.create_task(event_loop_lag_loop(self, self.loop))
+        )
         logger.info("GCS listening on %s", self.address)
+
+    async def rpc_gcs_stats(self, payload, conn):
+        return {
+            "event_loop_lag_ms": round(getattr(self, "event_loop_lag_ms", 0.0), 3),
+            "event_loop_lag_max_ms": round(getattr(self, "event_loop_lag_max_ms", 0.0), 3),
+            "num_nodes": len(self.nodes),
+            "num_actors": len(self.actors),
+            "num_placement_groups": sum(
+                1 for pg in self.placement_groups.values() if pg.state != "REMOVED"
+            ),
+        }
 
     # ------------------------------------------------------------------
     # persistence
@@ -697,7 +713,11 @@ class GcsServer:
             self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
         except Exception as e:  # creation failed
             msg = str(e)
-            if "insufficient resources" in msg or "bundle cannot host" in msg:
+            if (
+                "insufficient resources" in msg
+                or "bundle cannot host" in msg
+                or "spawn gate saturated" in msg
+            ):
                 # The GCS view was stale (resources not yet freed on the
                 # node).  Queue and retry when the view refreshes — the
                 # reference never fails an actor for transient resource

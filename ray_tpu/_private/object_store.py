@@ -92,14 +92,20 @@ class ObjectStoreCore:
         self.num_evictions = 0
         # Native arena backend (plasma-equivalent); None → file fallback.
         self.arena = _try_native_arena(store_dir, capacity_bytes, create=True)
-        if self.arena is not None:
-            # Background prefault: puts that land before it finishes just
-            # fault their own pages; everything after runs at warm-page
-            # memcpy speed (~4x on this class of box — PERF_ANALYSIS.md).
+        if self.arena is not None and CONFIG.arena_prefault_bytes > 0:
+            # Background trickled prefault of the hot low region (the
+            # bump allocator + freelist reuse low offsets): puts landing
+            # there run at warm-page memcpy speed (~4x — see
+            # PERF_ANALYSIS.md).  Capped + paced so a multi-raylet box
+            # doesn't make capacity x raylets resident or saturate the
+            # memory bus at startup.
             import threading
 
             threading.Thread(
-                target=self.arena.prefault, daemon=True, name="arena-prefault"
+                target=self.arena.prefault,
+                args=(CONFIG.arena_prefault_bytes,),
+                daemon=True,
+                name="arena-prefault",
             ).start()
         # --- spilling (reference: external_storage.py FileSystemStorage +
         # raylet/local_object_manager.h SpillObjects) ---

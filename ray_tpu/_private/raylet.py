@@ -131,6 +131,10 @@ class Raylet:
         # task ids cancelled while running here: worker death for them is
         # final (TaskCancelledError), never a retry.
         self.cancelled_tasks: Set[bytes] = set()
+        # FIFO tickets for the actor-creation spawn gate.
+        self._spawn_ticket_next = 0
+        self._spawn_ticket_serving = 0
+        self._spawn_tickets_abandoned: Set[int] = set()
         self.actor_workers: Dict[ActorID, WorkerHandle] = {}
         self.job_configs: Dict[JobID, dict] = {}
 
@@ -1283,6 +1287,39 @@ class Raylet:
         """From GCS: spawn a dedicated worker and run the creation task."""
         spec: TaskSpec = payload["spec"]
         res = spec.resources
+        # Spawn flow control FIRST — before any resources are reserved,
+        # so a parked creation can't block task leases on the node.  A
+        # creation burst (many actors at once) must not fork more
+        # interpreters than the node can register within the lease
+        # window.  FIFO tickets (like _grant_lease_waiters) so no
+        # creation starves; bounded wait — on timeout the GCS re-queues
+        # the actor and retries (see _schedule_actor's handler).  The
+        # task-dispatch and lease paths don't need this gate: they
+        # already suppress duplicate spawns per (job, env) and reuse
+        # STARTING workers.
+        cap = CONFIG.max_concurrent_worker_starts or max(2, 2 * (os.cpu_count() or 1))
+        my_ticket = self._spawn_ticket_next
+        self._spawn_ticket_next += 1
+        deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
+        try:
+            while True:
+                # skip over tickets whose waiters gave up or were
+                # cancelled, so a dead waiter can't wedge the queue
+                while self._spawn_ticket_serving in self._spawn_tickets_abandoned:
+                    self._spawn_tickets_abandoned.discard(self._spawn_ticket_serving)
+                    self._spawn_ticket_serving += 1
+                if my_ticket == self._spawn_ticket_serving and (
+                    sum(1 for x in self.workers.values() if x.state == "STARTING")
+                    < cap
+                ):
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("spawn gate saturated; retry actor creation")
+                await asyncio.sleep(0.02)
+        except BaseException:
+            self._spawn_tickets_abandoned.add(my_ticket)
+            raise
+        self._spawn_ticket_serving += 1
         bk = self._bundle_key(spec)
         if bk is not None:
             bundle = self.bundles.get(bk)
@@ -1688,15 +1725,10 @@ class Raylet:
     # ------------------------------------------------------------------
     async def _event_loop_lag_loop(self):
         """Sample the event loop's scheduling lag (reference: per-event-
-        loop stats in src/ray/stats — how late a sleep(period) wakes up
-        is a direct measure of loop congestion)."""
-        period = 0.5
-        while not self._stopping:
-            t0 = self.loop.time()
-            await asyncio.sleep(period)
-            lag_ms = max(0.0, (self.loop.time() - t0 - period) * 1000)
-            self.event_loop_lag_ms = 0.8 * self.event_loop_lag_ms + 0.2 * lag_ms
-            self.event_loop_lag_max_ms = max(self.event_loop_lag_max_ms, lag_ms)
+        loop stats in src/ray/stats; shared impl in common.py)."""
+        from ray_tpu._private.common import event_loop_lag_loop
+
+        await event_loop_lag_loop(self, self.loop, stop_pred=lambda: self._stopping)
 
     async def rpc_node_stats(self, payload, conn):
         return {

