@@ -123,8 +123,10 @@ class GcsServer:
         return {
             "event_loop_lag_ms": round(getattr(self, "event_loop_lag_ms", 0.0), 3),
             "event_loop_lag_max_ms": round(getattr(self, "event_loop_lag_max_ms", 0.0), 3),
-            "num_nodes": len(self.nodes),
-            "num_actors": len(self.actors),
+            "num_nodes": sum(1 for n in self.nodes.values() if n.state == "ALIVE"),
+            "num_actors": sum(
+                1 for a in self.actors.values() if a.state != "DEAD"
+            ),
             "num_placement_groups": sum(
                 1 for pg in self.placement_groups.values() if pg.state != "REMOVED"
             ),

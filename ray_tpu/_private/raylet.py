@@ -131,10 +131,17 @@ class Raylet:
         # task ids cancelled while running here: worker death for them is
         # final (TaskCancelledError), never a retry.
         self.cancelled_tasks: Set[bytes] = set()
-        # FIFO tickets for the actor-creation spawn gate.
+        # FIFO tickets for the actor-creation spawn gate; the event fires
+        # whenever a worker leaves STARTING so parked creations wake
+        # without busy-polling the worker table.
         self._spawn_ticket_next = 0
         self._spawn_ticket_serving = 0
         self._spawn_tickets_abandoned: Set[int] = set()
+        self._spawn_gate_event: Optional[asyncio.Event] = None
+        # Lease shapes this node couldn't serve or spill (direct-path
+        # demand the autoscaler must see); key = shape signature, value =
+        # (ResourceSet, last-seen monotonic).  TTL-pruned.
+        self._unmet_lease_demand: Dict[tuple, tuple] = {}
         self.actor_workers: Dict[ActorID, WorkerHandle] = {}
         self.job_configs: Dict[JobID, dict] = {}
 
@@ -468,8 +475,15 @@ class Raylet:
         except OSError:
             pass
 
+    def _kick_spawn_gate(self):
+        """Wake parked actor creations (a worker left STARTING or a gate
+        turn advanced)."""
+        if self._spawn_gate_event is not None:
+            self._spawn_gate_event.set()
+
     def _kill_worker_proc(self, w: WorkerHandle):
         w.state = "DEAD"
+        self._kick_spawn_gate()
         self.workers.pop(w.worker_id, None)
         if w.actor_id is not None:
             self.actor_workers.pop(w.actor_id, None)
@@ -510,6 +524,12 @@ class Raylet:
     # ------------------------------------------------------------------
     async def _report_loop(self):
         while not self._stopping:
+            now = time.monotonic()
+            self._unmet_lease_demand = {
+                k: v
+                for k, v in self._unmet_lease_demand.items()
+                if now - v[1] < 15.0  # retries refresh live demand
+            }
             try:
                 await self.gcs.call(
                     "resource_report",
@@ -524,7 +544,16 @@ class Raylet:
                         "pending_shapes": [
                             dict(self._task_resources(s))
                             for s in list(self.queue)[:64] + self.infeasible[:64]
-                        ],
+                        ]
+                        # direct-submission demand is queued in the
+                        # SUBMITTER, not this raylet: unmet lease shapes
+                        # (infeasible here and unspillable) must still
+                        # reach the autoscaler or it never sees them
+                        + [
+                            dict(shape)
+                            for shape, _t in self._unmet_lease_demand.values()
+                        ][:32]
+                        + [dict(res) for res, _f in list(self.lease_waiters)[:32]],
                     },
                     timeout=10,
                 )
@@ -645,6 +674,7 @@ class Raylet:
         w.conn = conn
         w.direct_address = payload.get("address")
         w.state = "IDLE"
+        self._kick_spawn_gate()  # one STARTING slot just freed
         conn.meta["worker_id"] = worker_id
         if w.actor_id is None and not w.reserved:
             self.idle_workers[(w.job_id, w.env_hash)].append(w)
@@ -1127,6 +1157,11 @@ class Raylet:
         allow_spill = not payload.get("spilled", False)
         if not res.fits_in(self.resources_total):
             target = self._spill_target(res) if allow_spill else None
+            if target is None:
+                # nowhere in the cluster fits this shape: ledger it so
+                # the heartbeat surfaces the demand to the autoscaler
+                sig = tuple(sorted(dict(res).items()))
+                self._unmet_lease_demand[sig] = (res.copy(), time.monotonic())
             return {"spill": target} if target else None
         # The whole grant (park + spawn) must finish inside the client's
         # call timeout, or the reply lands on a request the client already
@@ -1301,6 +1336,8 @@ class Raylet:
         my_ticket = self._spawn_ticket_next
         self._spawn_ticket_next += 1
         deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
+        if self._spawn_gate_event is None:
+            self._spawn_gate_event = asyncio.Event()
         try:
             while True:
                 # skip over tickets whose waiters gave up or were
@@ -1315,11 +1352,20 @@ class Raylet:
                     break
                 if time.monotonic() > deadline:
                     raise RuntimeError("spawn gate saturated; retry actor creation")
-                await asyncio.sleep(0.02)
+                # event-driven: woken when a worker leaves STARTING (or
+                # a turn advances); the timeout is just a missed-wakeup
+                # backstop, not the pacing mechanism
+                self._spawn_gate_event.clear()
+                try:
+                    await asyncio.wait_for(self._spawn_gate_event.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    pass
         except BaseException:
             self._spawn_tickets_abandoned.add(my_ticket)
+            self._kick_spawn_gate()
             raise
         self._spawn_ticket_serving += 1
+        self._kick_spawn_gate()
         bk = self._bundle_key(spec)
         if bk is not None:
             bundle = self.bundles.get(bk)

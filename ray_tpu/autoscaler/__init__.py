@@ -15,12 +15,22 @@ from ray_tpu.autoscaler.node_provider import (
     NodeProvider,
 )
 from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+from ray_tpu.autoscaler.tpu_node_provider import (
+    GceTpuClient,
+    MockTpuClient,
+    TPUNodeProvider,
+    slice_resources,
+)
 
 __all__ = [
     "StandardAutoscaler",
     "Monitor",
     "NodeProvider",
     "FakeMultiNodeProvider",
+    "TPUNodeProvider",
+    "MockTpuClient",
+    "GceTpuClient",
+    "slice_resources",
     "get_nodes_to_launch",
     "TAG_NODE_KIND",
     "TAG_NODE_TYPE",

@@ -14,6 +14,9 @@ from ray_tpu._private import rpc
 
 
 class Cluster:
+    _prefault_guards = 0  # live Cluster instances holding the env guard
+    _guard_owned = False  # whether the guard set the env var itself
+
     def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
         self.head = None
         self.workers: List = []  # (proc, raylet_address)
@@ -22,11 +25,15 @@ class Cluster:
         # Many raylets share this one machine: per-arena page
         # pre-population (a one-raylet-per-host production optimization)
         # would multiply resident memory by the node count and starve
-        # the box's core during bring-up.  Restored at shutdown so a
-        # later init() in this process isn't silently overridden (env
-        # beats _system_config in CONFIG resolution).
-        self._unset_prefault_env = "RAY_TPU_arena_prefault_bytes" not in os.environ
-        os.environ.setdefault("RAY_TPU_arena_prefault_bytes", "0")
+        # the box's core during bring-up.  Guard is REFCOUNTED across
+        # Cluster instances in this process and restored when the last
+        # one shuts down, so a later init() isn't silently overridden
+        # (env beats _system_config in CONFIG resolution).
+        if Cluster._prefault_guards == 0:
+            Cluster._guard_owned = "RAY_TPU_arena_prefault_bytes" not in os.environ
+            os.environ.setdefault("RAY_TPU_arena_prefault_bytes", "0")
+        Cluster._prefault_guards += 1
+        self._guard_released = False
         if initialize_head:
             self.add_head(**(head_node_args or {}))
 
@@ -94,9 +101,12 @@ class Cluster:
         if self.head is not None:
             self.head.terminate()
             self.head = None
-        if getattr(self, "_unset_prefault_env", False):
-            os.environ.pop("RAY_TPU_arena_prefault_bytes", None)
-            self._unset_prefault_env = False
+        if not getattr(self, "_guard_released", True):
+            self._guard_released = True
+            Cluster._prefault_guards -= 1
+            if Cluster._prefault_guards == 0 and Cluster._guard_owned:
+                os.environ.pop("RAY_TPU_arena_prefault_bytes", None)
+                Cluster._guard_owned = False
 
 
 class _NodeHandle:

