@@ -146,6 +146,9 @@ class TaskSpec:
     # end-of-stream sentinel (item count, or the task's error).
     # Reference: core_worker/generator_waiter.h + ObjectRefGenerator.
     is_streaming: bool = False
+    # W3C traceparent of the SUBMITTING context (reference:
+    # util/tracing/tracing_helper.py — spans nest across task hops).
+    trace_parent: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

@@ -52,10 +52,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # A spawned worker that hasn't registered within this window (runtime
     # env staging included) is presumed wedged and killed.
     "worker_register_timeout_s": 900,
-    # Cap on concurrently-STARTING workers per node: a burst of actor
-    # creations must queue at the spawn gate instead of forking more
-    # interpreters than the box can register within the lease window.
-    # 0 = auto (2 x cpu count, min 2).
+    # HOST-wide cap on concurrently-STARTING workers (flock token pool
+    # shared by all raylets of a session on one machine): actor bursts
+    # queue at the gate instead of forking more interpreters than the
+    # machine can register within the lease window. 0 = auto
+    # (2 x cpu count, min 4 — see spawn_gate.default_slots).
     "max_concurrent_worker_starts": 0,
     # Max idle workers kept around per node.
     "idle_worker_pool_size": 8,

@@ -715,11 +715,17 @@ class GcsServer:
             self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
         except Exception as e:  # creation failed
             msg = str(e)
-            if (
+            transient = (
                 "insufficient resources" in msg
                 or "bundle cannot host" in msg
                 or "spawn gate saturated" in msg
-            ):
+            )
+            if "failed to start" in msg:
+                # a start timeout under machine load is transient: retry
+                # a few times before declaring the actor dead
+                info.creation_attempts = getattr(info, "creation_attempts", 0) + 1
+                transient = transient or info.creation_attempts <= 3
+            if transient:
                 # The GCS view was stale (resources not yet freed on the
                 # node).  Queue and retry when the view refreshes — the
                 # reference never fails an actor for transient resource

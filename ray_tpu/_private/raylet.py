@@ -44,7 +44,7 @@ class WorkerHandle:
         "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
         "running", "spawn_time", "idle_since", "resources_held", "bundle_key",
         "direct_address", "lease_owner", "lease_blocked", "reserved",
-        "env_hash", "log_path",
+        "env_hash", "log_path", "spawn_token",
     )
 
     def __init__(self, worker_id: WorkerID, proc, job_id: JobID):
@@ -79,6 +79,8 @@ class WorkerHandle:
         # Worker stdout/stderr file; tailed by the log monitor and
         # streamed to the job's driver (reference: log_monitor.py).
         self.log_path: Optional[str] = None
+        # held host-wide spawn-gate slot fd while STARTING (actors only)
+        self.spawn_token: Optional[int] = None
 
 
 class Raylet:
@@ -133,11 +135,18 @@ class Raylet:
         self.cancelled_tasks: Set[bytes] = set()
         # FIFO tickets for the actor-creation spawn gate; the event fires
         # whenever a worker leaves STARTING so parked creations wake
-        # without busy-polling the worker table.
+        # without busy-polling the worker table.  The slot pool itself is
+        # HOST-wide (shared across the session's raylets via flock).
         self._spawn_ticket_next = 0
         self._spawn_ticket_serving = 0
         self._spawn_tickets_abandoned: Set[int] = set()
         self._spawn_gate_event: Optional[asyncio.Event] = None
+        from ray_tpu._private.spawn_gate import HostSpawnGate
+
+        self._spawn_gate = HostSpawnGate(
+            os.path.join(self.session_dir or "/tmp/ray_tpu", "spawn_gate"),
+            slots=CONFIG.max_concurrent_worker_starts or None,
+        )
         # Lease shapes this node couldn't serve or spill (direct-path
         # demand the autoscaler must see); key = shape signature, value =
         # (ResourceSet, last-seen monotonic).  TTL-pruned.
@@ -481,8 +490,17 @@ class Raylet:
         if self._spawn_gate_event is not None:
             self._spawn_gate_event.set()
 
+    def _release_spawn_token(self, w: "WorkerHandle"):
+        token = getattr(w, "spawn_token", None)
+        if token is not None:
+            w.spawn_token = None
+            from ray_tpu._private.spawn_gate import HostSpawnGate
+
+            HostSpawnGate.release(token)
+
     def _kill_worker_proc(self, w: WorkerHandle):
         w.state = "DEAD"
+        self._release_spawn_token(w)
         self._kick_spawn_gate()
         self.workers.pop(w.worker_id, None)
         if w.actor_id is not None:
@@ -674,6 +692,7 @@ class Raylet:
         w.conn = conn
         w.direct_address = payload.get("address")
         w.state = "IDLE"
+        self._release_spawn_token(w)
         self._kick_spawn_gate()  # one STARTING slot just freed
         conn.meta["worker_id"] = worker_id
         if w.actor_id is None and not w.reserved:
@@ -1325,19 +1344,20 @@ class Raylet:
         # Spawn flow control FIRST — before any resources are reserved,
         # so a parked creation can't block task leases on the node.  A
         # creation burst (many actors at once) must not fork more
-        # interpreters than the node can register within the lease
-        # window.  FIFO tickets (like _grant_lease_waiters) so no
-        # creation starves; bounded wait — on timeout the GCS re-queues
-        # the actor and retries (see _schedule_actor's handler).  The
-        # task-dispatch and lease paths don't need this gate: they
-        # already suppress duplicate spawns per (job, env) and reuse
-        # STARTING workers.
-        cap = CONFIG.max_concurrent_worker_starts or max(2, 2 * (os.cpu_count() or 1))
+        # interpreters than the MACHINE can register within the lease
+        # window; the gate is host-wide (flock token pool shared across
+        # every raylet of the session — see spawn_gate.py) so packed
+        # test topologies don't multiply the cap, while a single
+        # raylet's small population still starts fully concurrently.
+        # FIFO tickets (like _grant_lease_waiters) keep this raylet's
+        # creations starvation-free; bounded wait — on timeout the GCS
+        # re-queues the actor and retries (_schedule_actor's handler).
         my_ticket = self._spawn_ticket_next
         self._spawn_ticket_next += 1
         deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000
         if self._spawn_gate_event is None:
             self._spawn_gate_event = asyncio.Event()
+        spawn_token = None
         try:
             while True:
                 # skip over tickets whose waiters gave up or were
@@ -1345,19 +1365,18 @@ class Raylet:
                 while self._spawn_ticket_serving in self._spawn_tickets_abandoned:
                     self._spawn_tickets_abandoned.discard(self._spawn_ticket_serving)
                     self._spawn_ticket_serving += 1
-                if my_ticket == self._spawn_ticket_serving and (
-                    sum(1 for x in self.workers.values() if x.state == "STARTING")
-                    < cap
-                ):
-                    break
+                if my_ticket == self._spawn_ticket_serving:
+                    spawn_token = self._spawn_gate.try_acquire()
+                    if spawn_token is not None:
+                        break
                 if time.monotonic() > deadline:
                     raise RuntimeError("spawn gate saturated; retry actor creation")
-                # event-driven: woken when a worker leaves STARTING (or
-                # a turn advances); the timeout is just a missed-wakeup
-                # backstop, not the pacing mechanism
+                # woken when a worker leaves STARTING on THIS raylet (or
+                # a turn advances); the timeout also re-polls the
+                # host-wide pool for slots freed by other raylets
                 self._spawn_gate_event.clear()
                 try:
-                    await asyncio.wait_for(self._spawn_gate_event.wait(), timeout=0.5)
+                    await asyncio.wait_for(self._spawn_gate_event.wait(), timeout=0.2)
                 except asyncio.TimeoutError:
                     pass
         except BaseException:
@@ -1366,19 +1385,30 @@ class Raylet:
             raise
         self._spawn_ticket_serving += 1
         self._kick_spawn_gate()
-        bk = self._bundle_key(spec)
-        if bk is not None:
-            bundle = self.bundles.get(bk)
-            if bundle is None or not bundle["committed"] or not res.fits_in(bundle["available"]):
-                raise RuntimeError("placement group bundle cannot host actor")
-            bundle["available"].subtract(res)
-        else:
-            if not res.fits_in(self.resources_available):
-                raise RuntimeError("insufficient resources for actor")
-            self.resources_available.subtract(res)
-        w = self._spawn_worker(
-            spec.job_id, actor_id=spec.actor_id, runtime_env=spec.runtime_env
-        )
+        # From here until the token is parked on the worker handle, ANY
+        # raise must release it — the GCS retries these errors, and each
+        # retry would otherwise leak one host-wide slot until the pool
+        # drains and every creation on the machine wedges.
+        try:
+            bk = self._bundle_key(spec)
+            if bk is not None:
+                bundle = self.bundles.get(bk)
+                if bundle is None or not bundle["committed"] or not res.fits_in(bundle["available"]):
+                    raise RuntimeError("placement group bundle cannot host actor")
+                bundle["available"].subtract(res)
+            else:
+                if not res.fits_in(self.resources_available):
+                    raise RuntimeError("insufficient resources for actor")
+                self.resources_available.subtract(res)
+            w = self._spawn_worker(
+                spec.job_id, actor_id=spec.actor_id, runtime_env=spec.runtime_env
+            )
+        except BaseException:
+            from ray_tpu._private.spawn_gate import HostSpawnGate
+
+            HostSpawnGate.release(spawn_token)
+            raise
+        w.spawn_token = spawn_token  # released when it leaves STARTING
         w.resources_held = res.copy()
         w.bundle_key = bk
         self.actor_workers[spec.actor_id] = w

@@ -39,7 +39,137 @@ logger = logging.getLogger(__name__)
 KV_NS = b"fun:_runtime_envs"  # GCS KV namespace for uploaded packages
 URI_PREFIX = "gcs://_runtime_envs/"
 
-SUPPORTED_KEYS = {"working_dir", "py_modules", "env_vars", "pip", "config"}
+SUPPORTED_KEYS = {
+    "working_dir", "py_modules", "env_vars", "pip", "config",
+    "conda", "uv", "image_uri",
+}
+
+
+# ----------------------------------------------------------------------
+# plugin API (reference: _private/runtime_env/plugin.py RuntimeEnvPlugin)
+# ----------------------------------------------------------------------
+class RuntimeEnvPlugin:
+    """Pluggable runtime_env field handler.  ``name`` is the env dict
+    key the plugin owns; ``validate`` runs driver-side at prepare time,
+    ``stage`` runs in the worker before task execution and may mutate
+    the process (sys.path, os.environ, cwd)."""
+
+    name: str = ""
+    priority: int = 10  # lower stages first
+
+    def validate(self, value) -> None:
+        pass
+
+    def stage(self, value, gcs_client, session_dir: str) -> None:
+        raise NotImplementedError
+
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise RuntimeEnvError("plugin must set a name")
+    _plugins[plugin.name] = plugin
+    SUPPORTED_KEYS.add(plugin.name)
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """``conda``: named env or spec dict (reference: runtime_env/conda.py).
+    Gated: requires a conda binary on the node — absent here, staging
+    raises RuntimeEnvError rather than half-working."""
+
+    name = "conda"
+
+    def validate(self, value) -> None:
+        if not isinstance(value, (str, dict)):
+            raise RuntimeEnvError("runtime_env['conda'] must be an env name or spec dict")
+
+    def stage(self, value, gcs_client, session_dir: str) -> None:
+        import shutil
+
+        if shutil.which("conda") is None:
+            raise RuntimeEnvError(
+                "runtime_env['conda'] requires a conda installation on the node"
+            )
+        import subprocess as sp
+
+        if isinstance(value, str):
+            env_name = value
+        else:
+            env_name = value.get("name", "ray-tpu-env")
+            spec_path = os.path.join(session_dir, f"conda-{env_hash(value)}.yml")
+            with open(spec_path, "w") as f:
+                json.dump(value, f)
+            sp.run(["conda", "env", "update", "-n", env_name, "-f", spec_path],
+                   check=True, capture_output=True, timeout=1800)
+        # ask the ENV's interpreter for its own site-packages (its
+        # python version need not match this worker's)
+        out = sp.run(
+            ["conda", "run", "-n", env_name, "python", "-c",
+             "import site, sys; print(sys.prefix); print(site.getsitepackages()[0])"],
+            check=True, capture_output=True, text=True, timeout=120,
+        )
+        prefix, site_dir = out.stdout.strip().splitlines()[-2:]
+        os.sys.path.insert(0, site_dir)
+        os.environ["CONDA_PREFIX"] = prefix
+
+
+class UvPlugin(RuntimeEnvPlugin):
+    """``uv``: list of specs installed via the uv resolver (reference:
+    runtime_env/uv.py); falls back to RuntimeEnvError when uv is absent
+    (use ``pip`` instead on this image)."""
+
+    name = "uv"
+
+    def validate(self, value) -> None:
+        if not (isinstance(value, list) and all(isinstance(p, str) for p in value)):
+            raise RuntimeEnvError("runtime_env['uv'] must be a List[str] of specs")
+
+    def stage(self, value, gcs_client, session_dir: str) -> None:
+        import shutil
+
+        if shutil.which("uv") is None:
+            raise RuntimeEnvError(
+                "runtime_env['uv'] requires the uv binary; use 'pip' on this image"
+            )
+        target = os.path.join(_resources_dir(session_dir), f"uv-{env_hash(value)}")
+        marker = os.path.join(target, ".ray_tpu_complete")
+        if not os.path.exists(marker):
+            # once-per-node staging under the cross-process lock (same
+            # protocol as _stage_pip)
+            with _FileLock(target + ".lock"):
+                if not os.path.exists(marker):
+                    import subprocess as sp
+
+                    sp.run(["uv", "pip", "install", "--target", target] + list(value),
+                           check=True, capture_output=True, timeout=600)
+                    with open(marker, "w") as f:
+                        f.write("ok")
+        os.sys.path.insert(0, target)
+
+
+class ImageUriPlugin(RuntimeEnvPlugin):
+    """``image_uri``: per-task container images (reference:
+    runtime_env/image_uri.py).  Worker processes here run directly on
+    the host — container isolation needs a container runtime the image
+    doesn't ship, so this is validate-only + explicit failure."""
+
+    name = "image_uri"
+
+    def validate(self, value) -> None:
+        if not isinstance(value, str):
+            raise RuntimeEnvError("runtime_env['image_uri'] must be a string")
+
+    def stage(self, value, gcs_client, session_dir: str) -> None:
+        raise RuntimeEnvError(
+            "runtime_env['image_uri'] needs a container runtime (podman/docker), "
+            "which this deployment does not provide"
+        )
+
+
+for _p in (CondaPlugin(), UvPlugin(), ImageUriPlugin()):
+    _plugins[_p.name] = _p
 
 # Dirs never worth shipping (reference: working_dir.py excludes .git etc.
 # via upload filters; __pycache__ differs per interpreter run).
@@ -71,6 +201,9 @@ def validate(env: dict) -> None:
         isinstance(pip, list) and all(isinstance(p, str) for p in pip)
     ):
         raise RuntimeEnvError("runtime_env['pip'] must be a List[str] of pip specs")
+    for key, plugin in _plugins.items():
+        if key in env:
+            plugin.validate(env[key])
 
 
 def prepare(env: Optional[dict]) -> Tuple[Optional[dict], List[Tuple[str, bytes]]]:
@@ -111,6 +244,9 @@ def prepare(env: Optional[dict]) -> Tuple[Optional[dict], List[Tuple[str, bytes]
         norm["pip"] = sorted(env["pip"])
     if env.get("config"):
         norm["config"] = dict(env["config"])
+    for key in _plugins:
+        if key in env:
+            norm[key] = env[key]
     return (norm or None, uploads)
 
 
@@ -340,3 +476,8 @@ def stage_and_apply(env: Optional[dict], gcs_client, session_dir: str) -> None:
         os.environ["PYTHONPATH"] = staged + os.pathsep + os.environ.get("PYTHONPATH", "")
     for k, v in (env.get("env_vars") or {}).items():
         os.environ[k] = v
+    # plugin fields stage last, in priority order (reference: plugin.py
+    # priority-ordered plugin setup)
+    for key, plugin in sorted(_plugins.items(), key=lambda kv: kv[1].priority):
+        if key in env:
+            plugin.stage(env[key], gcs_client, session_dir)

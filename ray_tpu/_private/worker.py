@@ -1002,6 +1002,7 @@ class Worker:
             owner_worker_id=self.worker_id,
             runtime_env=runtime_env,
             is_streaming=is_streaming,
+            trace_parent=_current_traceparent(),
         )
         generator = None
         if is_streaming:
@@ -1252,6 +1253,7 @@ class Worker:
             scheduling_strategy=_resolve_strategy(options),
             owner_worker_id=self.worker_id,
             runtime_env=self._effective_runtime_env(options),
+            trace_parent=_current_traceparent(),
         )
         self.gcs_client.call("register_actor", {"spec": spec})
         return actor_id
@@ -1278,6 +1280,7 @@ class Worker:
             method_name=method_name,
             owner_worker_id=self.worker_id,
             is_streaming=is_streaming,
+            trace_parent=_current_traceparent(),
             concurrency_group=options.get("concurrency_group"),
         )
         # Completion flows back through the actor channel / stored error
@@ -1504,6 +1507,11 @@ class Worker:
     def _execute_task_guarded(self, spec: TaskSpec, conn=None):
         start = time.time()
         error = None
+        # enter a child span of the submitter's trace context, so spans
+        # nest across task hops (reference: tracing_helper.py)
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.install_context(getattr(spec, "trace_parent", None))
         try:
             self._execute_task(spec, conn)
         except BaseException as e:  # pragma: no cover — never crash the loop
@@ -1528,6 +1536,11 @@ class Worker:
                 "job_id": spec.job_id.hex(),
                 "actor_id": spec.actor_id.hex() if spec.is_actor_task else None,
             }
+            from ray_tpu.util import tracing as _tracing
+
+            if _tracing.get_trace_id() is not None:
+                event["trace_id"] = _tracing.get_trace_id()
+                event["span_id"] = _tracing.get_span_id()
             with self._task_event_lock:
                 self._task_events.append(event)
                 if self._task_event_flusher is None:
@@ -1831,6 +1844,9 @@ class Worker:
             self._cancel_requested.discard(tid)
 
     async def _execute_task_async_inner(self, spec: TaskSpec, conn=None):
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.install_context(getattr(spec, "trace_parent", None))
         self.current_spec = spec
         sink = None if conn is None else {"inline": [], "stored": []}
         if self._maybe_drop_cancelled(spec, sink):
@@ -1956,6 +1972,14 @@ def _resolve_strategy(options: dict) -> SchedulingStrategy:
 
 
 _global_worker: Optional[Worker] = None
+def _current_traceparent():
+    """Trace context to stamp onto outgoing specs (None when the caller
+    isn't inside a span or a traced task)."""
+    from ray_tpu.util import tracing
+
+    return tracing.current_traceparent()
+
+
 _worker_lock = threading.Lock()
 
 

@@ -155,11 +155,9 @@ class GceTpuClient:
 
     def create(self, name: str, accelerator_type: str, *,
                runtime_version: str = "v2-alpha-tpuv5-lite", **kwargs) -> dict:
-        return self._request(
-            "POST",
-            f"{self.parent}/nodes?nodeId={name}",
-            {"acceleratorType": accelerator_type, "runtimeVersion": runtime_version},
-        )
+        body = {"acceleratorType": accelerator_type, "runtimeVersion": runtime_version}
+        body.update(kwargs)  # networkConfig, labels, reservation, ...
+        return self._request("POST", f"{self.parent}/nodes?nodeId={name}", body)
 
     def get(self, name: str) -> Optional[dict]:
         import urllib.error
@@ -216,6 +214,12 @@ class TPUNodeProvider(NodeProvider):
 
     def create_node(self, node_config, tags, count):
         accel = node_config.get("accelerator_type", "v5litepod-16")
+        if accel not in SLICE_SHAPES:
+            # fail BEFORE creating a billed slice that later reconcile
+            # passes couldn't size (slice_resources raises on unknowns)
+            raise ValueError(
+                f"unknown accelerator_type {accel!r}; known: {sorted(SLICE_SHAPES)}"
+            )
         created = []
         for _ in range(count):
             name = f"{self.cluster_name}-{accel}-{uuid.uuid4().hex[:6]}"

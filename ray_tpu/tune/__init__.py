@@ -22,11 +22,14 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
+    HyperBandForBOHB,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
+from ray_tpu.tune.search.bohb import TuneBOHB
 from ray_tpu.tune.search.tpe import TPESearcher
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.trainable import Trainable, with_parameters, with_resources
@@ -64,6 +67,7 @@ __all__ = [
     "ConcurrencyLimiter",
     "BasicVariantGenerator",
     "TPESearcher",
+    "TuneBOHB",
     # schedulers
     "TrialScheduler",
     "FIFOScheduler",
@@ -72,4 +76,6 @@ __all__ = [
     "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "PB2",
+    "HyperBandForBOHB",
 ]

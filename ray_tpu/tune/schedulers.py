@@ -268,3 +268,122 @@ class PopulationBasedTraining(TrialScheduler):
 
     def on_trial_complete(self, trial, result):
         self._latest.pop(trial.trial_id, None)
+
+
+class PB2(PopulationBasedTraining):
+    """PB2 — Population-Based Bandits (reference:
+    tune/schedulers/pb2.py; Parker-Holder et al. 2020).
+
+    PBT with the random mutations replaced by a GP-bandit: exploited
+    trials pick their next hyperparameters by maximizing a UCB
+    acquisition over a Gaussian-process fit to the population's
+    (config, time, reward-change) history.  The reference wraps GPy;
+    here the GP (RBF kernel + noise, exact inference) is a small numpy
+    implementation — same algorithm, no dependency.
+
+    ``hyperparam_bounds``: {key: [low, high]} continuous bounds (PB2 is
+    defined for continuous ranges; categorical keys can stay in
+    ``hyperparam_mutations`` and mutate PBT-style)."""
+
+    def __init__(
+        self,
+        time_attr: str = TRAINING_ITERATION,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        perturbation_interval: int = 4,
+        hyperparam_bounds: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        seed: int = 0,
+    ):
+        super().__init__(
+            time_attr, metric, mode,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={},
+            quantile_fraction=quantile_fraction,
+            seed=seed,
+        )
+        self.hyperparam_bounds = hyperparam_bounds or {}
+        # (t, config-vector, reward delta) observations across the pop
+        self._history: list = []
+        self._prev_score: Dict[str, float] = {}
+
+    # -- data collection -------------------------------------------------
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        if score is not None:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None and self.hyperparam_bounds:
+                x = self._vec(trial.config)
+                if x is not None:
+                    t = float(result.get(self.time_attr, 0))
+                    self._history.append((t, x, score - prev))
+                    self._history = self._history[-256:]
+            self._prev_score[trial.trial_id] = score
+        decision = super().on_trial_result(trial, result)
+        if decision == TrialScheduler.PAUSE and getattr(trial, "_pbt_exploit", None):
+            # swap PBT's random mutation for the GP-bandit selection
+            trial._pbt_exploit["mutate"] = self._select_config
+        return decision
+
+    def _vec(self, config: Dict[str, Any]):
+        try:
+            return [float(config[k]) for k in sorted(self.hyperparam_bounds)]
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    # -- GP-UCB selection -------------------------------------------------
+    def _select_config(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        keys = sorted(self.hyperparam_bounds)
+        if not keys:
+            return dict(config)
+        lows = np.array([float(self.hyperparam_bounds[k][0]) for k in keys])
+        highs = np.array([float(self.hyperparam_bounds[k][1]) for k in keys])
+        span = np.where(highs > lows, highs - lows, 1.0)
+        rng = np.random.default_rng(self._rng.randrange(2**31))
+        n_cand = 64
+        cands = rng.uniform(lows, highs, size=(n_cand, len(keys)))
+
+        data = [h for h in self._history if h[1] is not None]
+        if len(data) < 4:
+            choice = cands[0]
+        else:
+            tmax = max(h[0] for h in data) or 1.0
+            X = np.array([[h[0] / tmax] + [(v - l) / s for v, l, s in
+                          zip(h[1], lows, span)] for h in data])
+            y = np.array([h[2] for h in data], dtype=float)
+            y_std = y.std() or 1.0
+            y = (y - y.mean()) / y_std
+
+            def rbf(A, B, ls=0.3):
+                d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+                return np.exp(-0.5 * d2 / ls**2)
+
+            K = rbf(X, X) + 1e-2 * np.eye(len(X))
+            Kinv_y = np.linalg.solve(K, y)
+            Xc = np.concatenate(
+                [np.full((n_cand, 1), 1.0), (cands - lows) / span], axis=1
+            )
+            Ks = rbf(Xc, X)
+            mu = Ks @ Kinv_y
+            # one factorization serves both mean and variance
+            KinvKs = np.linalg.solve(K, Ks.T)
+            var = np.maximum(1.0 - np.einsum("ij,ji->i", Ks, KinvKs), 1e-9)
+            ucb = mu + 2.0 * np.sqrt(var)
+            choice = cands[int(np.argmax(ucb))]
+
+        new = dict(config)
+        for k, v in zip(keys, choice):
+            cur = config.get(k)
+            new[k] = int(round(v)) if isinstance(cur, int) and not isinstance(cur, bool) else float(v)
+        return new
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant paired with the TuneBOHB searcher (reference:
+    tune/schedulers/hb_bohb.py).  The budget rungs are HyperBand's; the
+    model coupling BOHB adds happens through the controller's result
+    feed — every intermediate result reaches
+    ``TuneBOHB.on_trial_result``, so rung-stopped trials still train
+    the KDE at their budget level."""

@@ -1,0 +1,154 @@
+"""Distributed trace-context propagation (reference:
+python/ray/util/tracing/tracing_helper.py — W3C traceparent carried in
+task metadata so spans nest across task/actor boundaries).
+
+Standalone by design (the image ships no OpenTelemetry SDK): context is
+a W3C ``traceparent`` string ("00-<trace_id:32>-<span_id:16>-01")
+propagated via TaskSpec.trace_parent.  Submitting a task stamps the
+caller's current context onto the spec; the executing worker installs a
+child context before running the task body, so ``get_trace_id()`` is
+stable across an entire distributed call tree and every task event
+row carries (trace_id, span_id, parent_span_id) — the timeline and any
+external collector can reassemble the tree.
+
+If an OpenTelemetry SDK IS importable, ``use_opentelemetry()`` bridges
+span starts/ends to a real tracer.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import secrets
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar("ray_tpu_trace", default=None)
+_otel_tracer = None
+# process-local span log (drained by tests/exporters)
+_finished_spans: List[Dict[str, Any]] = []
+_MAX_SPANS = 10_000
+
+
+def _new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str]]:
+    if not header:
+        return None
+    parts = header.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+def get_trace_id() -> Optional[str]:
+    cur = _ctx.get()
+    return cur[0] if cur else None
+
+
+def get_span_id() -> Optional[str]:
+    cur = _ctx.get()
+    return cur[1] if cur else None
+
+
+def current_traceparent() -> Optional[str]:
+    """The header to stamp on outgoing work (None when not tracing)."""
+    cur = _ctx.get()
+    if cur is None:
+        return None
+    return format_traceparent(cur[0], cur[1])
+
+
+def install_context(traceparent: Optional[str]) -> None:
+    """Executor side: enter a CHILD context of the received header (a
+    fresh span id whose parent is the caller's span)."""
+    parsed = parse_traceparent(traceparent)
+    if parsed is None:
+        _ctx.set(None)
+        return
+    trace_id, parent_span = parsed
+    _ctx.set((trace_id, _new_span_id(), parent_span))
+
+
+@contextmanager
+def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Open a span under the current context (starting a new trace if
+    none is active); spans land in the process span log and, when
+    bridged, the OpenTelemetry tracer."""
+    prev = _ctx.get()
+    if prev is None:
+        trace_id, parent = _new_trace_id(), None
+    else:
+        trace_id, parent = prev[0], prev[1]
+    span_id = _new_span_id()
+    token = _ctx.set((trace_id, span_id, parent))
+    start = time.time()
+    otel_cm = None
+    if _otel_tracer is not None:
+        otel_cm = _otel_tracer.start_as_current_span(name)
+        otel_cm.__enter__()
+    try:
+        yield SpanHandle(trace_id, span_id)
+    finally:
+        if otel_cm is not None:
+            otel_cm.__exit__(None, None, None)
+        _record_span(
+            {
+                "name": name,
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "parent_span_id": parent,
+                "start_time": start,
+                "end_time": time.time(),
+                "pid": os.getpid(),
+                "attributes": attributes or {},
+            }
+        )
+        _ctx.reset(token)
+
+
+class SpanHandle:
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+def _record_span(span: Dict[str, Any]) -> None:
+    _finished_spans.append(span)
+    if len(_finished_spans) > _MAX_SPANS:
+        del _finished_spans[: len(_finished_spans) - _MAX_SPANS]
+
+
+def drain_spans() -> List[Dict[str, Any]]:
+    """Pop and return this process's finished spans."""
+    out, _finished_spans[:] = list(_finished_spans), []
+    return out
+
+
+def use_opentelemetry(tracer=None) -> bool:
+    """Bridge spans to an OpenTelemetry tracer if the SDK is available
+    (reference: tracing_helper's use of opentelemetry.trace)."""
+    global _otel_tracer
+    if tracer is not None:
+        _otel_tracer = tracer
+        return True
+    try:
+        from opentelemetry import trace as otel_trace
+
+        _otel_tracer = otel_trace.get_tracer("ray_tpu")
+        return True
+    except Exception:
+        return False

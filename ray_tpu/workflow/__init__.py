@@ -86,11 +86,13 @@ def _step_key(node: DAGNode, topo_index: int, structure: List[dict]) -> str:
 
 
 class _WorkflowRun:
-    def __init__(self, workflow_id: str, dag: DAGNode, input_val: Any):
+    def __init__(self, workflow_id: str, dag: DAGNode, input_val: Any,
+                 is_resume: bool = False):
         self.workflow_id = workflow_id
         self.dag = dag
         self.input_val = input_val
         self.dir = _wf_dir(workflow_id)
+        self.is_resume = is_resume
 
     def _meta_path(self):
         return os.path.join(self.dir, "workflow_meta.json")
@@ -139,6 +141,23 @@ class _WorkflowRun:
                     with open(ckpt, "rb") as f:
                         cache[node._stable_uuid] = pickle.load(f)
                     continue
+                if self.is_resume and not isinstance(
+                    node, (FunctionNode, MultiOutputNode, InputNode)
+                ):
+                    # Actor steps aren't checkpointed (module docstring):
+                    # the reference checkpoints every step, so diverging
+                    # SILENTLY would be a trap — say it loudly each time
+                    # a resume re-executes one.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "workflow %s resume: actor step %d (%s) has no "
+                        "checkpoint and will RE-EXECUTE — actor steps must "
+                        "be idempotent",
+                        self.workflow_id,
+                        i,
+                        type(node).__name__,
+                    )
                 out = node._execute_one(cache, self.input_val, ctx)
                 # resolve task outputs so the checkpoint stores values
                 if isinstance(out, ray_tpu.ObjectRef):
@@ -201,7 +220,7 @@ def resume(workflow_id: str) -> Any:
 
     with open(dag_blob, "rb") as f:
         dag, input_val = serialization.loads_function(f.read())
-    return _WorkflowRun(workflow_id, dag, input_val).execute()
+    return _WorkflowRun(workflow_id, dag, input_val, is_resume=True).execute()
 
 
 def get_output(workflow_id: str) -> Any:

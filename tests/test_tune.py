@@ -173,8 +173,10 @@ def test_pbt_exploits(ray_cluster, tmp_path):
             # The sleep keeps the population running concurrently: with
             # instant steps a trial can finish all 12 iterations before the
             # other trials report once, and PBT's quantile logic (correctly)
-            # refuses to exploit without a full population.
-            time.sleep(0.1)
+            # refuses to exploit without a full population.  0.25s and
+            # 16 iterations keep the population overlapping even when
+            # actor starts stagger by seconds on a loaded 1-core box.
+            time.sleep(0.25)
             self.value += self.config["lr"]
             return {"value": self.value}
 
@@ -202,7 +204,7 @@ def test_pbt_exploits(ray_cluster, tmp_path):
         param_space={"lr": tune.grid_search([0.1, 0.2, 5.0, 10.0])},
         tune_config=TuneConfig(metric="value", mode="max", scheduler=pbt),
         run_config=RunConfig(
-            name="pbt", storage_path=str(tmp_path), stop={"training_iteration": 12}
+            name="pbt", storage_path=str(tmp_path), stop={"training_iteration": 16}
         ),
     )
     results = tuner.fit()
@@ -211,8 +213,90 @@ def test_pbt_exploits(ray_cluster, tmp_path):
     # Exploitation: the bad trials (lr 0.1/0.2) clone a top trial's
     # checkpoint, so even the WORST final trajectory must beat the best
     # pure-bad-lr trajectory (12 * 0.2 = 2.4) by a wide margin.
-    assert min(finals) > 12 * 0.2 * 2
+    assert min(finals) > 16 * 0.2 * 2
     # Exploration: the exploited trials continue with a *mutated* config,
     # so some final lr must differ from every initial grid value.
     final_lrs = {r.metrics["config"]["lr"] for r in results if r.metrics}
     assert final_lrs - {0.1, 0.2, 5.0, 10.0}, f"no perturbed configs in {final_lrs}"
+
+
+def test_pb2_exploits_with_gp_bandit(ray_cluster, tmp_path):
+    """PB2: same exploit machinery as PBT, but new configs come from the
+    GP-bandit over population history and must respect the bounds."""
+
+    class PB2Trainable(tune.Trainable):
+        def setup(self, config):
+            self.value = 0.0
+
+        def step(self):
+            time.sleep(0.25)  # keep the population overlapping (see PBT test)
+            self.value += self.config["lr"]
+            return {"value": self.value}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "v.txt"), "w") as f:
+                f.write(str(self.value))
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "v.txt")) as f:
+                self.value = float(f.read())
+
+    pb2 = tune.PB2(
+        metric="value",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_bounds={"lr": [0.1, 10.0]},
+        quantile_fraction=0.5,
+        seed=0,
+    )
+    tuner = Tuner(
+        tune.with_resources(PB2Trainable, {"cpu": 0.25}),
+        param_space={"lr": tune.grid_search([0.1, 0.2, 5.0, 10.0])},
+        tune_config=TuneConfig(metric="value", mode="max", scheduler=pb2),
+        run_config=RunConfig(
+            name="pb2", storage_path=str(tmp_path), stop={"training_iteration": 16}
+        ),
+    )
+    results = tuner.fit()
+    assert results.num_errors == 0
+    finals = [r.metrics["value"] for r in results if r.metrics and "value" in r.metrics]
+    assert min(finals) > 16 * 0.2 * 2  # bad trials exploited a top trial
+    # the bandit saw population history
+    assert len(pb2._history) > 0
+    for r in results:
+        assert 0.1 <= r.config["lr"] <= 10.0  # selections respect bounds
+
+
+def test_bohb_searcher_with_hyperband(ray_cluster, tmp_path):
+    """TuneBOHB + HyperBandForBOHB: suggestions respect the space, the
+    KDE trains on intermediate (rung-budget) results, and the search
+    converges toward the good region."""
+
+    def objective(config):
+        for i in range(6):
+            tune.report({"score": -((config["x"] - 3.0) ** 2) - 0.1 * i ** 0.5})
+
+    searcher = tune.TuneBOHB(
+        space={"x": tune.uniform(-10.0, 10.0)},
+        metric="score",
+        mode="max",
+        n_startup_trials=4,
+        seed=1,
+    )
+    tuner = Tuner(
+        objective,
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            search_alg=searcher,
+            scheduler=tune.HyperBandForBOHB(metric="score", mode="max", max_t=6),
+            num_samples=16,
+        ),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert results.num_errors == 0
+    best = results.get_best_result(metric="score", mode="max")
+    assert abs(best.config["x"] - 3.0) < 3.0, best.config
+    # the model observed multiple budget levels (BOHB's point)
+    assert len(searcher._by_budget) >= 2, list(searcher._by_budget)

@@ -4,6 +4,7 @@ Reference test model: python/ray/tests/test_actor_pool.py, test_queue.py,
 test_metrics_agent.py, python/ray/tests/test_state_api.py.
 """
 
+import os
 import time
 
 import pytest
@@ -163,3 +164,85 @@ def test_metrics_roundtrip(ray_cluster):
 
     text = m.prometheus_text(recs)
     assert "test_requests_total" in text and 'le="+Inf"' in text
+
+
+def test_trace_context_propagates_across_tasks(ray_cluster):
+    """W3C trace context rides TaskSpec.trace_parent: every hop of a
+    distributed call tree shares one trace id (reference:
+    util/tracing/tracing_helper.py)."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def leaf():
+        return tracing.get_trace_id(), tracing.get_span_id()
+
+    @ray_tpu.remote
+    def mid():
+        here = tracing.get_trace_id()
+        sub_trace, _sub_span = ray_tpu.get(leaf.remote())
+        return here, sub_trace
+
+    with tracing.start_span("root") as root:
+        mid_trace, leaf_trace = ray_tpu.get(mid.remote(), timeout=60)
+    assert mid_trace == root.trace_id, "trace id lost at first hop"
+    assert leaf_trace == root.trace_id, "trace id lost at nested hop"
+    # untraced submissions carry no context
+    @ray_tpu.remote
+    def bare():
+        return tracing.get_trace_id()
+    assert ray_tpu.get(bare.remote(), timeout=60) is None
+    spans = tracing.drain_spans()
+    assert any(s["name"] == "root" for s in spans)
+
+
+def test_tracing_traceparent_format():
+    from ray_tpu.util import tracing
+
+    hdr = tracing.format_traceparent("a" * 32, "b" * 16)
+    assert tracing.parse_traceparent(hdr) == ("a" * 32, "b" * 16)
+    assert tracing.parse_traceparent("garbage") is None
+    assert tracing.parse_traceparent(None) is None
+
+
+def test_runtime_env_plugins(ray_cluster, tmp_path):
+    from ray_tpu import exceptions
+    from ray_tpu._private import runtime_env as renv
+
+    # validation: plugin keys accepted, bad values rejected
+    renv.validate({"conda": "myenv"})
+    renv.validate({"uv": ["requests"]})
+    renv.validate({"image_uri": "gcr.io/x/y:1"})
+    with pytest.raises(renv.RuntimeEnvError):
+        renv.validate({"uv": "not-a-list"})
+    with pytest.raises(renv.RuntimeEnvError):
+        renv.validate({"bogus_key": 1})
+
+    # custom plugin: registered, staged in priority order
+    staged = []
+
+    class MarkerPlugin(renv.RuntimeEnvPlugin):
+        name = "marker"
+        priority = 1
+
+        def stage(self, value, gcs_client, session_dir):
+            staged.append(value)
+            os.environ["MARKER_PLUGIN"] = str(value)
+
+    renv.register_plugin(MarkerPlugin())
+    try:
+        norm, uploads = renv.prepare({"marker": "hello"})
+        assert norm == {"marker": "hello"} and uploads == []
+        renv.stage_and_apply({"marker": "hello"}, None, str(tmp_path))
+        assert staged == ["hello"]
+        assert os.environ.pop("MARKER_PLUGIN") == "hello"
+    finally:
+        renv._plugins.pop("marker", None)
+        renv.SUPPORTED_KEYS.discard("marker")
+
+    # gated plugin fails LOUDLY end-to-end (no container runtime here)
+    @ray_tpu.remote(runtime_env={"image_uri": "gcr.io/x/y:1"}, max_retries=0)
+    def containered():
+        return 1
+
+    with pytest.raises(exceptions.RuntimeEnvSetupError):
+        ray_tpu.get(containered.remote(), timeout=120)
