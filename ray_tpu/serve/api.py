@@ -147,15 +147,22 @@ def run(
     http_port: Optional[int] = None,
     grpc_port: Optional[int] = None,
     _blocking: bool = False,
+    _local_testing_mode: bool = False,
 ) -> DeploymentHandle:
     """Deploy an application and return a handle (reference:
-    serve/api.py:492)."""
+    serve/api.py:492).  ``_local_testing_mode=True`` skips the cluster
+    entirely: the deployment runs in-process behind a handle with the
+    same calling convention (reference: local_testing_mode.py)."""
     import ray_tpu
     import time
 
-    controller = start(http_port=http_port, grpc_port=grpc_port)
     if isinstance(app, Deployment):
         app = app.bind()
+    if _local_testing_mode:
+        from ray_tpu.serve._private.local_testing_mode import run_local
+
+        return run_local(app)
+    controller = start(http_port=http_port, grpc_port=grpc_port)
     dep = app.deployment
     cfg = dep._config
     if route_prefix is not None:

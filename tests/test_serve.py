@@ -269,3 +269,43 @@ def test_long_poll_pushes_replica_set(serve_cluster):
         time.sleep(0.2)
     client.stop()
     assert any(len(s) == 2 for s in seen), f"no 2-replica snapshot pushed: {seen}"
+
+
+def test_local_testing_mode():
+    """serve.run(_local_testing_mode=True) needs NO cluster: the
+    deployment runs in-process with the normal handle convention,
+    including async methods and multiplexed model routing (reference:
+    serve/_private/local_testing_mode.py)."""
+
+    @serve.deployment
+    class Local:
+        def __init__(self, base):
+            self.base = base
+
+        async def __call__(self, x):
+            return self.base + x
+
+        def describe(self):
+            return "local"
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, mid):
+            return f"m-{mid}"
+
+        async def which_model(self, _):
+            return await self.get_model(serve.get_multiplexed_model_id())
+
+    h = serve.run(Local.bind(10), _local_testing_mode=True)
+    assert h.remote(5).result() == 15
+    assert h.describe.remote().result() == "local"
+    out = h.options(multiplexed_model_id="z").which_model.remote(None).result()
+    assert out == "m-z"
+
+    # errors propagate like DeploymentResponse.result does
+    @serve.deployment
+    def boom(payload):
+        raise ValueError("kapow")
+
+    hb = serve.run(boom.bind(), _local_testing_mode=True)
+    with pytest.raises(ValueError):
+        hb.remote(1).result()
