@@ -500,3 +500,44 @@ def test_impala_async_pipeline(ray_cluster):
     algo.cleanup()
     # async V-trace should at least double the initial return on CartPole
     assert best > 60, f"IMPALA made no progress: first={first_return} best={best}"
+
+
+def test_dqn_trains_and_syncs_target(ray_cluster):
+    """DQN mechanism smoke: replay fills, TD loss is finite and
+    shrinking-ish, epsilon anneals, target network syncs."""
+    from ray_tpu.rllib import DQNConfig
+
+    cfg = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=2)
+        .training(
+            lr=5e-4,
+            num_steps_sampled_before_learning_starts=200,
+            epsilon_decay_timesteps=1000,
+            target_network_update_freq=300,
+            updates_per_iteration=8,
+            sample_batch_size=64,
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    import jax
+    import numpy as np
+
+    target_before = jax.tree_util.tree_map(np.asarray, algo.learner.target_params)
+    eps0 = None
+    out = {}
+    for i in range(10):
+        out = algo.train()
+        eps0 = eps0 if eps0 is not None else out["epsilon"]
+    assert out["buffer_size"] >= 500
+    assert np.isfinite(out["total_loss"])
+    assert out["epsilon"] < eps0  # annealing
+    # target synced at least once (params moved from their init copy)
+    moved = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(a, np.asarray(b)),
+        target_before, algo.learner.target_params,
+    )
+    assert any(jax.tree_util.tree_leaves(moved)), "target network never synced"
+    algo.cleanup()
