@@ -1,0 +1,90 @@
+"""bench.py deadline ladder: the flagship bench must emit a parseable
+JSON record under BOTH a healthy backend and a wedged TPU tunnel.
+
+Round-4 postmortem: BENCH_r04.json was `{rc: 124, tail: "", parsed: null}`
+because the stage budgets summed past the driver's own timeout and the
+one JSON line printed only at the very end.  These tests pin the redesign:
+a bounded chip probe, a global deadline, and incremental emission —
+simulated-wedge included (BENCH_FAKE_WEDGE hangs backend init exactly the
+way the real tunnel does).
+
+Reference discipline: release/microbenchmark/run_microbenchmark.py
+(capture everything or say why).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(env_overrides, timeout):
+    env = dict(os.environ)
+    # the child must see the REAL platform selection logic, not the
+    # conftest CPU pin (the wedge prelude triggers only off-cpu)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = ""
+    env.update(env_overrides)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=timeout,
+    )
+    records = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    return proc, records
+
+
+def test_wedged_tunnel_still_emits_record():
+    """A hanging backend init (the real wedge signature) must still yield
+    parseable JSON lines well inside the global deadline."""
+    t0 = time.time()
+    proc, records = _run_bench(
+        {
+            "BENCH_FAKE_WEDGE": "1",
+            "BENCH_DEADLINE_S": "240",
+            "BENCH_PROBE_BUDGET_S": "5",
+            "BENCH_SKIP_PPO": "1",
+        },
+        timeout=280,
+    )
+    elapsed = time.time() - t0
+    assert records, f"no JSON records in output:\n{proc.stdout}\n{proc.stderr}"
+    final = records[-1]
+    assert final["metric"] == "gpt2_small_train_tokens_per_sec_per_chip"
+    assert final["on_tpu"] is False
+    assert final["value"] > 0, final
+    # every emitted line must be independently complete
+    for rec in records:
+        assert "value" in rec and "unit" in rec and "on_tpu" in rec
+    assert elapsed < 260, f"bench overran its deadline: {elapsed:.0f}s"
+
+
+def test_healthy_cpu_backend_full_record():
+    """With a healthy (CPU) backend the record carries the framework
+    number, the raw comparison, and the probe timing."""
+    proc, records = _run_bench(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_DEADLINE_S": "240",
+            "BENCH_SKIP_PPO": "1",
+        },
+        timeout=280,
+    )
+    assert records, f"no JSON records in output:\n{proc.stdout}\n{proc.stderr}"
+    final = records[-1]
+    assert final["value"] > 0
+    assert final["on_tpu"] is False  # cpu backend
+    assert "chip_probe_secs" in final
+    assert "raw_tokens_per_sec_per_chip" in final
+    # incremental emission: an interim record precedes the final one
+    assert len(records) >= 2
