@@ -159,11 +159,16 @@ def bench_actor_nn(n_pairs=4, n=1000) -> float:
 def bench_put_gbps(size_mb=256, repeat=3) -> float:
     arr = np.random.default_rng(0).integers(0, 255, size_mb << 20, dtype=np.uint8)
     best = None
-    for _ in range(repeat):
+    for i in range(repeat + 1):
         start = time.perf_counter()
         ref = ray_tpu.put(arr)
         t = time.perf_counter() - start
         del ref
+        # ref release is async (refcount message to the raylet): give it
+        # time to land or later puts measure eviction/spill, not memcpy
+        time.sleep(0.2)
+        if i == 0:
+            continue  # warmup: first put populates arena pages
         best = t if best is None else min(best, t)
     return (size_mb / 1024) / best
 
@@ -223,12 +228,24 @@ def main():
     args = parser.parse_args()
 
     ray_tpu.init(num_cpus=8)
+    import time as _time
+
+    _time.sleep(5)  # let the arena prefault thread drain before timing
     results = {}
     for name, fn, unit, baseline in BENCHES:
         if args.only and args.only not in name:
             continue
+        # capture-time load state (VERDICT r4 weak #2: every published
+        # number must carry the conditions it was measured under)
+        with open("/proc/loadavg") as f:
+            load1m = float(f.read().split()[0])
         value = fn()
-        rec = {"metric": name, "value": round(value, 2), "unit": unit}
+        rec = {
+            "metric": name,
+            "value": round(value, 2),
+            "unit": unit,
+            "loadavg_1m_at_capture": load1m,
+        }
         if baseline:
             rec["vs_baseline"] = round(value / baseline, 4)
         results[name] = rec

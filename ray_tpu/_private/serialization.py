@@ -44,7 +44,11 @@ def _maybe_devicearray_to_numpy(obj: Any) -> Any:
     never imports jax itself.
     """
     jax = sys.modules.get("jax")
-    if jax is not None and isinstance(obj, jax.Array):
+    # getattr, not attribute access: a worker dying mid-`import jax` has a
+    # partially initialized module in sys.modules without `Array`, and the
+    # ERROR-serialization path must never itself raise
+    jax_array = getattr(jax, "Array", None) if jax is not None else None
+    if jax_array is not None and isinstance(obj, jax_array):
         import numpy as np
 
         return np.asarray(obj)
@@ -57,7 +61,8 @@ class _Pickler(cloudpickle.Pickler):
 
     def reducer_override(self, obj):
         jax = sys.modules.get("jax")
-        if jax is not None and isinstance(obj, jax.Array):
+        jax_array = getattr(jax, "Array", None) if jax is not None else None
+        if jax_array is not None and isinstance(obj, jax_array):
             import numpy as np
 
             arr = np.asarray(obj)

@@ -8,7 +8,9 @@ Algorithm (a tune.Trainable).  Algorithms: PPO, DQN, IMPALA.
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, LearnerThread
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
@@ -46,6 +48,10 @@ __all__ = [
     "SACConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
+    "MARWIL",
+    "MARWILConfig",
     "LearnerThread",
     "MultiAgentEnv",
     "MultiAgentEnvRunner",

@@ -14,6 +14,8 @@ import pickle
 import time
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
+import numpy as np
+
 from ray_tpu.rllib.core.learner import LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
@@ -66,6 +68,11 @@ class AlgorithmConfig:
         # multi-agent (reference: algorithm_config.py multi_agent())
         self.policies: Optional[Dict[str, Any]] = None  # policy_id -> spec | None
         self.policy_mapping_fn: Optional[Callable[[str], str]] = None
+        # evaluation (reference: algorithm_config.py evaluation() —
+        # evaluation_interval/_num_env_runners/_duration)
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_num_env_runners = 0
+        self.evaluation_duration = 5  # episodes
         # debug
         self.seed = 0
 
@@ -131,6 +138,19 @@ class AlgorithmConfig:
     @property
     def is_multi_agent(self) -> bool:
         return bool(self.policies)
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_env_runners: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None):
+        """Configure the separate evaluation pass (reference:
+        algorithm_config.py evaluation()); duration is in episodes."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = evaluation_num_env_runners
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        return self
 
     def debugging(self, *, seed: Optional[int] = None):
         if seed is not None:
@@ -309,7 +329,63 @@ class Algorithm(Trainable):
         results.setdefault("timesteps_total", self._timesteps_total)
         results.update(self.env_runner_group.aggregate_metrics())
         results["time_this_iter_s"] = time.time() - t0
+        self._maybe_evaluate(results)
         return results
+
+    # -- evaluation (reference: algorithm.py evaluate() — a separate
+    # EnvRunnerGroup sampling deterministically, never the training
+    # runners) -----------------------------------------------------------
+    def _maybe_evaluate(self, results: Dict[str, Any]) -> None:
+        cfg = self.algo_config
+        if not cfg.evaluation_interval:
+            return
+        if cfg.env is None and cfg.env_creator is None:
+            return  # offline-only config without an env: nothing to roll out
+        # own counter: self.iteration is driver-dependent (the Tune
+        # driver sets it AFTER step(), standalone train() before), which
+        # would both shift the schedule and evaluate untrained weights
+        # on the very first step
+        self._train_iters_for_eval = getattr(self, "_train_iters_for_eval", 0) + 1
+        if self._train_iters_for_eval % cfg.evaluation_interval == 0:
+            results["evaluation"] = self.evaluate()
+
+    def _make_eval_runner_group(self) -> "EnvRunnerGroup":
+        cfg = self.algo_config
+        return EnvRunnerGroup(
+            cfg.make_env_creator(),
+            self.module_spec,
+            num_env_runners=cfg.evaluation_num_env_runners,
+            num_envs_per_runner=1,
+            rollout_fragment_length=32,
+            compute_advantages=False,
+            num_cpus_per_runner=cfg.num_cpus_per_env_runner,
+            seed=cfg.seed + 10_000,
+            inference_backend=cfg.inference_backend,
+            env_to_module=cfg.env_to_module,
+            module_to_env=cfg.module_to_env,
+        )
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Deterministic rollouts on dedicated eval runners; returns the
+        evaluation metrics dict (reference: algorithm.py evaluate()).
+
+        Algorithms whose policy is not the standard RLModule (DQN's
+        Q-net, SAC's squashed Gaussian) override this with their own
+        greedy rollout."""
+        cfg = self.algo_config
+        if cfg.is_multi_agent:
+            raise NotImplementedError("evaluate() is single-agent")
+        if getattr(self, "_eval_runner_group", None) is None:
+            self._eval_runner_group = self._make_eval_runner_group()
+        group = self._eval_runner_group
+        group.sync_weights(self.get_policy_weights())
+        returns = group.sample_episodes(cfg.evaluation_duration, explore=False)
+        return {
+            "num_episodes": len(returns),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_return_max": float(np.max(returns)),
+        }
 
     def train(self) -> Dict[str, Any]:
         """Standalone use: algo.train() outside a Tuner."""
@@ -380,6 +456,8 @@ class Algorithm(Trainable):
 
     def cleanup(self):
         self.env_runner_group.stop()
+        if getattr(self, "_eval_runner_group", None) is not None:
+            self._eval_runner_group.stop()
         if self.algo_config.is_multi_agent:
             for lg in self.learner_groups.values():
                 lg.shutdown()

@@ -16,7 +16,6 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.core.learner import Learner
-from ray_tpu.rllib.core.rl_module import RLModuleSpec
 from ray_tpu.rllib.utils.sample_batch import ACTIONS, OBS, SampleBatch
 
 
@@ -29,9 +28,6 @@ class BCConfig(AlgorithmConfig):
         self.num_epochs = 1
         self.input_: Any = None  # Dataset | list[dict] | path
         self.num_env_runners = 0
-        # evaluation rollouts (optional; BC itself never touches the env)
-        self.evaluation_interval: Optional[int] = None
-        self.evaluation_num_episodes = 5
 
     def offline_data(self, *, input_: Any = None):
         if input_ is not None:
@@ -64,23 +60,14 @@ class BC(Algorithm):
         self._dataset = _load_offline(cfg.input_)
         if self._dataset.count == 0:
             raise ValueError("BC offline input is empty")
-        # module spec from the data or from the (optional) env
-        if cfg.env is not None or cfg.env_creator is not None:
-            probe = cfg.make_env_creator()()
-            self.module_spec = RLModuleSpec.from_gym_env(
-                probe, hidden=tuple(cfg.model.get("hidden", (64, 64)))
-            )
-            probe.close()
-        else:
-            obs = np.asarray(self._dataset[OBS])
-            acts = np.asarray(self._dataset[ACTIONS])
-            discrete = np.issubdtype(acts.dtype, np.integer)
-            self.module_spec = RLModuleSpec(
-                observation_dim=int(np.prod(obs.shape[1:])),
-                action_dim=int(acts.max()) + 1 if discrete else int(np.prod(acts.shape[1:])),
-                discrete=discrete,
-                hidden=tuple(cfg.model.get("hidden", (64, 64))),
-            )
+        from ray_tpu.rllib.offline.offline_data import (
+            OfflineData,
+            module_spec_from_offline,
+        )
+
+        self.module_spec = module_spec_from_offline(
+            cfg, OfflineData(self._dataset)
+        )
         self.learner_group = LearnerGroup(
             BCLearner, self.module_spec, config=self._learner_config(), num_learners=cfg.num_learners
         )
@@ -98,12 +85,6 @@ class BC(Algorithm):
         )
         self._timesteps_total += batch.count
         metrics["num_env_steps_trained"] = self._timesteps_total
-        if (
-            cfg.evaluation_interval
-            and (cfg.env is not None or cfg.env_creator is not None)
-            and self.iteration % cfg.evaluation_interval == 0
-        ):
-            metrics["evaluation_return_mean"] = self.evaluate()
         return metrics
 
     def step(self) -> Dict[str, Any]:
@@ -113,32 +94,8 @@ class BC(Algorithm):
         out = self.training_step()  # no env runner group: offline only
         out.setdefault("timesteps_total", self._timesteps_total)
         out["time_this_iter_s"] = time.time() - t0
+        self._maybe_evaluate(out)
         return out
-
-    def evaluate(self) -> float:
-        """Greedy rollouts of the cloned policy (reference: BC eval via
-        evaluation env runners)."""
-        import jax
-
-        cfg = self.algo_config
-        env = cfg.make_env_creator()()
-        module = self.module_spec.build()
-        params = module.set_weights(self.learner_group.get_weights())
-        infer = jax.jit(module.forward_inference)
-        total = 0.0
-        for ep in range(cfg.evaluation_num_episodes):
-            obs, _ = env.reset(seed=cfg.seed + ep)
-            done = False
-            while not done:
-                a, _ = infer(params, obs[None])
-                a = np.asarray(a)[0]
-                if self.module_spec.discrete:
-                    a = int(a)
-                obs, r, term, trunc, _ = env.step(a)
-                total += float(r)
-                done = term or trunc
-        env.close()
-        return total / cfg.evaluation_num_episodes
 
     def save_checkpoint(self, checkpoint_dir: str):
         import os
@@ -165,44 +122,17 @@ class BC(Algorithm):
 
     def cleanup(self):
         self.learner_group.shutdown()
+        if getattr(self, "_eval_runner_group", None) is not None:
+            self._eval_runner_group.stop()
 
     stop = cleanup
 
 
 def _load_offline(input_: Any) -> SampleBatch:
-    """Materialize offline input into one flat SampleBatch."""
+    """Materialize offline input into one flat SampleBatch (delegates to
+    the shared offline-data plane, reference: rllib/offline)."""
+    from ray_tpu.rllib.offline.offline_data import _materialize
+
     if input_ is None:
         raise ValueError("BCConfig.offline_data(input_=...) is required")
-    if isinstance(input_, SampleBatch):
-        return input_
-    # ray_tpu.data Dataset
-    if hasattr(input_, "take_all"):
-        rows: List[dict] = input_.take_all()
-        return _rows_to_batch(rows)
-    if isinstance(input_, (list, tuple)):
-        return _rows_to_batch(list(input_))
-    if isinstance(input_, str):
-        import json
-        import os
-
-        rows = []
-        paths = (
-            [os.path.join(input_, f) for f in sorted(os.listdir(input_))]
-            if os.path.isdir(input_)
-            else [input_]
-        )
-        for p in paths:
-            with open(p) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        rows.append(json.loads(line))
-        return _rows_to_batch(rows)
-    raise TypeError(f"unsupported offline input type {type(input_).__name__}")
-
-
-def _rows_to_batch(rows: List[dict]) -> SampleBatch:
-    if not rows:
-        return SampleBatch({OBS: np.zeros((0, 1)), ACTIONS: np.zeros((0,))})
-    cols = {k: np.asarray([r[k] for r in rows]) for k in rows[0].keys()}
-    return SampleBatch(cols)
+    return _materialize(input_)

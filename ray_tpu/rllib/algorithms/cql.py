@@ -1,0 +1,295 @@
+"""CQL — Conservative Q-Learning for offline RL (reference:
+rllib/algorithms/cql/cql.py:390 + cql_torch_policy's loss: SAC plus a
+conservative regularizer that pushes down Q on out-of-distribution
+actions and up on dataset actions; Kumar et al. 2020).
+
+Builds on the SAC learner exactly as the reference's CQLConfig extends
+SACConfig.  Differences from SAC:
+  * purely offline: the dataset flows through
+    ray_tpu.rllib.offline.OfflineData — no env interaction, no replay
+    buffer (the dataset IS the buffer);
+  * critic loss adds min_q_weight * (logsumexp_a Q(s,a) - Q(s,a_data)),
+    with the logsumexp estimated over uniform + policy(s) + policy(s')
+    action samples, importance-corrected (the reference's num_actions
+    sampling in cql_torch_policy);
+  * the actor warms up with behavior cloning for the first ``bc_iters``
+    updates (reference: cql.py bc_iters) before switching to the SAC
+    actor loss — both branches live in ONE jitted program selected by a
+    traced flag, so the switch never recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rllib.offline import OfflineData
+from ray_tpu.rllib.utils.sample_batch import (
+    ACTIONS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+)
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.bc_iters = 200
+        self.temperature = 1.0
+        self.num_actions = 4      # sampled actions per logsumexp source
+        self.min_q_weight = 5.0
+        self.input_: Any = None
+        self.num_env_runners = 0
+
+    def offline_data(self, *, input_: Any = None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQLLearner(SACLearner):
+    """SAC learner + conservative penalty + BC actor warmup, all in one
+    fused jitted update (critic/actor/alpha optimizers + polyak sync)."""
+
+    def __init__(self, module_spec, config: Dict[str, Any]):
+        super().__init__(module_spec, config)
+        self._num_updates = 0
+
+    def _pi_logp_of(self, pi_params, obs, act_unscaled):
+        """log pi(a|s) of GIVEN (already unscaled to (-1,1)) actions
+        under the squashed Gaussian — atanh-transform + tanh-Jacobian."""
+        import jax.numpy as jnp
+
+        mean, log_std = self.pi_net.apply({"params": pi_params}, obs)
+        a = jnp.clip(act_unscaled, -0.999999, 0.999999)
+        pre_tanh = jnp.arctanh(a)
+        var = jnp.exp(2 * log_std)
+        logp_gauss = -0.5 * (
+            ((pre_tanh - mean) ** 2) / var + 2 * log_std + jnp.log(2 * jnp.pi)
+        ).sum(-1)
+        return logp_gauss - jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+
+    def _build_update_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        gamma = self.config.get("gamma", 0.99)
+        tau = self.config.get("tau", 0.005)
+        temp = self.config.get("temperature", 1.0)
+        n_act = self.config.get("num_actions", 4)
+        min_q_w = self.config.get("min_q_weight", 5.0)
+        adim = self.spec.action_dim
+
+        def sampled_q(q_params, pi_params, obs, rng):
+            """(B, 3*n_act) importance-corrected Q samples for the
+            logsumexp: uniform, pi(s), pi(s) fresh draws."""
+            B = obs.shape[0]
+            rep = jnp.repeat(obs, n_act, axis=0)  # (B*n_act, obs_dim)
+            r_unif, r_pi = jax.random.split(rng)
+            a_unif = jax.random.uniform(r_unif, (B * n_act, adim), minval=-1.0, maxval=1.0)
+            a_pi, logp_pi = self._pi_sample_logp(pi_params, rep, r_pi)
+            q1u, q2u = self.q_net.apply({"params": q_params}, rep, a_unif)
+            q1p, q2p = self.q_net.apply({"params": q_params}, rep, a_pi)
+            log_unif = -adim * jnp.log(2.0)  # U(-1,1)^adim density
+            logp_pi = jax.lax.stop_gradient(logp_pi)
+
+            def corrected(qu, qp):
+                cat = jnp.concatenate(
+                    [
+                        qu.reshape(B, n_act) - log_unif,
+                        qp.reshape(B, n_act) - logp_pi.reshape(B, n_act),
+                    ],
+                    axis=1,
+                )
+                return cat
+
+            return corrected(q1u, q1p), corrected(q2u, q2p)
+
+        def update(pi_params, q_params, target_q, log_alpha,
+                   pi_os, q_os, alpha_os, batch, rng, bc_phase):
+            rng_next, rng_pi, rng_cql, rng_cql2 = jax.random.split(rng, 4)
+            alpha = jnp.exp(log_alpha)
+            obs, next_obs = batch[OBS], batch[NEXT_OBS]
+            act = self._unscale(batch[ACTIONS])
+            rew = batch[REWARDS]
+            done = batch[TERMINATEDS].astype(jnp.float32)
+
+            next_a, next_logp = self._pi_sample_logp(pi_params, next_obs, rng_next)
+            tq1, tq2 = self.q_net.apply({"params": target_q}, next_obs, next_a)
+            target = rew + gamma * (1.0 - done) * (
+                jnp.minimum(tq1, tq2) - alpha * next_logp
+            )
+            target = jax.lax.stop_gradient(target)
+
+            def q_loss_fn(qp):
+                q1, q2 = self.q_net.apply({"params": qp}, obs, act)
+                bellman = ((q1 - target) ** 2 + (q2 - target) ** 2).mean() * 0.5
+                # conservative term: temp*logsumexp(Q/temp) - Q(s, a_data)
+                cat1, cat2 = sampled_q(qp, pi_params, obs, rng_cql)
+                ncat1, ncat2 = sampled_q(qp, pi_params, next_obs, rng_cql2)
+                lse1 = temp * jax.scipy.special.logsumexp(
+                    jnp.concatenate([cat1, ncat1], axis=1) / temp, axis=1
+                )
+                lse2 = temp * jax.scipy.special.logsumexp(
+                    jnp.concatenate([cat2, ncat2], axis=1) / temp, axis=1
+                )
+                gap = (lse1 - q1).mean() + (lse2 - q2).mean()
+                return bellman + min_q_w * gap, (q1.mean(), gap)
+
+            (q_loss, (q_mean, cql_gap)), q_grads = jax.value_and_grad(
+                q_loss_fn, has_aux=True
+            )(q_params)
+            q_up, q_os = self.q_opt.update(q_grads, q_os, q_params)
+            q_params = jax.tree_util.tree_map(lambda p, u: p + u, q_params, q_up)
+
+            # actor: BC warmup (alpha*logp - log pi(a_data|s)), then SAC
+            def pi_loss_fn(pp):
+                a, logp = self._pi_sample_logp(pp, obs, rng_pi)
+                q1, q2 = self.q_net.apply({"params": q_params}, obs, a)
+                sac_loss = (alpha * logp - jnp.minimum(q1, q2)).mean()
+                bc_loss = (alpha * logp - self._pi_logp_of(pp, obs, act)).mean()
+                return jnp.where(bc_phase, bc_loss, sac_loss), logp
+
+            (pi_loss, logp), pi_grads = jax.value_and_grad(
+                pi_loss_fn, has_aux=True
+            )(pi_params)
+            pi_up, pi_os = self.pi_opt.update(pi_grads, pi_os, pi_params)
+            pi_params = jax.tree_util.tree_map(lambda p, u: p + u, pi_params, pi_up)
+
+            def alpha_loss_fn(la):
+                return -(jnp.exp(la) * jax.lax.stop_gradient(logp + self.target_entropy)).mean()
+
+            alpha_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+            a_up, alpha_os = self.alpha_opt.update(a_grad, alpha_os, log_alpha)
+            log_alpha = log_alpha + a_up
+
+            target_q = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_q, q_params
+            )
+            metrics = {
+                "critic_loss": q_loss,
+                "actor_loss": pi_loss,
+                "alpha_loss": alpha_loss,
+                "alpha": jnp.exp(log_alpha),
+                "q_mean": q_mean,
+                "cql_gap": cql_gap,
+                "entropy": -logp.mean(),
+            }
+            return pi_params, q_params, target_q, log_alpha, pi_os, q_os, alpha_os, metrics
+
+        import jax
+
+        return jax.jit(update, donate_argnums=(1, 2, 4, 5, 6))
+
+    def update_from_batch(self, batch) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        self._rng, rng = jax.random.split(self._rng)
+        bc_phase = jnp.asarray(self._num_updates < self.config.get("bc_iters", 200))
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items() if k != "batch_indexes"}
+        (self.pi_params, self.q_params, self.target_q_params, self.log_alpha,
+         self.pi_opt_state, self.q_opt_state, self.alpha_opt_state, metrics) = self._update_fn(
+            self.pi_params, self.q_params, self.target_q_params, self.log_alpha,
+            self.pi_opt_state, self.q_opt_state, self.alpha_opt_state, jbatch, rng,
+            bc_phase,
+        )
+        self._num_updates += 1
+        self._metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        return self._metrics
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["num_updates"] = self._num_updates
+        return state
+
+    def set_state(self, state: Dict[str, Any]):
+        super().set_state(state)
+        self._num_updates = state.get("num_updates", 0)
+
+
+class CQL(SAC):
+    config_class = CQLConfig
+    learner_class = CQLLearner
+
+    def setup(self, config: Dict[str, Any]):
+        import gymnasium as gym
+
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        cfg = self.algo_config
+        self._dataset = OfflineData(cfg.input_, shuffle_seed=cfg.seed)
+        self._dataset.ensure_next_obs()
+        acts = np.asarray(self._dataset[ACTIONS], np.float32)
+        obs = np.asarray(self._dataset[OBS])
+        if acts.ndim == 1:
+            acts = acts[:, None]
+            self._dataset.batch[ACTIONS] = acts
+        self.module_spec = RLModuleSpec(
+            observation_dim=int(np.prod(obs.shape[1:])),
+            action_dim=int(acts.shape[-1]),
+            discrete=False,
+            hidden=tuple(cfg.model.get("hidden", (256, 256))),
+        )
+        lcfg = self._learner_config()
+        # action bounds: from the env when given, else the data envelope
+        if cfg.env is not None or cfg.env_creator is not None:
+            probe = cfg.make_env_creator()()
+            space = probe.action_space
+            if not isinstance(space, gym.spaces.Box):
+                probe.close()
+                raise ValueError("CQL requires a continuous (Box) action space")
+            lcfg["action_low"] = np.asarray(space.low, np.float32)
+            lcfg["action_high"] = np.asarray(space.high, np.float32)
+            probe.close()
+        else:
+            lcfg["action_low"] = acts.min(axis=0)
+            lcfg["action_high"] = acts.max(axis=0)
+        lcfg["hidden"] = tuple(cfg.model.get("hidden", (256, 256)))
+        self.learner = CQLLearner(self.module_spec, lcfg)
+        self._timesteps_total = 0
+
+    def _learner_config(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        out = super()._learner_config()
+        out.update(
+            bc_iters=cfg.bc_iters,
+            temperature=cfg.temperature,
+            num_actions=cfg.num_actions,
+            min_q_weight=cfg.min_q_weight,
+        )
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {"dataset_size": self._dataset.count}
+        for _ in range(cfg.updates_per_iteration):
+            batch = self._dataset.sample(min(cfg.train_batch_size, self._dataset.count))
+            metrics.update(self.learner.update_from_batch(batch))
+        self._timesteps_total += cfg.updates_per_iteration * cfg.train_batch_size
+        metrics["num_env_steps_trained"] = self._timesteps_total
+        return metrics
+
+    def load_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner.set_state(state["learner"])
+        self._timesteps_total = state.get("timesteps_total", 0)
+
+    def cleanup(self):
+        pass
+
+    stop = cleanup

@@ -228,7 +228,20 @@ class DQN(Algorithm):
         out = self.training_step()
         out.setdefault("timesteps_total", self._timesteps_total)
         out["time_this_iter_s"] = time.time() - t0
+        self._maybe_evaluate(out)
         return out
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy (argmax-Q) rollouts on a fresh env — the Q-net is not
+        an RLModule, so the base eval-runner path doesn't apply
+        (reference: DQN eval with explore=False)."""
+        cfg = self.algo_config
+        from ray_tpu.rllib.utils.evaluation import greedy_eval
+
+        act = lambda obs: int(  # noqa: E731
+            np.asarray(self.sampler._q_fn(self.learner.params, obs[None])).argmax()
+        )
+        return greedy_eval(cfg.make_env_creator(), act, cfg.evaluation_duration, cfg.seed)
 
     def save_checkpoint(self, checkpoint_dir: str):
         import os

@@ -348,7 +348,30 @@ class SAC(Algorithm):
         out = self.training_step()
         out.setdefault("timesteps_total", self._timesteps_total)
         out["time_this_iter_s"] = time.time() - t0
+        self._maybe_evaluate(out)
         return out
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Deterministic (tanh of the Gaussian mean) rollouts — the
+        squashed-Gaussian learner is not an RLModule, so the base
+        eval-runner path doesn't apply."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.algo_config
+        from ray_tpu.rllib.utils.evaluation import greedy_eval
+
+        learner = self.learner
+
+        @jax.jit
+        def mean_action(pi_params, obs):
+            mean, _ = learner.pi_net.apply({"params": pi_params}, obs)
+            return learner._scale(jnp.tanh(mean))
+
+        act = lambda obs: np.asarray(  # noqa: E731
+            mean_action(learner.pi_params, obs[None])
+        )[0]
+        return greedy_eval(cfg.make_env_creator(), act, cfg.evaluation_duration, cfg.seed)
 
     def save_checkpoint(self, checkpoint_dir: str):
         import os

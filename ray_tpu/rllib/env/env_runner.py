@@ -194,6 +194,25 @@ class SingleAgentEnvRunner:
                 batches.append(env_batch)
         return SampleBatch.concat_samples(batches)
 
+    def sample_episodes(self, num_episodes: int, explore: bool = False) -> List[float]:
+        """Reset, then step until ``num_episodes`` episodes complete;
+        return their returns (reference: env runner eval sampling with
+        duration_unit="episodes").
+
+        The reset matters on a CACHED eval runner: without it, episodes
+        left mid-flight by the previous evaluate() call would finish
+        under newly synced weights and blend two policies' returns."""
+        self._eval_calls = getattr(self, "_eval_calls", 0) + 1
+        obs, _ = self.envs.reset(seed=self.worker_index * 31 + self._eval_calls * 7919)
+        self._obs = obs
+        self._prev_done[:] = False
+        self._episode_returns[:] = 0.0
+        self._episode_lens[:] = 0
+        target = len(self._completed_returns) + num_episodes
+        while len(self._completed_returns) < target:
+            self.sample(num_steps=32, explore=explore)
+        return self._completed_returns[-num_episodes:]
+
     def get_metrics(self) -> Dict[str, Any]:
         out = {
             "num_episodes": len(self._completed_returns),

@@ -94,6 +94,24 @@ class EnvRunnerGroup:
             raise RuntimeError("all env runners failed")
         return SampleBatch.concat_samples(batches)
 
+    def sample_episodes(self, num_episodes: int, explore: bool = False) -> List[float]:
+        """Collect episode returns across runners (evaluation path;
+        reference: algorithm.py evaluate() duration-splitting across
+        eval workers)."""
+        if self.local_runner is not None:
+            return self.local_runner.sample_episodes(num_episodes, explore)
+        per = -(-num_episodes // len(self.runners))  # ceil split
+        refs = [r.sample_episodes.remote(per, explore) for r in self.runners]
+        returns: List[float] = []
+        for i, ref in enumerate(refs):
+            try:
+                returns.extend(self._ray.get(ref))
+            except Exception as e:  # noqa: BLE001 — tolerate lost runners
+                logger.warning("eval env runner %d failed: %s", i, e)
+        if not returns:
+            raise RuntimeError("all evaluation env runners failed")
+        return returns[:num_episodes]
+
     def aggregate_metrics(self) -> Dict[str, Any]:
         if self.local_runner is not None:
             per = [self.local_runner.get_metrics()]

@@ -1,0 +1,18 @@
+"""ray_tpu.rllib.offline — offline-RL data plane (reference:
+rllib/offline/ — offline_data.py, json_reader.py, json_writer.py,
+offline_env_runner.py).
+
+TPU-first shape: offline data is columnar from the moment it is read
+(one SampleBatch of contiguous numpy arrays, minibatches sliced by
+index), so the learner's fused jitted update consumes it with zero
+per-row Python work.  Reading flows through ray_tpu.data when given a
+Dataset; writing produces JSONL shards any Dataset reader can ingest.
+"""
+
+from ray_tpu.rllib.offline.offline_data import (
+    JsonWriter,
+    OfflineData,
+    record_rollouts,
+)
+
+__all__ = ["OfflineData", "JsonWriter", "record_rollouts"]

@@ -161,6 +161,77 @@ def cmd_submit(args):
     return subprocess.call(cmd, env=env)
 
 
+def cmd_serve_deploy(args):
+    """Apply a declarative Serve config (reference: serve/scripts.py
+    deploy)."""
+    from ray_tpu.serve.schema import ServeDeploySchema
+    from ray_tpu.serve.api import deploy_config
+
+    _connect(args)
+    schema = ServeDeploySchema.from_file(args.config_file)
+    statuses = deploy_config(schema)
+    for app, deps in statuses.items():
+        print(f"application {app!r}:")
+        for name in deps:
+            print(f"  deployed {name}")
+    return 0
+
+
+def cmd_serve_status(args):
+    from ray_tpu import serve
+
+    _connect(args)
+    print(json.dumps(serve.status(), indent=1, default=str))
+    return 0
+
+
+def cmd_serve_build(args):
+    """Emit a deploy config for an importable app (reference:
+    serve/scripts.py build)."""
+    from ray_tpu.serve.schema import ServeDeploySchema, build_app_schema
+
+    schema = ServeDeploySchema(
+        applications=[
+            build_app_schema(path, name=f"app{i}" if i else "default")
+            for i, path in enumerate(args.import_paths)
+        ]
+    )
+    if args.output:
+        schema.to_yaml(args.output)
+        print(f"wrote {args.output}")
+    else:
+        import yaml
+
+        print(yaml.safe_dump(schema.to_dict(), sort_keys=False))
+    return 0
+
+
+def cmd_serve_run(args):
+    """Deploy one importable app and block (reference: serve run)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import import_attr
+
+    _connect(args)
+    app = import_attr(args.import_path)
+    serve.run(app, http_port=args.port)
+    print(f"serving {args.import_path} on port {args.port}; ctrl-c to exit")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        serve.shutdown()
+    return 0
+
+
+def cmd_serve_shutdown(args):
+    from ray_tpu import serve
+
+    _connect(args)
+    serve.shutdown()
+    print("serve shut down")
+    return 0
+
+
 def _auto_address():
     from ray_tpu._private.node import CLUSTER_ADDRESS_FILE
 
@@ -227,6 +298,35 @@ def main(argv=None):
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_submit)
+
+    # serve config plane (reference: serve/scripts.py — serve
+    # build/deploy/status/run/shutdown)
+    ps = sub.add_parser("serve", help="model-serving config plane")
+    ssub = ps.add_subparsers(dest="serve_cmd", required=True)
+
+    p = ssub.add_parser("deploy", help="apply a YAML/JSON serve config")
+    p.add_argument("config_file")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve_deploy)
+
+    p = ssub.add_parser("status", help="deployment statuses")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve_status)
+
+    p = ssub.add_parser("build", help="emit a deploy config from importable apps")
+    p.add_argument("import_paths", nargs="+", help="module:attr of Application(s)")
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_serve_build)
+
+    p = ssub.add_parser("run", help="deploy one app and block")
+    p.add_argument("import_path")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve_run)
+
+    p = ssub.add_parser("shutdown", help="tear down all serve apps")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve_shutdown)
 
     args = parser.parse_args(argv)
     return args.fn(args)

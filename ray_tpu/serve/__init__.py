@@ -10,12 +10,19 @@ from ray_tpu.serve.api import (
     Application,
     Deployment,
     delete,
+    deploy_config,
     deployment,
     get_deployment_handle,
     run,
     shutdown,
     start,
     status,
+)
+from ray_tpu.serve.schema import (
+    ApplicationSchema,
+    DeploymentSchema,
+    ServeDeploySchema,
+    build_app_schema,
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
@@ -24,6 +31,11 @@ from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 __all__ = [
     "multiplexed",
     "get_multiplexed_model_id",
+    "deploy_config",
+    "ServeDeploySchema",
+    "ApplicationSchema",
+    "DeploymentSchema",
+    "build_app_schema",
     "deployment",
     "Deployment",
     "Application",

@@ -110,6 +110,16 @@ class LocalDeploymentHandle:
 
 
 def run_local(app) -> LocalDeploymentHandle:
-    """Build the Application's deployment in-process."""
+    """Build the Application's deployment graph in-process: nested
+    Applications in bind args become LocalDeploymentHandles, mirroring
+    the cluster path's handle substitution (api._deploy_graph) so graph
+    apps behave identically in both modes."""
+    from ray_tpu.serve.api import Application
+
+    def resolve(a):
+        return run_local(a) if isinstance(a, Application) else a
+
     dep = app.deployment
-    return LocalDeploymentHandle(dep._target, app.init_args, app.init_kwargs)
+    args = tuple(resolve(a) for a in app.init_args)
+    kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
+    return LocalDeploymentHandle(dep._target, args, kwargs)

@@ -19,12 +19,23 @@ _started = False
 
 class Application:
     """A deployment bound to init args (reference: Application =
-    Deployment.bind())."""
+    Deployment.bind()).  Init args may themselves be Applications —
+    run() deploys the whole graph and passes DeploymentHandles in their
+    place (reference: the deployment-graph bind pattern)."""
 
     def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
         self.deployment = deployment
         self.init_args = args
         self.init_kwargs = kwargs
+
+
+def walk_applications(app: "Application"):
+    """Yield app and every Application nested in its bind args,
+    dependencies first (deploy order)."""
+    for a in list(app.init_args) + list(app.init_kwargs.values()):
+        if isinstance(a, Application):
+            yield from walk_applications(a)
+    yield app
 
 
 class Deployment:
@@ -163,25 +174,125 @@ def run(
 
         return run_local(app)
     controller = start(http_port=http_port, grpc_port=grpc_port)
+    ingress_name = _deploy_graph(controller, app, route_prefix=route_prefix)
+    handle = DeploymentHandle(ingress_name, controller)
+    # wait for at least one running replica of every deployment in the app
+    deadline = time.monotonic() + 60
+    for sub in walk_applications(app):
+        name = sub.deployment._config.name
+        while time.monotonic() < deadline:
+            if ray_tpu.get(controller.get_replicas.remote(name)):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(f"deployment {name} failed to start replicas")
+    return handle
+
+
+def _deploy_graph(controller, app: Application, *, route_prefix: Optional[str]) -> str:
+    """Deploy app's dependency graph depth-first; nested Applications in
+    bind args become DeploymentHandles (they pickle by name, the replica
+    re-resolves its router).  Only the ingress (the root) gets a route.
+    Returns the ingress deployment name."""
+    import ray_tpu
+
+    def resolve(a):
+        if isinstance(a, Application):
+            return DeploymentHandle(_deploy_graph(controller, a, route_prefix=None))
+        return a
+
+    args = tuple(resolve(a) for a in app.init_args)
+    kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
     dep = app.deployment
-    cfg = dep._config
+    cfg = dataclasses.replace(dep._config)
     if route_prefix is not None:
         cfg.route_prefix = route_prefix
     if cfg.route_prefix is None:
         cfg.route_prefix = f"/{cfg.name}"
     cfg_dict = dataclasses.asdict(cfg)
-    init = (dep._target, app.init_args, app.init_kwargs)
+    init = (dep._target, args, kwargs)
     ray_tpu.get(controller.deploy.remote(cfg_dict, init))
-    handle = DeploymentHandle(cfg.name, controller)
-    # wait for at least one running replica
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        if ray_tpu.get(controller.get_replicas.remote(cfg.name)):
-            break
-        time.sleep(0.1)
-    else:
-        raise TimeoutError(f"deployment {cfg.name} failed to start replicas")
-    return handle
+    return cfg.name
+
+
+def deploy_config(schema) -> Dict[str, list]:
+    """Apply a declarative config against the controller (reference:
+    serve/scripts.py deploy → controller.apply_config; here the config
+    drives the SAME deploy path as serve.run, so replica-count and
+    version changes roll through long-poll pushes).
+
+    Returns {app_name: [deployment names deployed]}.
+    """
+    from ray_tpu.serve.schema import ServeDeploySchema, import_attr
+
+    if isinstance(schema, dict):
+        schema = ServeDeploySchema.from_dict(schema)
+    http_port = schema.http_options.get("port")
+    grpc_port = schema.grpc_options.get("port")
+    controller = start(http_port=http_port, grpc_port=grpc_port)
+    import ray_tpu
+
+    statuses: Dict[str, list] = {}
+    for app_schema in schema.applications:
+        target = import_attr(app_schema.import_path)
+        if isinstance(target, Deployment):
+            target = target.bind()
+        if not isinstance(target, Application):
+            raise TypeError(
+                f"{app_schema.import_path} resolved to {type(target).__name__}, "
+                "expected Application or Deployment"
+            )
+        # non-default apps get name-prefixed deployments so two apps with
+        # a same-named deployment class can't clobber each other
+        # (reference: schema.py scopes deployment names per application)
+        prefix = "" if app_schema.name == "default" else f"{app_schema.name}_"
+        app = _apply_overrides(
+            target, app_schema.deployment_overrides(), name_prefix=prefix
+        )
+        _deploy_graph(controller, app, route_prefix=app_schema.route_prefix)
+        names = [sub.deployment._config.name for sub in walk_applications(app)]
+        # wait for every deployment to reach its target
+        import time
+
+        deadline = time.monotonic() + 60
+        for name in names:
+            while time.monotonic() < deadline:
+                if ray_tpu.get(controller.get_replicas.remote(name)):
+                    break
+                time.sleep(0.1)
+            else:
+                raise TimeoutError(
+                    f"application {app_schema.name!r}: deployment {name!r} "
+                    "failed to start any replica within 60s"
+                )
+        statuses[app_schema.name] = names
+    return statuses
+
+
+def _apply_overrides(
+    app: Application,
+    overrides: Dict[str, Dict[str, Any]],
+    name_prefix: str = "",
+) -> Application:
+    """Rebuild the app graph with per-deployment config overrides applied
+    (reference: schema deployments[] merged over code defaults).
+    Overrides are keyed by the UNPREFIXED name the config file uses."""
+
+    def rebuild(a: Application) -> Application:
+        args = tuple(rebuild(x) if isinstance(x, Application) else x for x in a.init_args)
+        kwargs = {
+            k: rebuild(v) if isinstance(v, Application) else v
+            for k, v in a.init_kwargs.items()
+        }
+        dep = a.deployment
+        ov = dict(overrides.get(dep._config.name) or {})
+        if name_prefix:
+            ov["name"] = name_prefix + dep._config.name
+        if ov:
+            dep = dep.options(**ov)
+        return Application(dep, args, kwargs)
+
+    return rebuild(app)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

@@ -8,10 +8,15 @@ crashed/cancelled workflow resumes from the last completed step.
 Checkpoints are keyed by a content hash of the DAG *structure* (each
 node's type, target name, and parent positions); resuming a workflow_id
 whose DAG no longer matches the stored structure raises instead of
-silently mapping old checkpoints onto different steps.  Actor
-(ClassMethodNode) steps are NOT checkpointed — actor state can't be
-captured by pickling a method's return value — so they re-execute on
-resume; keep actor steps idempotent.
+silently mapping old checkpoints onto different steps.
+
+Actor (ClassMethodNode) steps checkpoint BOTH their outputs and, after
+each committed step, the actor's internal state via the actor's
+get_state()/set_state() hooks (the Checkpointable pattern,
+rllib/utils/checkpoints.py); a resume replays completed outputs from
+storage, re-creates the actor, and restores its snapshot before the
+first live step.  Actors without get_state() still replay outputs but
+re-build internal state from __init__ (warned once).
 """
 
 from __future__ import annotations
@@ -23,7 +28,14 @@ import pickle
 import time
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+from ray_tpu.dag import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
 
 __all__ = ["init", "run", "run_async", "resume", "get_output", "get_status", "list_all", "delete"]
 
@@ -97,6 +109,75 @@ class _WorkflowRun:
     def _meta_path(self):
         return os.path.join(self.dir, "workflow_meta.json")
 
+    # -- actor-state checkpoints (reference: every workflow step is
+    # checkpointed, workflow_executor.py:32; actor internals snapshot via
+    # the user's get_state/set_state — the Checkpointable pattern
+    # rllib/utils/checkpoints.py uses) -----------------------------------
+    def _snapshot_actor_state(self, node: ClassMethodNode, cache, path: str, snapshot_ok):
+        """Persist the actor's post-step state next to the step's output
+        checkpoint (written before it — see execute() on crash ordering)."""
+        import ray_tpu
+
+        class_node = node._bound_args[0]
+        uuid = class_node._stable_uuid
+        if snapshot_ok.get(uuid) is False:
+            return
+        actor = cache.get(uuid)
+        if actor is None:
+            return
+        try:
+            state = ray_tpu.get(actor.get_state.remote())
+            snapshot_ok[uuid] = True
+        except Exception:
+            if snapshot_ok.get(uuid):
+                # get_state WORKED for earlier steps: this is a transient
+                # failure, not a missing capability.  Swallowing it would
+                # let output checkpoints advance past the last snapshot —
+                # a resume would then restore stale state.  Fail the step
+                # (its output is not yet checkpointed, so resume
+                # re-executes it from the last good snapshot).
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "workflow %s: actor %s does not implement get_state(); its "
+                "internal state will not survive resume (completed step "
+                "OUTPUTS are still checkpointed and replayed)",
+                self.workflow_id,
+                type(class_node._actor_cls).__name__,
+            )
+            snapshot_ok[uuid] = False
+            return
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump(state, f, protocol=5)
+        os.replace(path + ".tmp", path)
+
+    def _restore_actor_state(self, node: ClassMethodNode, cache, latest_snapshot, restored):
+        """Before the first live method step on an actor during a resume,
+        load the snapshot of the newest output-checkpointed step."""
+        import ray_tpu
+
+        uuid = node._bound_args[0]._stable_uuid
+        if uuid in restored:
+            return
+        restored.add(uuid)
+        path = latest_snapshot.get(uuid)
+        if path is None:
+            return
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        actor = cache.get(uuid)
+        if actor is None:
+            return
+        try:
+            ray_tpu.get(actor.set_state.remote(state))
+        except Exception as e:
+            raise RuntimeError(
+                f"workflow {self.workflow_id}: actor has a state snapshot at "
+                f"{path} but set_state() failed — implement "
+                f"set_state(state) to make actor steps resumable: {e}"
+            ) from e
+
     def _write_meta(self, status: str):
         with open(self._meta_path(), "w") as f:
             json.dump({"status": status, "updated_at": time.time(), "workflow_id": self.workflow_id}, f)
@@ -133,31 +214,36 @@ class _WorkflowRun:
                 f.write(serialization.dumps_function((self.dag, self.input_val)))
         cache: Dict[str, Any] = {}
         ctx: dict = {"actors": {}}
+        # actor-state checkpointing (reference: workflow checkpoints every
+        # step, workflow_executor.py:32; RLlib's Checkpointable pattern).
+        # Snapshots are PER METHOD STEP (ckpt + ".actor_state") and
+        # written before the step's output checkpoint commits: a snapshot
+        # is only ever consulted through its step's output file, so a
+        # crash between the two writes leaves an orphan snapshot that is
+        # never restored — no stale-state/fresh-output mismatch in either
+        # direction.  While replaying cached steps we track the newest
+        # output-checkpointed snapshot per actor; the first live step on
+        # that actor restores it.
+        latest_snapshot: Dict[str, str] = {}  # class uuid -> snapshot path
+        restored: set = set()
+        snapshot_ok: Dict[str, bool] = {}
         try:
             for i, node in enumerate(order):
                 key = _step_key(node, i, structure)
                 ckpt = os.path.join(self.dir, key + ".pkl")
-                if os.path.exists(ckpt):
+                if not isinstance(node, ClassNode) and os.path.exists(ckpt):
                     with open(ckpt, "rb") as f:
                         cache[node._stable_uuid] = pickle.load(f)
+                    if isinstance(node, ClassMethodNode):
+                        snap = ckpt + ".actor_state"
+                        if os.path.exists(snap):
+                            latest_snapshot[node._bound_args[0]._stable_uuid] = snap
                     continue
-                if self.is_resume and not isinstance(
-                    node, (FunctionNode, MultiOutputNode, InputNode)
-                ):
-                    # Actor steps aren't checkpointed (module docstring):
-                    # the reference checkpoints every step, so diverging
-                    # SILENTLY would be a trap — say it loudly each time
-                    # a resume re-executes one.
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "workflow %s resume: actor step %d (%s) has no "
-                        "checkpoint and will RE-EXECUTE — actor steps must "
-                        "be idempotent",
-                        self.workflow_id,
-                        i,
-                        type(node).__name__,
-                    )
+                if isinstance(node, ClassMethodNode):
+                    # first live method step on this actor after a resume:
+                    # restore the state snapshotted alongside the last
+                    # checkpointed method step
+                    self._restore_actor_state(node, cache, latest_snapshot, restored)
                 out = node._execute_one(cache, self.input_val, ctx)
                 # resolve task outputs so the checkpoint stores values
                 if isinstance(out, ray_tpu.ObjectRef):
@@ -165,7 +251,11 @@ class _WorkflowRun:
                 elif isinstance(out, list) and out and isinstance(out[0], ray_tpu.ObjectRef):
                     out = ray_tpu.get(out)
                 cache[node._stable_uuid] = out
-                if isinstance(node, (FunctionNode, MultiOutputNode)):
+                if isinstance(node, ClassMethodNode):
+                    # snapshot first: if get_state fails, this step has no
+                    # output checkpoint and simply re-executes on resume
+                    self._snapshot_actor_state(node, cache, ckpt + ".actor_state", snapshot_ok)
+                if isinstance(node, (FunctionNode, MultiOutputNode, ClassMethodNode)):
                     with open(ckpt + ".tmp", "wb") as f:
                         pickle.dump(out, f, protocol=5)
                     os.replace(ckpt + ".tmp", ckpt)

@@ -161,3 +161,60 @@ def test_workflow_resume_skips_completed_steps(ray_cluster, tmp_path):
     with open(marker) as f:
         assert f.read() == "a"  # step_a ran exactly once
     assert workflow.get_status("wf_resume") == "SUCCESSFUL"
+
+
+def test_workflow_actor_steps_checkpoint_and_restore_state(ray_cluster, tmp_path):
+    """Actor steps checkpoint outputs AND actor state (get_state/set_state):
+    a resume replays completed actor-step outputs from storage and
+    restores the actor's counter before the first live step — no
+    re-execution of completed steps (VERDICT r4 ask #10; reference:
+    workflow_executor.py checkpoints every step)."""
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path))
+    calls_marker = str(tmp_path / "accum_calls")
+
+    @ray_tpu.remote
+    class Accumulator:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            with open(calls_marker, "a") as f:
+                f.write("x")
+            self.total += x
+            return self.total
+
+        def get_state(self):
+            return {"total": self.total}
+
+        def set_state(self, state):
+            self.total = state["total"]
+
+    flag_file = str(tmp_path / "crash_once_actor")
+
+    @ray_tpu.remote
+    def flaky_gate(x, flag=flag_file):
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            raise RuntimeError("simulated crash")
+        return x
+
+    acc = Accumulator.bind()
+    with InputNode() as inp:
+        first = acc.add.bind(inp)          # 0 + 7 = 7, checkpointed
+        gated = flaky_gate.bind(first)     # crashes on first run
+        dag = acc.add.bind(gated)          # resumed: needs total==7 restored
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf_actor", input_val=7)
+    assert workflow.get_status("wf_actor") == "FAILED"
+
+    out = workflow.resume("wf_actor")
+    # 7 (replayed from checkpoint) + 7 on a RESTORED total of 7 → 14
+    assert out == 14
+    with open(calls_marker) as f:
+        # first add ran once (original attempt); second add ran once
+        # (after resume); the first add was NOT re-executed on resume
+        assert f.read() == "xx"
+    assert workflow.get_status("wf_actor") == "SUCCESSFUL"

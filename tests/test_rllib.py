@@ -317,7 +317,7 @@ def test_bc_clones_cartpole_expert(ray_cluster):
     algo = cfg.build()
     for _ in range(15):
         out = algo.train()
-    ret = algo.evaluate()
+    ret = algo.evaluate()["episode_return_mean"]
     algo.cleanup()
     assert ret > 120, f"BC clone scored only {ret}"
     assert out["bc_logp"] > -0.5  # near-deterministic imitation
@@ -502,9 +502,11 @@ def test_impala_async_pipeline(ray_cluster):
     assert best > 60, f"IMPALA made no progress: first={first_return} best={best}"
 
 
-def test_dqn_trains_and_syncs_target(ray_cluster):
-    """DQN mechanism smoke: replay fills, TD loss is finite and
-    shrinking-ish, epsilon anneals, target network syncs."""
+def test_dqn_learns_cartpole(ray_cluster):
+    """Reward-gated DQN learning test (reference: rllib/BUILD:153
+    learning_tests_dqn_cartpole gates on reward, not mechanism): greedy
+    eval return must clear the bar within the step budget.  Mechanism
+    checks (epsilon anneal, target sync) ride along."""
     from ray_tpu.rllib import DQNConfig
 
     cfg = (
@@ -512,13 +514,15 @@ def test_dqn_trains_and_syncs_target(ray_cluster):
         .environment("CartPole-v1")
         .env_runners(num_envs_per_env_runner=2)
         .training(
-            lr=5e-4,
-            num_steps_sampled_before_learning_starts=200,
-            epsilon_decay_timesteps=1000,
-            target_network_update_freq=300,
-            updates_per_iteration=8,
+            lr=1e-3,
+            num_steps_sampled_before_learning_starts=500,
+            epsilon_decay_timesteps=4000,
+            target_network_update_freq=200,
+            updates_per_iteration=16,
             sample_batch_size=64,
+            train_batch_size=64,
         )
+        .evaluation(evaluation_duration=5)
         .debugging(seed=0)
     )
     algo = cfg.build()
@@ -528,13 +532,16 @@ def test_dqn_trains_and_syncs_target(ray_cluster):
     target_before = jax.tree_util.tree_map(np.asarray, algo.learner.target_params)
     eps0 = None
     out = {}
-    for i in range(10):
+    best = -np.inf
+    for i in range(120):
         out = algo.train()
         eps0 = eps0 if eps0 is not None else out["epsilon"]
-    assert out["buffer_size"] >= 500
-    assert np.isfinite(out["total_loss"])
+        if i >= 20 and i % 10 == 0:
+            best = max(best, algo.evaluate()["episode_return_mean"])
+            if best > 130:
+                break
+    assert best > 130, f"DQN failed to learn CartPole: best greedy eval={best}"
     assert out["epsilon"] < eps0  # annealing
-    # target synced at least once (params moved from their init copy)
     moved = jax.tree_util.tree_map(
         lambda a, b: not np.allclose(a, np.asarray(b)),
         target_before, algo.learner.target_params,

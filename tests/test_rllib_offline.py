@@ -1,0 +1,244 @@
+"""Offline-RL pipeline tests: OfflineData derivations, recorded-rollout
+round-trips, MARWIL/CQL learning thresholds, and the separate
+evaluation path.
+
+Reference test model: rllib/offline/tests/ (reader/writer round-trips)
+plus the BUILD learning_tests gating CQL/MARWIL on reward
+(rllib/BUILD:153-164), scaled to CI size."""
+
+import numpy as np
+import pytest
+
+
+def _expert_action(obs) -> int:
+    """Scripted CartPole expert: push toward the pole's lean (~200+ return)."""
+    return int(obs[2] + 0.5 * obs[3] > 0)
+
+
+def _cartpole_mixture_rows(n_steps=3000, expert_frac=0.5, seed=0):
+    """Mixed expert/random CartPole transitions with episode structure
+    (the advantage signal MARWIL needs: expert episodes are long, random
+    episodes short)."""
+    import gymnasium as gym
+
+    rng = np.random.default_rng(seed)
+    env = gym.make("CartPole-v1")
+    rows = []
+    eps = 0
+    use_expert = True
+    obs, _ = env.reset(seed=seed)
+    steps = 0
+    while steps < n_steps:
+        a = _expert_action(obs) if use_expert else int(rng.integers(0, 2))
+        next_obs, r, term, trunc, _ = env.step(a)
+        rows.append(
+            {
+                "obs": obs.astype(np.float32).tolist(),
+                "actions": a,
+                "rewards": float(r),
+                "terminateds": bool(term),
+                "truncateds": bool(trunc),
+                "eps_id": eps,
+            }
+        )
+        steps += 1
+        if term or trunc:
+            eps += 1
+            use_expert = rng.random() < expert_frac
+            obs, _ = env.reset(seed=seed + eps)
+        else:
+            obs = next_obs
+    env.close()
+    return rows
+
+
+def test_offline_data_next_obs_and_returns():
+    """NEXT_OBS shifts inside episodes only; VALUE_TARGETS are the
+    per-episode discounted returns-to-go."""
+    from ray_tpu.rllib.offline import OfflineData
+
+    rows = [
+        # episode 0: two steps
+        {"obs": [0.0], "actions": 0, "rewards": 1.0, "terminateds": False, "eps_id": 0},
+        {"obs": [1.0], "actions": 1, "rewards": 2.0, "terminateds": True, "eps_id": 0},
+        # episode 1: one step
+        {"obs": [5.0], "actions": 0, "rewards": 3.0, "terminateds": True, "eps_id": 1},
+    ]
+    ds = OfflineData(rows).ensure_next_obs().ensure_value_targets(gamma=0.5)
+    np.testing.assert_allclose(ds["next_obs"][:, 0], [1.0, 1.0, 5.0])
+    # returns-to-go: [1 + 0.5*2, 2, 3]
+    np.testing.assert_allclose(ds["value_targets"], [2.0, 2.0, 3.0])
+
+
+def test_record_rollouts_jsonl_roundtrip(tmp_path):
+    """record_rollouts persists JSONL that OfflineData reads back whole."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.offline import OfflineData, record_rollouts
+
+    out = str(tmp_path / "cartpole_random")
+    batch = record_rollouts(
+        lambda: gym.make("CartPole-v1"),
+        lambda obs: int(obs[2] > 0),
+        num_steps=120,
+        output_path=out,
+        seed=3,
+    )
+    assert batch.count == 120
+    ds = OfflineData(out)
+    assert ds.count == 120
+    np.testing.assert_allclose(
+        np.asarray(ds["obs"], np.float32), np.asarray(batch["obs"], np.float32), rtol=1e-6
+    )
+    assert ds["actions"].dtype.kind in "iu"
+    # sampling without replacement below count
+    s = ds.sample(32)
+    assert s.count == 32 and len(np.unique(s["rewards"], axis=0)) >= 1
+
+
+def test_marwil_learns_cartpole_from_mixed_data(ray_cluster):
+    """MARWIL (beta=1) on 50/50 expert/random data reaches expert-like
+    eval returns — the advantage weighting must upweight expert episodes
+    (reference: BUILD learning_tests_marwil_cartpole)."""
+    from ray_tpu.rllib import MARWILConfig
+
+    rows = _cartpole_mixture_rows(n_steps=4000, expert_frac=0.5, seed=1)
+    cfg = (
+        MARWILConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=rows)
+        .training(lr=1e-3, train_batch_size=2048, minibatch_size=256,
+                  num_epochs=2, beta=1.0)
+        .evaluation(evaluation_interval=10, evaluation_duration=5)
+        .debugging(seed=7)
+    )
+    algo = cfg.build()
+    best = -np.inf
+    for i in range(30):
+        out = algo.train()
+        if "evaluation" in out:
+            best = max(best, out["evaluation"]["episode_return_mean"])
+            if best > 120:
+                break
+    algo.cleanup()
+    assert best > 120, f"MARWIL failed to exceed mixed-data baseline: best={best}"
+
+
+def test_cql_learns_one_step_continuous_task(ray_cluster):
+    """CQL on a one-step continuous-control dataset recovers near-optimal
+    actions from noisy behavior data (reference: BUILD
+    learning_tests_cql_pendulum, scaled to a CI-sized task).
+
+    Env: obs ~ U(-1,1)^2, reward = -||a - 0.5*obs||^2, episode ends.
+    Behavior data: a = 0.5*obs + N(0, 0.3) — CQL must stay close to the
+    data manifold while improving on it."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import CQLConfig
+
+    class OneStepReach(gym.Env):
+        observation_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        action_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+
+        def __init__(self):
+            self._rng = np.random.default_rng(0)
+            self._obs = None
+
+        def reset(self, *, seed=None, options=None):
+            if seed is not None:
+                self._rng = np.random.default_rng(seed)
+            self._obs = self._rng.uniform(-1, 1, 2).astype(np.float32)
+            return self._obs, {}
+
+        def step(self, action):
+            r = -float(np.sum((np.asarray(action) - 0.5 * self._obs) ** 2))
+            return self._obs, r, True, False, {}
+
+    # behavior dataset
+    rng = np.random.default_rng(5)
+    obs = rng.uniform(-1, 1, (2000, 2)).astype(np.float32)
+    acts = np.clip(0.5 * obs + rng.normal(0, 0.3, obs.shape), -1, 1).astype(np.float32)
+    rews = -np.sum((acts - 0.5 * obs) ** 2, axis=1).astype(np.float32)
+    rows = [
+        {"obs": o.tolist(), "actions": a.tolist(), "rewards": float(r),
+         "terminateds": True, "truncateds": False, "eps_id": i}
+        for i, (o, a, r) in enumerate(zip(obs, acts, rews))
+    ]
+
+    cfg = (
+        CQLConfig()
+        .environment(env_creator=OneStepReach)
+        .offline_data(input_=rows)
+        .training(lr=3e-4, train_batch_size=256, bc_iters=64,
+                  min_q_weight=1.0, updates_per_iteration=64,
+                  model={"hidden": (64, 64)})
+        .evaluation(evaluation_duration=20)
+        .debugging(seed=11)
+    )
+    algo = cfg.build()
+    for _ in range(10):
+        out = algo.train()
+    ev = algo.evaluate()
+    algo.cleanup()
+    # random actions score ~ -E||a-t||^2 ≈ -1.2; behavior data mean ≈ -0.18;
+    # a learned policy must beat the behavior mean
+    assert ev["episode_return_mean"] > -0.15, (
+        f"CQL eval {ev['episode_return_mean']} worse than behavior data "
+        f"(mean {rews.mean():.3f})"
+    )
+    assert np.isfinite(out["cql_gap"])
+
+
+def test_cql_checkpoint_resumes_bc_phase(ray_cluster, tmp_path):
+    """bc_iters progress survives save/restore (the BC→SAC switch is
+    learner state, not a fresh counter)."""
+    from ray_tpu.rllib import CQLConfig
+
+    rng = np.random.default_rng(0)
+    obs = rng.uniform(-1, 1, (64, 1)).astype(np.float32)
+    rows = [
+        {"obs": o.tolist(), "actions": [float(o[0])], "rewards": 0.0,
+         "terminateds": True, "truncateds": False, "eps_id": i}
+        for i, o in enumerate(obs)
+    ]
+    cfg = (
+        CQLConfig()  # no env: action bounds come from the data envelope
+        .offline_data(input_=rows)
+        .training(train_batch_size=32, bc_iters=1000, updates_per_iteration=4,
+                  model={"hidden": (16,)})
+    )
+    algo = cfg.build()
+    algo.train()
+    assert algo.learner._num_updates == 4
+    ckpt = str(tmp_path)
+    algo.save_checkpoint(ckpt)
+    algo2 = cfg.build()
+    algo2.load_checkpoint(ckpt)
+    assert algo2.learner._num_updates == 4
+
+
+def test_ppo_evaluation_runners(ray_cluster):
+    """evaluate() uses SEPARATE eval runners with explore=False and the
+    evaluation_interval wiring lands results under 'evaluation'
+    (reference: algorithm.py evaluate())."""
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, rollout_fragment_length=64)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1)
+        .evaluation(evaluation_interval=2, evaluation_num_env_runners=1,
+                    evaluation_duration=3)
+    )
+    algo = cfg.build()
+    out1 = algo.train()
+    assert "evaluation" not in out1  # iteration 1: off-interval
+    out2 = algo.train()
+    ev = out2["evaluation"]
+    assert ev["num_episodes"] == 3
+    assert np.isfinite(ev["episode_return_mean"])
+    assert ev["episode_return_min"] <= ev["episode_return_mean"] <= ev["episode_return_max"]
+    # the eval group exists and is distinct from the training group
+    assert algo._eval_runner_group is not algo.env_runner_group
+    algo.cleanup()
