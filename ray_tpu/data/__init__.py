@@ -67,6 +67,10 @@ __all__ = [
     "from_huggingface",
     "read_webdataset",
     "read_text",
+    "read_avro",
+    "read_mongo",
+    "read_bigquery",
+    "read_iceberg",
 ]
 
 _builtin_range = range
@@ -174,3 +178,47 @@ def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
     """WebDataset-style .tar sample archives: files sharing a basename
     prefix become one row (reference: read_api.py read_webdataset)."""
     return read_datasource(WebDatasetDatasource(paths), parallelism=parallelism)
+
+
+def read_avro(paths, *, parallelism: int = -1) -> Dataset:
+    """Avro object container files via the in-repo OCF codec
+    (reference: read_api.py read_avro)."""
+    from ray_tpu.data.datasource import AvroDatasource
+
+    return read_datasource(AvroDatasource(paths), parallelism=parallelism)
+
+
+def read_mongo(database: str, collection: str, *, client_factory,
+               pipeline_filter=None, parallelism: int = -1) -> Dataset:
+    """MongoDB collection via an injected pymongo-compatible client
+    factory (reference: read_api.py read_mongo)."""
+    from ray_tpu.data.datasource import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(database, collection, client_factory=client_factory,
+                        pipeline_filter=pipeline_filter),
+        parallelism=parallelism,
+    )
+
+
+def read_bigquery(*, project_id: str, dataset: Optional[str] = None,
+                  query: Optional[str] = None, client_factory=None,
+                  parallelism: int = -1) -> Dataset:
+    """BigQuery table/query (reference: read_api.py read_bigquery);
+    client injectable for hermetic use."""
+    from ray_tpu.data.datasource import BigQueryDatasource
+
+    return read_datasource(
+        BigQueryDatasource(project_id=project_id, dataset=dataset, query=query,
+                           client_factory=client_factory),
+        parallelism=parallelism,
+    )
+
+
+def read_iceberg(metadata_path: str, *, parallelism: int = -1) -> Dataset:
+    """Apache Iceberg table scan: metadata JSON -> manifest list ->
+    manifests -> parquet data files (reference: read_api.py
+    read_iceberg)."""
+    from ray_tpu.data.datasource import IcebergDatasource
+
+    return read_datasource(IcebergDatasource(metadata_path), parallelism=parallelism)

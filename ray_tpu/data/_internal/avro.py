@@ -1,0 +1,324 @@
+"""Minimal Avro Object Container File codec (reference:
+python/ray/data/_internal/datasource/avro_datasource.py, which wraps
+fastavro; fastavro is not in this image, so the OCF format — header
+with embedded JSON schema, sync-marker-framed deflate/null blocks, and
+the binary record encoding — is implemented here directly).
+
+Supported schema types: null, boolean, int, long, float, double, bytes,
+string, record, enum, array, map, union, fixed — the full primitive +
+named set, which covers real-world Avro files including Iceberg
+manifests.  Logical types are surfaced as their underlying primitive.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# zig-zag varint primitives (the Avro binary wire encoding)
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # zig-zag decode
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zig-zag encode
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            break
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+def _write_bytes(out: io.BytesIO, data: bytes) -> None:
+    _write_long(out, len(data))
+    out.write(data)
+
+
+# ---------------------------------------------------------------------------
+# schema-driven value codec
+
+
+def _decode(schema: Any, buf: io.BytesIO) -> Any:
+    if isinstance(schema, list):  # union: long index then value
+        idx = _read_long(buf)
+        return _decode(schema[idx], buf)
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _decode(f["type"], buf) for f in schema["fields"]}
+        if t == "enum":
+            return schema["symbols"][_read_long(buf)]
+        if t == "array":
+            out = []
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:  # block with byte-size prefix
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    out.append(_decode(schema["items"], buf))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    k = _read_bytes(buf).decode("utf-8")
+                    out[k] = _decode(schema["values"], buf)
+            return out
+        if t == "fixed":
+            return buf.read(schema["size"])
+        return _decode(t, buf)  # {"type": "string", "logicalType": ...}
+    # primitive name
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) != b"\x00"
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema == "bytes":
+        return _read_bytes(buf)
+    if schema == "string":
+        return _read_bytes(buf).decode("utf-8")
+    raise ValueError(f"unsupported avro schema {schema!r}")
+
+
+def _encode(schema: Any, value: Any, out: io.BytesIO) -> None:
+    if isinstance(schema, list):  # union: pick first matching branch
+        for idx, branch in enumerate(schema):
+            if _matches(branch, value):
+                _write_long(out, idx)
+                _encode(branch, value, out)
+                return
+        raise TypeError(f"value {value!r} matches no union branch {schema!r}")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _encode(f["type"], value[f["name"]], out)
+            return
+        if t == "enum":
+            _write_long(out, schema["symbols"].index(value))
+            return
+        if t == "array":
+            if value:
+                _write_long(out, len(value))
+                for v in value:
+                    _encode(schema["items"], v, out)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if value:
+                _write_long(out, len(value))
+                for k, v in value.items():
+                    _write_bytes(out, k.encode("utf-8"))
+                    _encode(schema["values"], v, out)
+            _write_long(out, 0)
+            return
+        if t == "fixed":
+            out.write(value)
+            return
+        _encode(t, value, out)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+        return
+    if schema in ("int", "long"):
+        _write_long(out, int(value))
+        return
+    if schema == "float":
+        out.write(struct.pack("<f", value))
+        return
+    if schema == "double":
+        out.write(struct.pack("<d", value))
+        return
+    if schema == "bytes":
+        _write_bytes(out, value)
+        return
+    if schema == "string":
+        _write_bytes(out, value.encode("utf-8"))
+        return
+    raise ValueError(f"unsupported avro schema {schema!r}")
+
+
+def _matches(schema: Any, value: Any) -> bool:
+    t = schema["type"] if isinstance(schema, dict) else schema
+    if t == "null":
+        return value is None
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t in ("float", "double"):
+        return isinstance(value, float)
+    if t == "bytes" or t == "fixed":
+        return isinstance(value, (bytes, bytearray))
+    if t == "string":
+        return isinstance(value, str)
+    if t == "record" or t == "map":
+        return isinstance(value, dict)
+    if t == "array":
+        return isinstance(value, list)
+    if t == "enum":
+        return isinstance(value, str)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Object Container File
+
+
+def read_ocf(path: str) -> Tuple[dict, Iterator[dict]]:
+    """Returns (schema, row iterator) for an Avro OCF."""
+    f = open(path, "rb")
+    if f.read(4) != MAGIC:
+        f.close()
+        raise ValueError(f"{path} is not an Avro object container file")
+    buf = io.BytesIO(f.read())
+    f.close()
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = _read_long(buf)
+        if n == 0:
+            break
+        if n < 0:
+            _read_long(buf)
+            n = -n
+        for _ in range(n):
+            k = _read_bytes(buf).decode("utf-8")
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = buf.read(16)
+
+    def rows() -> Iterator[dict]:
+        while True:
+            try:
+                count = _read_long(buf)
+            except EOFError:
+                return
+            size = _read_long(buf)
+            block = buf.read(size)
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            elif codec != "null":
+                raise ValueError(f"unsupported avro codec {codec!r}")
+            bbuf = io.BytesIO(block)
+            for _ in range(count):
+                yield _decode(schema, bbuf)
+            if buf.read(16) != sync:
+                raise ValueError("avro sync marker mismatch (corrupt file)")
+
+    return schema, rows()
+
+
+def write_ocf(path: str, schema: dict, rows: List[dict], *, codec: str = "deflate") -> None:
+    """Write rows as an Avro OCF (single block)."""
+    body = io.BytesIO()
+    for row in rows:
+        _encode(schema, row, body)
+    block = body.getvalue()
+    if codec == "deflate":
+        comp = zlib.compressobj(wbits=-15)
+        block = comp.compress(block) + comp.flush()
+    elif codec != "null":
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    sync = os.urandom(16)
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode(), "avro.codec": codec.encode()}
+    _write_long(out, len(meta))
+    for k, v in meta.items():
+        _write_bytes(out, k.encode())
+        _write_bytes(out, v)
+    _write_long(out, 0)
+    out.write(sync)
+    _write_long(out, len(rows))
+    _write_bytes(out, block)
+    out.write(sync)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(out.getvalue())
+    os.replace(tmp, path)
+
+
+def schema_for_rows(rows: List[dict], name: str = "row") -> dict:
+    """Infer a permissive record schema from sample rows (write path)."""
+
+    def typ(v: Any) -> Any:
+        if v is None:
+            return "null"
+        if isinstance(v, bool):
+            return "boolean"
+        if isinstance(v, int):
+            return "long"
+        if isinstance(v, float):
+            return "double"
+        if isinstance(v, (bytes, bytearray)):
+            return "bytes"
+        if isinstance(v, str):
+            return "string"
+        if isinstance(v, list):
+            item = typ(v[0]) if v else "string"
+            return {"type": "array", "items": item}
+        if isinstance(v, dict):
+            val = typ(next(iter(v.values()))) if v else "string"
+            return {"type": "map", "values": val}
+        raise TypeError(f"cannot infer avro type for {type(v).__name__}")
+
+    fields = []
+    for key in rows[0].keys():
+        # infer from the first NON-NULL value (a None in row 0 must not
+        # collapse the column to "null" and silently drop real values)
+        sample = next((r[key] for r in rows if r.get(key) is not None), None)
+        t = typ(sample)
+        nullable = any(r.get(key) is None for r in rows)
+        fields.append(
+            {"name": key, "type": ["null", t] if nullable and t != "null" else t}
+        )
+    return {"type": "record", "name": name, "fields": fields}

@@ -411,6 +411,32 @@ class Dataset:
     def write_json(self, path: str) -> None:
         self.write_datasink(JSONDatasink(path))
 
+    def write_numpy(self, path: str, *, column: str = "data") -> None:
+        from ray_tpu.data.datasource import NumpyDatasink
+
+        self.write_datasink(NumpyDatasink(path, column=column))
+
+    def write_tfrecords(self, path: str) -> None:
+        from ray_tpu.data.datasource import TFRecordsDatasink
+
+        self.write_datasink(TFRecordsDatasink(path))
+
+    def write_avro(self, path: str) -> None:
+        from ray_tpu.data.datasource import AvroDatasink
+
+        self.write_datasink(AvroDatasink(path))
+
+    def write_webdataset(self, path: str) -> None:
+        from ray_tpu.data.datasource import WebDatasetDatasink
+
+        self.write_datasink(WebDatasetDatasink(path))
+
+    def write_images(self, path: str, *, column: str = "image",
+                     file_format: str = "png") -> None:
+        from ray_tpu.data.datasource import ImageDatasink
+
+        self.write_datasink(ImageDatasink(path, column=column, file_format=file_format))
+
     def __repr__(self) -> str:
         return f"Dataset(dag={self._dag.name()})"
 

@@ -279,24 +279,234 @@ class ImageDatasource(FileBasedDatasource):
 
 
 class TFRecordsDatasource(FileBasedDatasource):
-    """Minimal TFRecord reader: raw records as bytes rows (the reference
-    parses tf.train.Example; we expose bytes + a decode helper so torch/tf
-    are not required)."""
+    """TFRecord reader (reference: tfrecords_datasource.py).  With
+    ``parse_examples=True`` (default) each record is decoded as a
+    tf.train.Example into columns via the dependency-free codec in
+    _internal/tfrecord.py; ``parse_examples=False`` yields raw bytes."""
 
     _FILE_SUFFIXES = [".tfrecords", ".tfrecord"]
 
     def _read_file(self, path: str) -> Iterator[Block]:
-        records = []
-        with open(path, "rb") as f:
-            while True:
-                header = f.read(8)
-                if len(header) < 8:
-                    break
-                (length,) = np.frombuffer(header, dtype="<u8", count=1)
-                f.read(4)  # length crc
-                records.append(f.read(int(length)))
-                f.read(4)  # data crc
-        yield pa.table({"bytes": pa.array(records, type=pa.binary())})
+        from ray_tpu.data._internal import tfrecord
+
+        parse = self._read_args.get("parse_examples", True)
+        records = list(tfrecord.read_records(path))
+        if not parse:
+            yield pa.table({"bytes": pa.array(records, type=pa.binary())})
+            return
+        rows = []
+        for rec in records:
+            try:
+                rows.append(tfrecord.decode_example(rec))
+            except Exception:
+                rows = None  # not Example protos: fall back to raw bytes
+                break
+        if rows:
+            yield build_block(rows)
+        elif rows is None:
+            yield pa.table({"bytes": pa.array(records, type=pa.binary())})
+
+
+class AvroDatasource(FileBasedDatasource):
+    """Avro Object Container Files (reference: avro_datasource.py wraps
+    fastavro; here via the dependency-free OCF codec in _internal/avro.py —
+    embedded schema, null/deflate codecs, full primitive + named types)."""
+
+    _FILE_SUFFIXES = [".avro"]
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from ray_tpu.data._internal import avro
+
+        _schema, rows = avro.read_ocf(path)
+        batch = []
+        for row in rows:
+            batch.append(row)
+            if len(batch) >= 8192:
+                yield build_block(batch)
+                batch = []
+        if batch:
+            yield build_block(batch)
+
+
+class MongoDatasource(Datasource):
+    """MongoDB collection source (reference: mongo_datasource.py, which
+    wraps pymongoarrow).  pymongo is not in this image, so the client is
+    INJECTED: ``client_factory`` is a zero-arg callable returning an
+    object with the pymongo surface used here
+    (``client[db][coll].count_documents/find``) — pass
+    ``lambda: pymongo.MongoClient(uri)`` in real deployments, a stub in
+    hermetic tests.  Reads partition by skip/limit windows over a stable
+    _id sort."""
+
+    def __init__(self, database: str, collection: str, *,
+                 client_factory: Callable[[], Any],
+                 pipeline_filter: Optional[Dict[str, Any]] = None):
+        self._db = database
+        self._coll = collection
+        self._factory = client_factory
+        self._filter = pipeline_filter or {}
+
+    def get_name(self) -> str:
+        return "Mongo"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        client = self._factory()
+        total = client[self._db][self._coll].count_documents(self._filter)
+        db, coll, factory, filt = self._db, self._coll, self._factory, self._filter
+        n = max(1, min(parallelism, total or 1))
+        per = (total + n - 1) // n if total else 0
+        tasks = []
+        for i in range(n):
+            lo = i * per
+            limit = min(per, total - lo) if total else 0
+            if total and limit <= 0:
+                break
+
+            def read(lo=lo, limit=limit) -> Iterator[Block]:
+                c = factory()
+                cursor = (
+                    c[db][coll].find(filt).sort("_id", 1).skip(lo).limit(limit)
+                )
+                rows = [
+                    {k: v for k, v in doc.items() if k != "_id"} for doc in cursor
+                ]
+                if rows:
+                    yield build_block(rows)
+
+            tasks.append(ReadTask(read, BlockMetadata(num_rows=limit or None, size_bytes=None)))
+        return tasks
+
+
+class BigQueryDatasource(Datasource):
+    """BigQuery source (reference: bigquery_datasource.py).  The client
+    is injectable for hermetic tests; by default the
+    ``google.cloud.bigquery`` client is constructed lazily inside each
+    read task.  Reads partition the query/table with OFFSET windows."""
+
+    def __init__(self, *, project_id: str, dataset: Optional[str] = None,
+                 query: Optional[str] = None,
+                 client_factory: Optional[Callable[[], Any]] = None):
+        if (dataset is None) == (query is None):
+            raise ValueError("exactly one of dataset= or query= is required")
+        self._project = project_id
+        self._dataset = dataset
+        self._query = query or f"SELECT * FROM `{dataset}`"
+        self._factory = client_factory
+
+    def get_name(self) -> str:
+        return "BigQuery"
+
+    def _client(self):
+        if self._factory is not None:
+            return self._factory()
+        from google.cloud import bigquery
+
+        return bigquery.Client(project=self._project)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        src = self
+
+        def run_query(sql: str) -> List[dict]:
+            job = src._client().query(sql)
+            return [dict(row) for row in job.result()]
+
+        try:
+            total = int(
+                run_query(f"SELECT COUNT(*) AS n FROM ({src._query})")[0]["n"]
+            )
+        except Exception:
+            total = None
+        if not total or parallelism <= 1:
+            def read_all() -> Iterator[Block]:
+                rows = run_query(src._query)
+                if rows:
+                    yield build_block(rows)
+
+            return [ReadTask(read_all, BlockMetadata(num_rows=total, size_bytes=None))]
+        n = min(parallelism, total)
+        per = (total + n - 1) // n
+        tasks = []
+        for i in range(n):
+            lo = i * per
+            limit = min(per, total - lo)
+            if limit <= 0:
+                break
+
+            def read_window(lo=lo, limit=limit) -> Iterator[Block]:
+                # ORDER BY 1 pins a consistent order across independent
+                # window jobs — BigQuery gives no stable order without
+                # it, so windows would overlap/drop rows (same reason as
+                # SQLDatasource's window query)
+                rows = run_query(
+                    f"SELECT * FROM ({src._query}) ORDER BY 1 "
+                    f"LIMIT {limit} OFFSET {lo}"
+                )
+                if rows:
+                    yield build_block(rows)
+
+            tasks.append(ReadTask(read_window, BlockMetadata(num_rows=limit, size_bytes=None)))
+        return tasks
+
+
+class IcebergDatasource(Datasource):
+    """Apache Iceberg table source (reference: iceberg_datasource.py,
+    which wraps pyiceberg).  pyiceberg is not in this image; the table
+    spec is walked directly: table metadata JSON → current snapshot →
+    manifest list (Avro) → manifests (Avro) → parquet data files, all
+    through the in-repo Avro codec.  Deletes/positional files and
+    partition pruning are out of scope — full-scan reads only."""
+
+    def __init__(self, metadata_path: str):
+        self._meta_path = metadata_path
+
+    def get_name(self) -> str:
+        return "Iceberg"
+
+    def _data_files(self) -> List[str]:
+        import json as _json
+
+        from ray_tpu.data._internal import avro
+
+        with open(self._meta_path) as f:
+            meta = _json.load(f)
+        snap_id = meta.get("current-snapshot-id")
+        snapshot = next(
+            (s for s in meta.get("snapshots", []) if s["snapshot-id"] == snap_id),
+            None,
+        )
+        if snapshot is None:
+            return []
+        root = os.path.dirname(os.path.dirname(self._meta_path))
+
+        def local(p: str) -> str:
+            # spec paths are absolute URIs; strip scheme and remap under
+            # the table root so relocated tables stay readable
+            p = p.split("://", 1)[-1]
+            if os.path.exists(p):
+                return p
+            for marker in ("/metadata/", "/data/"):
+                if marker in p:
+                    return os.path.join(root, p[p.index(marker) + 1 :])
+            return p
+
+        _, manifests = avro.read_ocf(local(snapshot["manifest-list"]))
+        files: List[str] = []
+        for m in manifests:
+            _, entries = avro.read_ocf(local(m["manifest_path"]))
+            for e in entries:
+                if e.get("status") == 2:  # DELETED entry
+                    continue
+                df = e.get("data_file") or {}
+                path = df.get("file_path")
+                if path and df.get("content", 0) == 0:  # 0 = data (not deletes)
+                    files.append(local(path))
+        return files
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        files = self._data_files()
+        if not files:
+            return []
+        return ParquetDatasource(files).get_read_tasks(parallelism)
 
 
 # ---------------------------------------------------------------------------
@@ -352,6 +562,148 @@ class JSONDatasink(_FileDatasink):
     def _write_table(self, table: pa.Table, path: str) -> None:
         df = table.to_pandas()
         df.to_json(path, orient="records", lines=True)
+
+
+class NumpyDatasink(_FileDatasink):
+    """One .npy per block from a single column (reference:
+    numpy_datasink.py write_numpy column semantics)."""
+
+    def __init__(self, path: str, column: str = "data"):
+        super().__init__(path, "npy")
+        self._column = column
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        from ray_tpu.data.block import BlockAccessor as _BA
+
+        cols = _BA.for_block(table).to_numpy()
+        if self._column not in cols:
+            raise ValueError(
+                f"write_numpy: column {self._column!r} not in {list(cols)}"
+            )
+        np.save(path[: -len(".npy")], cols[self._column])
+
+
+class TFRecordsDatasink(_FileDatasink):
+    """Rows → tf.train.Example records with real CRC-32C framing
+    (reference: tfrecords_datasink.py; codec in _internal/tfrecord.py so
+    tensorflow is not required)."""
+
+    def __init__(self, path: str):
+        super().__init__(path, "tfrecords")
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        from ray_tpu.data._internal import tfrecord
+
+        rows = table.to_pylist()
+        with open(path, "wb") as f:
+            for row in rows:
+                tfrecord.write_record(f, tfrecord.encode_example(_tf_safe(row)))
+
+
+def _tf_safe(row: Dict[str, Any]) -> Dict[str, Any]:
+    """Example features support int64/float/bytes lists only."""
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        if isinstance(v, np.generic):
+            v = v.item()
+        out[k] = v
+    return out
+
+
+class AvroDatasink(_FileDatasink):
+    """Rows → Avro OCF shards with an inferred record schema
+    (_internal/avro.py; reference: fastavro-based write path)."""
+
+    def __init__(self, path: str):
+        super().__init__(path, "avro")
+
+    def _write_table(self, table: pa.Table, path: str) -> None:
+        from ray_tpu.data._internal import avro
+
+        rows = [_tf_safe(r) for r in table.to_pylist()]
+        if not rows:
+            # valid empty OCF: write() reports this path, so it must exist
+            avro.write_ocf(
+                path, {"type": "record", "name": "row", "fields": []}, []
+            )
+            return
+        avro.write_ocf(path, avro.schema_for_rows(rows), rows)
+
+
+class WebDatasetDatasink(Datasink):
+    """Samples → POSIX tar shards (reference: webdataset_datasink.py).
+    Each row needs a "__key__" column (auto-generated if absent); other
+    columns become files named <key>.<column>; bytes pass through, str
+    encodes utf-8, everything else serializes as JSON."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def on_write_start(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def write(self, blocks: Iterable[Block], ctx: Dict[str, Any]) -> Any:
+        import io as _io
+        import json as _json
+        import tarfile
+
+        written = []
+        for i, block in enumerate(blocks):
+            rows = BlockAccessor.for_block(block).to_arrow().to_pylist()
+            name = os.path.join(
+                self._path, f"shard-{ctx['task_idx']:05d}-{i:03d}.tar"
+            )
+            with tarfile.open(name, "w") as tf:
+                for j, row in enumerate(rows):
+                    key = row.get("__key__") or f"{ctx['task_idx']:05d}{j:07d}"
+                    for col, val in row.items():
+                        if col == "__key__":
+                            continue
+                        if isinstance(val, (bytes, bytearray)):
+                            data = bytes(val)
+                        elif isinstance(val, str):
+                            data = val.encode("utf-8")
+                        else:
+                            data = _json.dumps(_tf_safe({"v": val})["v"]).encode()
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(data)
+                        tf.addfile(info, _io.BytesIO(data))
+            written.append(name)
+        return written
+
+
+class ImageDatasink(Datasink):
+    """One image file per row from an array column (reference:
+    image_datasink.py; PIL encode)."""
+
+    def __init__(self, path: str, column: str = "image", file_format: str = "png"):
+        self._path = path
+        self._column = column
+        self._format = file_format
+
+    def on_write_start(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def write(self, blocks: Iterable[Block], ctx: Dict[str, Any]) -> Any:
+        from PIL import Image
+
+        written = []
+        for i, block in enumerate(blocks):
+            arrs = BlockAccessor.for_block(block).to_numpy()
+            if self._column not in arrs:
+                raise ValueError(
+                    f"write_images: column {self._column!r} not in {list(arrs)}"
+                )
+            for j, arr in enumerate(np.asarray(arrs[self._column])):
+                name = os.path.join(
+                    self._path,
+                    f"img-{ctx['task_idx']:05d}-{i:03d}-{j:05d}.{self._format}",
+                )
+                Image.fromarray(np.asarray(arr, np.uint8)).save(name)
+                written.append(name)
+        return written
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,7 @@ from ray_tpu.rllib.utils.sample_batch import (
     OBS,
     REWARDS,
     TERMINATEDS,
+    TRUNCATEDS,
 )
 
 
@@ -120,7 +121,14 @@ class CQLLearner(SACLearner):
             obs, next_obs = batch[OBS], batch[NEXT_OBS]
             act = self._unscale(batch[ACTIONS])
             rew = batch[REWARDS]
+            # Truncated boundaries count as done for the TARGET: the
+            # recorded dataset has no true next_obs there (ensure_next_obs
+            # copies the row's own obs), so bootstrapping from it would
+            # bias Q at every episode boundary.  Terminal zeroing is the
+            # lesser bias, and standard offline-RL practice.
             done = batch[TERMINATEDS].astype(jnp.float32)
+            if TRUNCATEDS in batch:
+                done = jnp.clip(done + batch[TRUNCATEDS].astype(jnp.float32), 0.0, 1.0)
 
             next_a, next_logp = self._pi_sample_logp(pi_params, next_obs, rng_next)
             tq1, tq2 = self.q_net.apply({"params": target_q}, next_obs, next_a)

@@ -166,11 +166,14 @@ class ServeController(LongPollHost):
                 self.notify_changed(lp_replicas_key(name), snapshot)
 
     def _push_route_table(self):
+        # route_prefix == "" means explicitly unrouted (internal
+        # deployments of a graph app — only the ingress is exposed)
         self.notify_changed(
             LP_ROUTE_TABLE,
             {
                 (dep["config"].get("route_prefix") or f"/{name}"): name
                 for name, dep in self.deployments.items()
+                if dep["config"].get("route_prefix") != ""
             },
         )
 

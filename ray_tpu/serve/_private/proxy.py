@@ -68,6 +68,7 @@ class ProxyActor:
         self._routes = {
             (dep["config"].get("route_prefix") or f"/{name}"): name
             for name, dep in deployments.items()
+            if dep["config"].get("route_prefix") != ""  # "" = unrouted
         }
 
     async def _await_ref(self, ref):

@@ -189,7 +189,8 @@ def run(
     return handle
 
 
-def _deploy_graph(controller, app: Application, *, route_prefix: Optional[str]) -> str:
+def _deploy_graph(controller, app: Application, *, route_prefix: Optional[str],
+                  ingress: bool = True) -> str:
     """Deploy app's dependency graph depth-first; nested Applications in
     bind args become DeploymentHandles (they pickle by name, the replica
     re-resolves its router).  Only the ingress (the root) gets a route.
@@ -198,7 +199,9 @@ def _deploy_graph(controller, app: Application, *, route_prefix: Optional[str]) 
 
     def resolve(a):
         if isinstance(a, Application):
-            return DeploymentHandle(_deploy_graph(controller, a, route_prefix=None))
+            return DeploymentHandle(
+                _deploy_graph(controller, a, route_prefix=None, ingress=False)
+            )
         return a
 
     args = tuple(resolve(a) for a in app.init_args)
@@ -208,7 +211,10 @@ def _deploy_graph(controller, app: Application, *, route_prefix: Optional[str]) 
     if route_prefix is not None:
         cfg.route_prefix = route_prefix
     if cfg.route_prefix is None:
-        cfg.route_prefix = f"/{cfg.name}"
+        # "" = explicitly unrouted: only the ingress defaults to an HTTP
+        # route; internal deployments stay handle-only (the reference
+        # exposes only the ingress)
+        cfg.route_prefix = f"/{cfg.name}" if ingress else ""
     cfg_dict = dataclasses.asdict(cfg)
     init = (dep._target, args, kwargs)
     ray_tpu.get(controller.deploy.remote(cfg_dict, init))

@@ -264,10 +264,15 @@ def test_iter_batches_early_break_no_leak(ray_cluster):
     for _ in range(3):
         for b in rd.range(1000, parallelism=4).iter_batches(batch_size=10, prefetch_batches=2):
             break
-    # Leases idle out after ~1s; wait past that so transient rpc-reader
-    # threads for leased workers don't count as leaks.
-    time.sleep(2.0)
-    leaked = live_names() - before
+    # Leases idle out after ~1s; poll past that (fixed sleeps flake on a
+    # loaded box where transient rpc-reader threads linger) so they don't
+    # count as leaks.
+    deadline = time.time() + 12.0
+    while True:
+        leaked = live_names() - before
+        if len(leaked) <= 1 or time.time() > deadline:
+            break
+        time.sleep(0.5)
     assert len(leaked) <= 1, f"leaked threads: {sorted(leaked)}"
 
 

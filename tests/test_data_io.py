@@ -1,0 +1,294 @@
+"""Data IO parity: new sinks (numpy/tfrecords/avro/webdataset/images)
+and sources (avro/mongo/bigquery/iceberg), all hermetic — external
+services are injected stubs, binary formats use the in-repo codecs
+(reference test model: python/ray/data/tests/test_{tfrecords,avro,
+mongo,bigquery}*.py with mocked clients)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# codec units
+
+
+def test_avro_ocf_roundtrip_full_types(tmp_path):
+    from ray_tpu.data._internal import avro
+
+    schema = {
+        "type": "record",
+        "name": "r",
+        "fields": [
+            {"name": "i", "type": "long"},
+            {"name": "f", "type": "double"},
+            {"name": "s", "type": "string"},
+            {"name": "b", "type": "bytes"},
+            {"name": "maybe", "type": ["null", "long"]},
+            {"name": "tags", "type": {"type": "array", "items": "string"}},
+            {"name": "props", "type": {"type": "map", "values": "long"}},
+            {"name": "color", "type": {"type": "enum", "name": "c", "symbols": ["R", "G"]}},
+            {"name": "nested", "type": {"type": "record", "name": "n", "fields": [
+                {"name": "x", "type": "int"}]}},
+        ],
+    }
+    rows = [
+        {"i": 1, "f": 2.5, "s": "hey", "b": b"\x00\x01", "maybe": None,
+         "tags": ["a", "b"], "props": {"k": 9}, "color": "G", "nested": {"x": 7}},
+        {"i": -42, "f": -0.5, "s": "", "b": b"", "maybe": 12,
+         "tags": [], "props": {}, "color": "R", "nested": {"x": -1}},
+    ]
+    path = str(tmp_path / "t.avro")
+    avro.write_ocf(path, schema, rows)
+    rschema, riter = avro.read_ocf(path)
+    assert rschema["name"] == "r"
+    assert list(riter) == rows
+    # null codec too
+    avro.write_ocf(path, schema, rows, codec="null")
+    _, riter = avro.read_ocf(path)
+    assert list(riter) == rows
+
+
+def test_tfrecord_example_roundtrip_and_crc(tmp_path):
+    from ray_tpu.data._internal import tfrecord
+
+    row = {"label": 3, "score": 0.5, "name": b"abc", "vec": [1.0, 2.0, 3.0],
+           "ids": [10, 20, -5]}
+    blob = tfrecord.encode_example(row)
+    back = tfrecord.decode_example(blob)
+    assert back["label"] == 3
+    assert back["score"] == pytest.approx(0.5)
+    assert back["name"] == b"abc"
+    assert back["vec"] == pytest.approx([1.0, 2.0, 3.0])
+    assert back["ids"] == [10, 20, -5]
+
+    path = str(tmp_path / "x.tfrecords")
+    with open(path, "wb") as f:
+        tfrecord.write_record(f, blob)
+    assert next(iter(tfrecord.read_records(path, verify_crc=True))) == blob
+    # corrupt one payload byte: CRC verification must catch it
+    data = bytearray(open(path, "rb").read())
+    data[14] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="crc"):
+        list(tfrecord.read_records(path, verify_crc=True))
+
+
+# ---------------------------------------------------------------------------
+# dataset-level sink/source round trips
+
+
+def test_write_read_tfrecords(ray_cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    out = str(tmp_path / "tfr")
+    rd.from_items(
+        [{"x": i, "w": float(i) / 2, "tag": f"t{i}".encode()} for i in range(20)]
+    ).write_tfrecords(out)
+    back = rd.read_tfrecords(out).take_all()
+    assert sorted(r["x"] for r in back) == list(range(20))
+    assert {r["tag"] for r in back} == {f"t{i}".encode() for i in range(20)}
+
+
+def test_write_read_avro(ray_cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    out = str(tmp_path / "avro")
+    rd.from_items(
+        [{"id": i, "name": f"row{i}", "score": i * 1.5} for i in range(25)]
+    ).write_avro(out)
+    assert any(f.endswith(".avro") for f in os.listdir(out))
+    back = rd.read_avro(out).take_all()
+    assert sorted(r["id"] for r in back) == list(range(25))
+    assert {r["name"] for r in back} == {f"row{i}" for i in range(25)}
+
+
+def test_write_read_numpy(ray_cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    out = str(tmp_path / "npy")
+    rd.from_numpy(np.arange(12.0).reshape(12, 1)).write_numpy(out)
+    back = rd.read_numpy(out).take_all()
+    vals = sorted(float(np.asarray(r["data"]).ravel()[0]) for r in back)
+    assert vals == [float(i) for i in range(12)]
+
+
+def test_write_read_webdataset(ray_cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    out = str(tmp_path / "wds")
+    rows = [
+        {"__key__": f"{i:04d}", "jpg": bytes([i] * 4), "json": {"label": i}}
+        for i in range(6)
+    ]
+    rd.from_items(rows).write_webdataset(out)
+    assert any(f.endswith(".tar") for f in os.listdir(out))
+    back = rd.read_webdataset(out).take_all()
+    assert sorted(r["__key__"] for r in back) == [f"{i:04d}" for i in range(6)]
+    by_key = {r["__key__"]: r for r in back}
+    assert by_key["0003"]["jpg"] == bytes([3] * 4)
+    assert by_key["0003"]["json"]["label"] == 3
+
+
+def test_write_read_images(ray_cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    out = str(tmp_path / "imgs")
+    imgs = np.stack([np.full((4, 4, 3), i * 10, np.uint8) for i in range(5)])
+    rd.from_numpy(imgs).map(lambda r: {"image": r["data"]}).write_images(out)
+    assert len(os.listdir(out)) == 5
+    back = rd.read_images(out).take_all()
+    means = sorted(int(np.asarray(r["image"]).mean()) for r in back)
+    assert means == [0, 10, 20, 30, 40]
+
+
+# ---------------------------------------------------------------------------
+# service-backed sources with injected stub clients
+
+
+class _StubMongoCursor:
+    def __init__(self, docs):
+        self._docs = docs
+
+    def sort(self, key, direction):
+        self._docs = sorted(self._docs, key=lambda d: d[key])
+        return self
+
+    def skip(self, n):
+        self._docs = self._docs[n:]
+        return self
+
+    def limit(self, n):
+        self._docs = self._docs[:n]
+        return self
+
+    def __iter__(self):
+        return iter(self._docs)
+
+
+class _StubMongoCollection:
+    DOCS = [{"_id": i, "val": i * 2, "name": f"d{i}"} for i in range(30)]
+
+    def count_documents(self, filt):
+        return len(self.DOCS)
+
+    def find(self, filt):
+        return _StubMongoCursor(list(self.DOCS))
+
+
+class _StubMongoClient:
+    def __getitem__(self, name):
+        return {"coll": _StubMongoCollection()}  # db -> collections
+
+
+def test_read_mongo_with_stub_client(ray_cluster):
+    import ray_tpu.data as rd
+
+    ds = rd.read_mongo("db", "coll", client_factory=_StubMongoClient, parallelism=4)
+    rows = ds.take_all()
+    assert sorted(r["val"] for r in rows) == [i * 2 for i in range(30)]
+    assert all("_id" not in r for r in rows)
+
+
+class _StubBQJob:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def result(self):
+        return self._rows
+
+
+class _StubBQClient:
+    TABLE = [{"n": i, "sq": i * i} for i in range(17)]
+
+    def query(self, sql):
+        base = "SELECT * FROM tbl"
+        if sql.startswith("SELECT COUNT(*)"):
+            return _StubBQJob([{"n": len(self.TABLE)}])
+        if "LIMIT" in sql:
+            import re
+
+            m = re.search(r"LIMIT (\d+) OFFSET (\d+)", sql)
+            limit, off = int(m.group(1)), int(m.group(2))
+            return _StubBQJob(self.TABLE[off : off + limit])
+        return _StubBQJob(list(self.TABLE))
+
+
+def test_read_bigquery_with_stub_client(ray_cluster):
+    import ray_tpu.data as rd
+
+    ds = rd.read_bigquery(
+        project_id="p", query="SELECT * FROM tbl",
+        client_factory=_StubBQClient, parallelism=3,
+    )
+    rows = ds.take_all()
+    assert sorted(r["n"] for r in rows) == list(range(17))
+    assert all(r["sq"] == r["n"] ** 2 for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# iceberg scan over a hand-built table
+
+
+def test_read_iceberg_scan(ray_cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import ray_tpu.data as rd
+    from ray_tpu.data._internal import avro
+
+    root = tmp_path / "tbl"
+    (root / "data").mkdir(parents=True)
+    (root / "metadata").mkdir()
+
+    # two parquet data files + one that a DELETED manifest entry drops
+    for name, lo in (("a.parquet", 0), ("b.parquet", 10), ("gone.parquet", 100)):
+        pq.write_table(
+            pa.table({"v": list(range(lo, lo + 10))}), str(root / "data" / name)
+        )
+
+    manifest_entry_schema = {
+        "type": "record", "name": "manifest_entry", "fields": [
+            {"name": "status", "type": "int"},
+            {"name": "data_file", "type": {"type": "record", "name": "data_file",
+             "fields": [
+                 {"name": "content", "type": "int"},
+                 {"name": "file_path", "type": "string"},
+                 {"name": "record_count", "type": "long"},
+             ]}},
+        ],
+    }
+    manifest_path = str(root / "metadata" / "m1.avro")
+    avro.write_ocf(manifest_path, manifest_entry_schema, [
+        {"status": 1, "data_file": {"content": 0,
+         "file_path": f"file://{root}/data/a.parquet", "record_count": 10}},
+        {"status": 1, "data_file": {"content": 0,
+         "file_path": f"file://{root}/data/b.parquet", "record_count": 10}},
+        {"status": 2, "data_file": {"content": 0,  # deleted entry: skipped
+         "file_path": f"file://{root}/data/gone.parquet", "record_count": 10}},
+    ])
+
+    mlist_schema = {
+        "type": "record", "name": "manifest_file", "fields": [
+            {"name": "manifest_path", "type": "string"},
+            {"name": "manifest_length", "type": "long"},
+        ],
+    }
+    mlist_path = str(root / "metadata" / "snap-1.avro")
+    avro.write_ocf(mlist_path, mlist_schema, [
+        {"manifest_path": f"file://{manifest_path}",
+         "manifest_length": os.path.getsize(manifest_path)},
+    ])
+
+    meta_path = str(root / "metadata" / "v2.metadata.json")
+    with open(meta_path, "w") as f:
+        json.dump({
+            "format-version": 2,
+            "current-snapshot-id": 1,
+            "snapshots": [{"snapshot-id": 1, "manifest-list": f"file://{mlist_path}"}],
+        }, f)
+
+    rows = rd.read_iceberg(meta_path).take_all()
+    assert sorted(r["v"] for r in rows) == list(range(20))
