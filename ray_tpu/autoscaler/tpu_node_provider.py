@@ -253,7 +253,9 @@ class TPUNodeProvider(NodeProvider):
             try:
                 updater.update()
                 return True
-            except CommandRunnerError as e:
+            except Exception as e:  # noqa: BLE001 — ssh timeouts,
+                # network errors etc. must mark the host failed, not
+                # escape and wedge the slice in 'pending' forever
                 import logging
 
                 logging.getLogger(__name__).warning(
@@ -345,29 +347,31 @@ class TPUNodeProvider(NodeProvider):
             # tick that called non_terminated_nodes (reference: updater
             # threads in autoscaler.py).
             with self._lock:
-                pending = [
-                    (nid, rec) for nid, rec in self._nodes.items()
-                    if rec["tags"].get(TAG_NODE_STATUS) == "pending"
-                    and not rec.get("bootstrapping")
-                ]
+                # claim inside the SAME lock acquisition as the snapshot:
+                # two concurrent reconcile callers must not both start a
+                # bootstrap for one slice (double `ray start` per host)
+                pending = []
+                for nid, rec in self._nodes.items():
+                    if (rec["tags"].get(TAG_NODE_STATUS) == "pending"
+                            and not rec.get("bootstrapping")):
+                        rec["bootstrapping"] = True
+                        pending.append((nid, rec))
             for nid, rec in pending:
-                if not self.is_running(nid):
-                    continue
-                if not self._has_bootstrap_commands:
-                    with self._lock:
-                        rec["tags"][TAG_NODE_STATUS] = "up-to-date"
-                    continue
-
                 def run_bootstrap(nid=nid, rec=rec):
-                    ok = self._bootstrap_slice(nid)
+                    final = None  # None = not READY yet: stays pending,
+                    # re-claimed on the next reconcile
+                    try:
+                        if self.is_running(nid):
+                            ok = (not self._has_bootstrap_commands
+                                  or self._bootstrap_slice(nid))
+                            final = "up-to-date" if ok else "update-failed"
+                    except Exception:  # noqa: BLE001 — never wedge 'pending'
+                        final = "update-failed"
                     with self._lock:
                         rec["bootstrapping"] = False
-                        rec["tags"][TAG_NODE_STATUS] = (
-                            "up-to-date" if ok else "update-failed"
-                        )
+                        if final is not None:
+                            rec["tags"][TAG_NODE_STATUS] = final
 
-                with self._lock:
-                    rec["bootstrapping"] = True
                 t = threading.Thread(
                     target=run_bootstrap, daemon=True,
                     name=f"slice-bootstrap-{nid}",

@@ -127,13 +127,71 @@ class _DashboardState:
         return out
 
     def prometheus_metrics(self) -> str:
+        """User metrics (util.metrics flushed through the GCS) PLUS
+        built-in operational gauges derived from cluster state, so a
+        cluster with zero user instrumentation still exports a real
+        scrape surface (reference: the C++ stats the reference exports
+        unconditionally — node count, resources, scheduler health)."""
         try:
             from ray_tpu.util import metrics as metrics_mod
 
-            records = self.gcs.call("metrics_get", None) or []
+            records = list(self.gcs.call("metrics_get", None) or [])
+            records.extend(self._builtin_metric_records())
             return metrics_mod.prometheus_text(records)
         except Exception:
             return ""
+
+    def _builtin_metric_records(self) -> list:
+        out = []
+
+        def gauge(name, desc, value, tags=None):
+            out.append({
+                "name": name, "type": "gauge", "description": desc,
+                "value": float(value), "tags": tags or {},
+            })
+
+        try:
+            status = self.cluster_status()
+            gauge("ray_tpu_nodes_alive", "alive raylet nodes", status["nodes_alive"])
+            gauge("ray_tpu_nodes_dead", "dead raylet nodes", status["nodes_dead"])
+            for k, v in status["resources_total"].items():
+                gauge("ray_tpu_resource_total", "cluster resource capacity", v,
+                      {"resource": k})
+            for k, v in status["resources_available"].items():
+                gauge("ray_tpu_resource_available", "cluster resource availability",
+                      v, {"resource": k})
+            gauge("ray_tpu_actors_alive", "alive actors",
+                  sum(1 for a in self.actors() if a.get("state") == "ALIVE"))
+        except Exception:
+            pass
+        # per-node raylet health (event-loop lag is the saturation signal
+        # the stress suite asserts on); per-node try so one unreachable
+        # raylet doesn't drop every later node's gauges from the scrape
+        try:
+            nodes = self.nodes()
+        except Exception:
+            nodes = []
+        for n in nodes:
+            try:
+                if n["state"] != "ALIVE":
+                    continue
+                stats = self._raylet(n["raylet_address"]).call("node_stats", {})
+                nid = n["node_id"][:12]
+                for key in ("event_loop_lag_ms", "event_loop_lag_max_ms",
+                            "num_workers", "queue_len", "infeasible",
+                            "num_tasks_dispatched", "num_tasks_spilled"):
+                    if key in stats:
+                        gauge(f"ray_tpu_raylet_{key}", f"raylet {key}",
+                              stats[key], {"node": nid})
+                store = stats.get("store", {})
+                for key in ("used_bytes", "capacity_bytes", "num_objects",
+                            "num_evictions", "num_spilled"):
+                    if key in store:
+                        gauge(f"ray_tpu_object_store_{key}", f"object store {key}",
+                              store[key], {"node": nid})
+            except Exception:
+                continue
+        return out
 
 
 def _html_table(title: str, rows: list) -> str:
@@ -223,6 +281,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(
                     200, self.state.prometheus_metrics().encode(), "text/plain; version=0.0.4"
                 )
+            if path == "/api/grafana_dashboard":
+                # importable Grafana JSON generated from the metrics this
+                # cluster actually exports (reference:
+                # modules/metrics/grafana_dashboard_factory.py)
+                from ray_tpu.dashboard.grafana_dashboard_factory import (
+                    generate_grafana_dashboard,
+                )
+
+                return self._json(
+                    generate_grafana_dashboard(self.state.prometheus_metrics())
+                )
             return self._error(404, f"no route {path}")
         except BrokenPipeError:
             pass
@@ -272,6 +341,17 @@ class _Handler(BaseHTTPRequestHandler):
         return self._error(404, f"no route {path}")
 
     def _index(self):
+        """Serve the SPA (static/index.html — tabbed tables polling the
+        /api endpoints; reference: dashboard/client).  Falls back to a
+        minimal server-rendered page if the asset is missing."""
+        import os
+
+        asset = os.path.join(os.path.dirname(__file__), "static", "index.html")
+        try:
+            with open(asset, "rb") as f:
+                return self._send(200, f.read(), "text/html")
+        except OSError:
+            pass
         import html as html_mod
 
         status = self.state.cluster_status()

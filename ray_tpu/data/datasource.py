@@ -291,19 +291,26 @@ class TFRecordsDatasource(FileBasedDatasource):
 
         parse = self._read_args.get("parse_examples", True)
         records = list(tfrecord.read_records(path))
-        if not parse:
+        if not parse or not records:
             yield pa.table({"bytes": pa.array(records, type=pa.binary())})
             return
         rows = []
         for rec in records:
             try:
-                rows.append(tfrecord.decode_example(rec))
+                row = tfrecord.decode_example(rec)
             except Exception:
-                rows = None  # not Example protos: fall back to raw bytes
+                row = None
+            if not row:
+                # decode failures AND decodes yielding no features: raw
+                # non-Example payloads can parse as wire-valid protobuf
+                # by accident, but never produce named features — fall
+                # back to raw bytes rather than emit garbage rows
+                rows = None
                 break
-        if rows:
+            rows.append(row)
+        if rows is not None:
             yield build_block(rows)
-        elif rows is None:
+        else:
             yield pa.table({"bytes": pa.array(records, type=pa.binary())})
 
 
@@ -433,12 +440,15 @@ class BigQueryDatasource(Datasource):
                 break
 
             def read_window(lo=lo, limit=limit) -> Iterator[Block]:
-                # ORDER BY 1 pins a consistent order across independent
-                # window jobs — BigQuery gives no stable order without
-                # it, so windows would overlap/drop rows (same reason as
-                # SQLDatasource's window query)
+                # TO_JSON_STRING(row) is a TOTAL order: ORDER BY 1 alone
+                # leaves ties on duplicate first-column values, and
+                # BigQuery's tie order differs between the independent
+                # window jobs (rows dropped/duplicated).  Ties under the
+                # JSON key are fully identical rows, where any
+                # assignment yields the same multiset.
                 rows = run_query(
-                    f"SELECT * FROM ({src._query}) ORDER BY 1 "
+                    f"SELECT * FROM ({src._query}) AS __rt "
+                    f"ORDER BY TO_JSON_STRING(__rt) "
                     f"LIMIT {limit} OFFSET {lo}"
                 )
                 if rows:

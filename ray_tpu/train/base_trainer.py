@@ -22,6 +22,10 @@ from ray_tpu.train._internal.backend_executor import (
 
 logger = logging.getLogger(__name__)
 
+# restore() override sentinel: distinguishes "not passed" from an
+# explicit None (resume_from_checkpoint=None = start fresh)
+_UNSET = object()
+
 
 class TrainingFailedError(RuntimeError):
     """Training failed after exhausting FailureConfig.max_failures
@@ -228,18 +232,20 @@ class DataParallelTrainer(BaseTrainer):
         cls,
         path: str,
         *,
-        train_loop_per_worker: Optional[Callable] = None,
-        train_loop_config: Optional[Dict[str, Any]] = None,
-        datasets: Optional[Dict[str, Any]] = None,
-        scaling_config: Optional[ScalingConfig] = None,
-        run_config: Optional[RunConfig] = None,
-        backend_config: Optional[BackendConfig] = None,
-        resume_from_checkpoint: Optional[Checkpoint] = None,
+        train_loop_per_worker: Any = _UNSET,
+        train_loop_config: Any = _UNSET,
+        datasets: Any = _UNSET,
+        scaling_config: Any = _UNSET,
+        run_config: Any = _UNSET,
+        backend_config: Any = _UNSET,
+        resume_from_checkpoint: Any = _UNSET,
     ) -> "DataParallelTrainer":
         """Typed restore (reference: train/base_trainer.py:250): the
         re-bindable fields are explicit parameters — the common case is
         re-passing `train_loop_per_worker` (closures don't pickle) and
-        `datasets` (live iterators don't either)."""
+        `datasets` (live iterators don't either).  An EXPLICIT
+        ``resume_from_checkpoint=None`` disables auto-resume (sentinel
+        default, so passing None is distinguishable from omitting)."""
         overrides = {
             k: v
             for k, v in dict(
@@ -251,7 +257,7 @@ class DataParallelTrainer(BaseTrainer):
                 backend_config=backend_config,
                 resume_from_checkpoint=resume_from_checkpoint,
             ).items()
-            if v is not None
+            if v is not _UNSET
         }
         return super().restore(path, **overrides)
 

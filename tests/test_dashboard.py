@@ -49,12 +49,62 @@ def test_state_endpoints(dash):
     ray_tpu.kill(m)
 
 
-def test_index_and_metrics(dash):
+def test_index_serves_spa_and_metrics(dash):
+    """GET / serves the self-contained SPA (tabbed tables polling the
+    /api endpoints — reference: dashboard/client app)."""
     with request.urlopen(dash + "/", timeout=10) as r:
         page = r.read().decode()
-    assert "ray_tpu cluster" in page
+    assert "<nav" in page and "/api/cluster_status" in page  # live-polling SPA
+    for endpoint in ("/api/nodes", "/api/actors", "/api/tasks", "/api/jobs"):
+        assert endpoint in page  # every entity tab wired to its API
     with request.urlopen(dash + "/metrics", timeout=10) as r:
         assert r.status == 200
+
+
+def test_grafana_dashboard_endpoint(dash):
+    """GET /api/grafana_dashboard returns importable Grafana JSON whose
+    panels cover the families the cluster exports (reference:
+    modules/metrics/grafana_dashboard_factory.py)."""
+    model = _get(dash, "/api/grafana_dashboard")
+    assert model["uid"] == "ray-tpu-default"
+    assert model["templating"]["list"][0]["name"] == "datasource"
+    with request.urlopen(dash + "/metrics", timeout=10) as r:
+        metrics_text = r.read().decode()
+    exported = {
+        line.split(None, 3)[2]
+        for line in metrics_text.splitlines()
+        if line.startswith("# TYPE ")
+    }
+    paneled = set()
+    for p in model["panels"]:
+        for t in p["targets"]:
+            expr = t["expr"]
+            paneled.add(
+                expr.split("rate(")[-1].split("[")[0].split("_bucket")[0]
+                if "(" in expr else expr
+            )
+    missing = exported - paneled
+    assert not missing, f"metrics with no panel: {missing}"
+
+
+def test_grafana_factory_query_shapes():
+    """Counters get rate() queries, histograms get quantile queries over
+    _bucket, gauges are raw."""
+    from ray_tpu.dashboard.grafana_dashboard_factory import generate_grafana_dashboard
+
+    text = (
+        "# HELP reqs total requests\n# TYPE reqs counter\nreqs 10\n"
+        "# TYPE depth gauge\ndepth 3\n"
+        "# TYPE lat histogram\nlat_bucket{le=\"1\"} 4\nlat_sum 2.0\nlat_count 4\n"
+    )
+    model = generate_grafana_dashboard(text)
+    by_title = {p["title"]: p for p in model["panels"]}
+    assert by_title["reqs"]["targets"][0]["expr"] == "rate(reqs[5m])"
+    assert by_title["depth"]["targets"][0]["expr"] == "depth"
+    lat_exprs = [t["expr"] for t in by_title["lat"]["targets"]]
+    assert any("histogram_quantile(0.99" in e and "lat_bucket" in e for e in lat_exprs)
+    assert len(lat_exprs) == 3
+    assert by_title["reqs"]["description"] == "total requests"
 
 
 def test_job_submission_lifecycle(dash, tmp_path):
