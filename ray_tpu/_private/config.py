@@ -127,6 +127,10 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # session dir so a restarted GCS resumes the cluster (reference: redis
     # persistence, redis_store_client.h:106).  "memory": no persistence.
     "gcs_storage": "file",
+    # External snapshot destination for head-NODE-loss recovery
+    # (reference: redis_store_client.h): "redis://[:pw@]host:port[/key]"
+    # or "file:///shared/mount/path"; "" = session-dir file.
+    "gcs_external_storage": "",
     "gcs_snapshot_interval_ms": 500,
     # How long raylets/drivers/workers retry reconnecting to a down GCS
     # before declaring it fatal (reference: gcs_rpc_server_reconnect_timeout_s).

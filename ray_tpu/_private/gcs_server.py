@@ -111,6 +111,9 @@ class GcsServer:
         await self.server.start()
         self._bg_tasks.append(self.loop.create_task(self._health_loop()))
         if CONFIG.gcs_storage == "file":
+            store = self._store()
+            if store is not None:
+                logger.info("GCS persistence backend: %s", store.describe())
             self._bg_tasks.append(self.loop.create_task(self._snapshot_loop()))
         from ray_tpu._private.common import event_loop_lag_loop
 
@@ -135,11 +138,18 @@ class GcsServer:
     # ------------------------------------------------------------------
     # persistence
     # ------------------------------------------------------------------
-    def _snapshot_path(self) -> Optional[str]:
-        import os
+    def _store(self):
+        """Pluggable persistence backend (reference:
+        redis_store_client.h:106): external Redis/shared-file via the
+        ``gcs_external_storage`` URI, else the session-dir file."""
+        if getattr(self, "_store_backend", None) is None:
+            from ray_tpu._private.gcs_store import make_snapshot_store
 
-        sd = self.session_info.get("session_dir")
-        return os.path.join(sd, "gcs_snapshot.pkl") if sd else None
+            self._store_backend = make_snapshot_store(
+                getattr(CONFIG, "gcs_external_storage", ""),
+                self.session_info.get("session_dir"),
+            )
+        return self._store_backend
 
     def _dirty(self):
         self._snapshot_dirty = True
@@ -156,11 +166,10 @@ class GcsServer:
                     logger.exception("GCS snapshot write failed")
 
     def _write_snapshot(self):
-        import os
         import pickle
 
-        path = self._snapshot_path()
-        if path is None:
+        store = self._store()
+        if store is None:
             return
         state = {
             "actors": self.actors,
@@ -171,23 +180,24 @@ class GcsServer:
             "jobs": self.jobs,
             "next_job_int": self.next_job_int,
         }
-        tmp = path + ".w"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f, protocol=5)
-        os.replace(tmp, path)
+        store.save(pickle.dumps(state, protocol=5))
 
     def _load_snapshot(self):
-        import os
         import pickle
 
-        path = self._snapshot_path()
-        if path is None or not os.path.exists(path):
+        store = self._store()
+        if store is None:
             return
         try:
-            with open(path, "rb") as f:
-                state = pickle.load(f)
+            blob = store.load()
+            if blob is None:
+                return
+            state = pickle.loads(blob)
         except Exception:
-            logger.exception("GCS snapshot load failed; starting fresh")
+            logger.exception(
+                "GCS snapshot load from %s failed; starting fresh",
+                store.describe(),
+            )
             return
         self.actors = state.get("actors", {})
         self.named_actors = state.get("named_actors", {})

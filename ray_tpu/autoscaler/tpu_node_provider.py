@@ -347,30 +347,36 @@ class TPUNodeProvider(NodeProvider):
             # tick that called non_terminated_nodes (reference: updater
             # threads in autoscaler.py).
             with self._lock:
-                # claim inside the SAME lock acquisition as the snapshot:
-                # two concurrent reconcile callers must not both start a
-                # bootstrap for one slice (double `ray start` per host)
-                pending = []
-                for nid, rec in self._nodes.items():
+                candidates = [
+                    (nid, rec) for nid, rec in self._nodes.items()
+                    if rec["tags"].get(TAG_NODE_STATUS) == "pending"
+                    and not rec.get("bootstrapping")
+                ]
+            # cheap READY pre-filter OUTSIDE the lock: a slice mid-
+            # provisioning must not spawn a thread per tick just to find
+            # it isn't running yet
+            ready = [(nid, rec) for nid, rec in candidates if self.is_running(nid)]
+            with self._lock:
+                # claim inside ONE lock acquisition: two concurrent
+                # reconcile callers must not both start a bootstrap for
+                # one slice (double `ray start` per host)
+                claimed = []
+                for nid, rec in ready:
                     if (rec["tags"].get(TAG_NODE_STATUS) == "pending"
                             and not rec.get("bootstrapping")):
                         rec["bootstrapping"] = True
-                        pending.append((nid, rec))
-            for nid, rec in pending:
+                        claimed.append((nid, rec))
+            for nid, rec in claimed:
                 def run_bootstrap(nid=nid, rec=rec):
-                    final = None  # None = not READY yet: stays pending,
-                    # re-claimed on the next reconcile
                     try:
-                        if self.is_running(nid):
-                            ok = (not self._has_bootstrap_commands
-                                  or self._bootstrap_slice(nid))
-                            final = "up-to-date" if ok else "update-failed"
+                        ok = (not self._has_bootstrap_commands
+                              or self._bootstrap_slice(nid))
+                        final = "up-to-date" if ok else "update-failed"
                     except Exception:  # noqa: BLE001 — never wedge 'pending'
                         final = "update-failed"
                     with self._lock:
                         rec["bootstrapping"] = False
-                        if final is not None:
-                            rec["tags"][TAG_NODE_STATUS] = final
+                        rec["tags"][TAG_NODE_STATUS] = final
 
                 t = threading.Thread(
                     target=run_bootstrap, daemon=True,

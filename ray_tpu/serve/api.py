@@ -94,9 +94,13 @@ def deployment(
     return wrap
 
 
-def start(http_port: Optional[int] = None, grpc_port: Optional[int] = None) -> Any:
+def start(http_port: Optional[int] = None, grpc_port: Optional[int] = None,
+          grpc_servicer_functions: Optional[list] = None) -> Any:
     """Start (or connect to) the Serve controller; optionally the HTTP
-    and/or gRPC proxies (reference: serve.start + proxy bring-up)."""
+    and/or gRPC proxies (reference: serve.start + proxy bring-up).
+    ``grpc_servicer_functions``: dotted paths of protoc-generated
+    add_XServicer_to_server functions for TYPED gRPC services
+    (reference: grpc_options.grpc_servicer_functions)."""
     global _started
     import ray_tpu
 
@@ -116,7 +120,7 @@ def start(http_port: Optional[int] = None, grpc_port: Optional[int] = None) -> A
     if http_port is not None:
         _ensure_proxy(controller, http_port)
     if grpc_port is not None:
-        _ensure_grpc_proxy(controller, grpc_port)
+        _ensure_grpc_proxy(controller, grpc_port, grpc_servicer_functions or [])
     return controller
 
 
@@ -135,19 +139,32 @@ def _ensure_proxy(controller, port: int):
         ray_tpu.get(proxy.ready.remote())
 
 
-def _ensure_grpc_proxy(controller, port: int):
+def _ensure_grpc_proxy(controller, port: int, servicer_functions=()):
     import ray_tpu
 
     from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
 
     name = "SERVE_GRPC_PROXY"
     try:
-        ray_tpu.get_actor(name, "serve")
+        proxy = ray_tpu.get_actor(name, "serve")
     except Exception:
         proxy = ray_tpu.remote(
             name=name, namespace="serve", num_cpus=0.1, max_concurrency=1000
-        )(GrpcProxyActor).remote(port)
+        )(GrpcProxyActor).remote(port, servicer_functions=tuple(servicer_functions))
         ray_tpu.get(proxy.ready.remote())
+        return
+    if servicer_functions:
+        # gRPC can't register handlers after server start: requesting NEW
+        # typed services against a live proxy must fail loudly, not serve
+        # UNIMPLEMENTED (reference: grpc_options are start-time config)
+        registered = set(ray_tpu.get(proxy.registered_servicers.remote()))
+        missing = [f for f in servicer_functions if f not in registered]
+        if missing:
+            raise ValueError(
+                f"gRPC proxy is already running without typed service(s) "
+                f"{missing}; grpc_servicer_functions must be passed when the "
+                f"proxy FIRST starts — serve.shutdown() and re-run with them"
+            )
 
 
 def run(
@@ -157,6 +174,7 @@ def run(
     route_prefix: Optional[str] = None,
     http_port: Optional[int] = None,
     grpc_port: Optional[int] = None,
+    grpc_servicer_functions: Optional[list] = None,
     _blocking: bool = False,
     _local_testing_mode: bool = False,
 ) -> DeploymentHandle:
@@ -173,7 +191,8 @@ def run(
         from ray_tpu.serve._private.local_testing_mode import run_local
 
         return run_local(app)
-    controller = start(http_port=http_port, grpc_port=grpc_port)
+    controller = start(http_port=http_port, grpc_port=grpc_port,
+                       grpc_servicer_functions=grpc_servicer_functions)
     ingress_name = _deploy_graph(controller, app, route_prefix=route_prefix)
     handle = DeploymentHandle(ingress_name, controller)
     # wait for at least one running replica of every deployment in the app
@@ -235,7 +254,14 @@ def deploy_config(schema) -> Dict[str, list]:
         schema = ServeDeploySchema.from_dict(schema)
     http_port = schema.http_options.get("port")
     grpc_port = schema.grpc_options.get("port")
-    controller = start(http_port=http_port, grpc_port=grpc_port)
+    controller = start(
+        http_port=http_port, grpc_port=grpc_port,
+        # accept the reference's key name and the short form
+        grpc_servicer_functions=(
+            schema.grpc_options.get("grpc_servicer_functions")
+            or schema.grpc_options.get("servicer_functions")
+        ),
+    )
     import ray_tpu
 
     statuses: Dict[str, list] = {}

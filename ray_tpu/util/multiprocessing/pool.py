@@ -65,23 +65,37 @@ class AsyncResult:
             pass
 
     def _resolve(self, timeout: Optional[float]):
+        # Wait OUTSIDE the lock: the background callback waiter holds an
+        # untimed wait, and get(timeout=...) must still be able to raise
+        # TimeoutError while it blocks (joblib's timeout retrieval
+        # depends on this).
+        if not self._done and timeout is not None:
+            done, _ = ray_tpu.wait(
+                list(self._refs), num_returns=len(self._refs), timeout=timeout
+            )
+            if len(done) < len(self._refs):
+                raise TimeoutError()
         with self._lock:
             if self._done:
                 return
             try:
-                out = ray_tpu.get(self._refs, timeout=timeout)
+                out = ray_tpu.get(self._refs)
                 if self._flatten:
                     out = [x for chunk in out for x in chunk]
                 self._value = out[0] if self._single else out
+                # _done BEFORE the callback: a callback that re-enters
+                # get() must see the settled state, not recurse
+                self._done = True
                 if self._callback is not None:
                     self._callback(self._value)
-            except ray_tpu.exceptions.GetTimeoutError:
-                raise TimeoutError() from None
             except BaseException as e:  # noqa: BLE001 — stored, re-raised on get
-                self._error = e
-                if self._error_callback is not None:
-                    self._error_callback(e)
-            self._done = True
+                if not self._done:
+                    self._error = e
+                    self._done = True
+                    if self._error_callback is not None:
+                        self._error_callback(e)
+                else:
+                    raise  # callback itself raised: propagate
 
     def get(self, timeout: Optional[float] = None):
         self._resolve(timeout)
@@ -189,20 +203,31 @@ class Pool:
                            error_callback=error_callback)
 
     def imap(self, fn, iterable: Iterable, chunksize: int = 1):
-        """Ordered lazy iterator (reference: pool.imap)."""
+        """Ordered results iterator.  Submission happens EAGERLY at the
+        call (multiprocessing semantics: imap kicks off the work even if
+        the iterator is never consumed; only retrieval is lazy)."""
         self._check_running()
         refs = self._submit_chunks(fn, self._chunks(iterable, chunksize), star=False)
-        for ref in refs:
-            yield from ray_tpu.get(ref)
+
+        def results():
+            for ref in refs:
+                yield from ray_tpu.get(ref)
+
+        return results()
 
     def imap_unordered(self, fn, iterable: Iterable, chunksize: int = 1):
-        """Completion-ordered lazy iterator (reference: imap_unordered)."""
+        """Completion-ordered results iterator (eager submission, as
+        above)."""
         self._check_running()
         refs = self._submit_chunks(fn, self._chunks(iterable, chunksize), star=False)
-        pending = list(refs)
-        while pending:
-            done, pending = ray_tpu.wait(pending, num_returns=1)
-            yield from ray_tpu.get(done[0])
+
+        def results():
+            pending = list(refs)
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1)
+                yield from ray_tpu.get(done[0])
+
+        return results()
 
     def close(self):
         self._closed = True
