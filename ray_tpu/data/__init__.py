@@ -71,6 +71,7 @@ __all__ = [
     "read_mongo",
     "read_bigquery",
     "read_iceberg",
+    "from_torch",
 ]
 
 _builtin_range = range
@@ -213,6 +214,14 @@ def read_bigquery(*, project_id: str, dataset: Optional[str] = None,
                            client_factory=client_factory),
         parallelism=parallelism,
     )
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """A map-style torch Dataset as rows (reference: read_api.py
+    from_torch)."""
+    from ray_tpu.data.datasource import TorchDatasource
+
+    return read_datasource(TorchDatasource(torch_dataset), parallelism=parallelism)
 
 
 def read_iceberg(metadata_path: str, *, parallelism: int = -1) -> Dataset:

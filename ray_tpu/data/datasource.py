@@ -335,6 +335,57 @@ class AvroDatasource(FileBasedDatasource):
             yield build_block(batch)
 
 
+class TorchDatasource(Datasource):
+    """A torch map-style Dataset as rows (reference:
+    torch_datasource.py / from_torch).  Items become {"item": value}
+    rows (tensors converted to numpy); index ranges are sharded across
+    read tasks, each re-reading from the SAME dataset object (map-style
+    datasets are random-access by contract)."""
+
+    def __init__(self, torch_dataset):
+        if not hasattr(torch_dataset, "__len__") or not hasattr(torch_dataset, "__getitem__"):
+            raise TypeError(
+                "from_torch requires a map-style torch Dataset "
+                "(__len__ + __getitem__); wrap IterableDatasets with from_items"
+            )
+        self._ds = torch_dataset
+
+    def get_name(self) -> str:
+        return "Torch"
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        total = len(self._ds)
+        n = max(1, min(parallelism, total or 1))
+        per = (total + n - 1) // n
+        ds = self._ds
+        tasks = []
+
+        def to_row(item):
+            import torch
+
+            def conv(x):
+                return x.numpy() if isinstance(x, torch.Tensor) else x
+
+            if isinstance(item, (tuple, list)):
+                # (x, y) samples → one column per element: mixed dtypes
+                # can't share an arrow list column
+                return {f"item_{i}": conv(x) for i, x in enumerate(item)}
+            if isinstance(item, dict):
+                return {k: conv(v) for k, v in item.items()}
+            return {"item": conv(item)}
+
+        for i in range(n):
+            lo, hi = i * per, min((i + 1) * per, total)
+            if lo >= hi:
+                break
+
+            def read(lo=lo, hi=hi) -> Iterator[Block]:
+                yield build_block([to_row(ds[j]) for j in range(lo, hi)])
+
+            tasks.append(ReadTask(read, BlockMetadata(num_rows=hi - lo, size_bytes=None)))
+        return tasks
+
+
 class MongoDatasource(Datasource):
     """MongoDB collection source (reference: mongo_datasource.py, which
     wraps pymongoarrow).  pymongo is not in this image, so the client is

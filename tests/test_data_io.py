@@ -292,3 +292,23 @@ def test_read_iceberg_scan(ray_cluster, tmp_path):
 
     rows = rd.read_iceberg(meta_path).take_all()
     assert sorted(r["v"] for r in rows) == list(range(20))
+
+
+def test_from_torch_map_style_dataset(ray_cluster):
+    import torch
+    from torch.utils.data import TensorDataset
+
+    import ray_tpu.data as rd
+
+    xs = torch.arange(20, dtype=torch.float32).reshape(20, 1)
+    ys = torch.arange(20)
+    ds = rd.from_torch(TensorDataset(xs, ys), parallelism=3)
+    rows = ds.take_all()
+    assert len(rows) == 20
+    # (x, y) samples land as item_0/item_1 columns, tensors as numpy
+    got = sorted(int(np.asarray(r["item_1"])) for r in rows)
+    assert got == list(range(20))
+    assert np.asarray(rows[0]["item_0"]).shape == (1,)
+
+    with pytest.raises(TypeError, match="map-style"):
+        rd.from_torch(iter([1, 2, 3]))
