@@ -10,6 +10,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, LearnerThread
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -50,6 +51,8 @@ __all__ = [
     "BCConfig",
     "CQL",
     "CQLConfig",
+    "DreamerV3",
+    "DreamerV3Config",
     "MARWIL",
     "MARWILConfig",
     "LearnerThread",

@@ -97,6 +97,12 @@ class OffPolicyEstimator:
         bounds = np.concatenate(
             [[0], np.where(ids[1:] != ids[:-1])[0] + 1, [len(ids)]]
         )
+        if len(np.unique(ids)) != len(bounds) - 1:
+            raise ValueError(
+                "off-policy estimation needs episode-CONTIGUOUS rows: the "
+                "batch's eps_id values are interleaved (shuffled batch?) — "
+                "ratio products over fragments would be silently wrong"
+            )
         out = []
         for lo, hi in zip(bounds[:-1], bounds[1:]):
             rew = rew_flat[lo:hi]
