@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import urlparse
@@ -281,6 +282,15 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(
                     200, self.state.prometheus_metrics().encode(), "text/plain; version=0.0.4"
                 )
+            if path == "/api/usage_stats":
+                from ray_tpu.dashboard import usage_stats as usage_mod
+
+                if not usage_mod.enabled():
+                    return self._json({"enabled": False})
+                # read-only endpoint: persistence belongs to the loop
+                return self._json(
+                    usage_mod.collect(self.state, self.session_info, self.start_time)
+                )
             if path == "/api/grafana_dashboard":
                 # importable Grafana JSON generated from the metrics this
                 # cluster actually exports (reference:
@@ -386,11 +396,30 @@ def start_dashboard(
     handler = type("BoundHandler", (_Handler,), {})
     handler.state = _DashboardState(gcs_client)
     handler.jobs = JobManager(jobs_gcs_client, gcs_address, session_dir)
+    handler.session_info = {"session_dir": session_dir}
+    handler.start_time = time.time()
     try:
         server = ThreadingHTTPServer((host, port), handler)
     except OSError as e:
         logger.warning("dashboard: cannot bind %s:%s: %s", host, port, e)
         return None
     threading.Thread(target=server.serve_forever, daemon=True, name="dashboard-http").start()
+
+    # periodic local usage report (reference: usage_stats_head's report
+    # loop; here local-file only — see dashboard/usage_stats.py)
+    from ray_tpu.dashboard import usage_stats as usage_mod
+
+    if usage_mod.enabled():
+        def usage_loop():
+            while True:
+                try:
+                    usage_mod.write_report(
+                        handler.state, handler.session_info, handler.start_time
+                    )
+                except Exception:
+                    pass
+                time.sleep(300)
+
+        threading.Thread(target=usage_loop, daemon=True, name="usage-stats").start()
     logger.info("dashboard listening on http://%s:%s", *server.server_address)
     return server

@@ -162,3 +162,29 @@ def test_failed_job_status(dash):
     sid = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
     assert client.wait_until_finished(sid, timeout=60) == "FAILED"
     assert "code 3" in client.get_job_info(sid)["message"]
+
+
+def test_usage_stats_endpoint_and_local_report(dash):
+    """Usage stats (reference: dashboard/modules/usage_stats): LOCAL
+    report only — /api/usage_stats collects a snapshot, persists it in
+    the session dir, and never needs egress."""
+    stats = _get(dash, "/api/usage_stats")
+    assert stats["schema_version"] == 1
+    assert stats["num_nodes_alive"] >= 1
+    assert stats["total_num_cpus"] >= 2
+    assert "ray_tpu.data" not in stats["libraries_used"]  # dashboard proc
+
+    # persisted next to the session's other artifacts (by the loop)
+    import os
+
+    from ray_tpu._private.worker import get_global_worker
+
+    sd = get_global_worker().session_info.get("session_dir")
+    path = os.path.join(sd, "usage_stats.json")
+    deadline = time.time() + 20  # loop writes once at startup
+    while not os.path.exists(path) and time.time() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(path)
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["schema_version"] == 1
