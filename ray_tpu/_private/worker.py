@@ -60,9 +60,22 @@ class ReferenceCounter:
         self._borrows: Dict[ObjectID, int] = {}
         self._task_borrows: Dict[bytes, List[ObjectID]] = {}
         self._deferred: set = set()
+        # ObjectRef.__del__ lands here, NEVER on self._lock: the cyclic
+        # GC can fire inside ANY allocating statement of a critical
+        # section below, and a __del__ that then blocks on the same
+        # (non-reentrant) lock deadlocks the whole process.  deque
+        # append/popleft are atomic, so __del__ needs no lock at all.
+        import collections
+
+        self._pending_removals = collections.deque()
+
+    def _drain_removals_locked(self):
+        while self._pending_removals:
+            self._remove_owned_locked(self._pending_removals.popleft())
 
     def add_owned(self, object_id: ObjectID):
         with self._lock:
+            self._drain_removals_locked()
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
 
     def mark_escaped(self, object_id: ObjectID):
@@ -75,6 +88,7 @@ class ReferenceCounter:
         BEFORE the task id exists — the caller's temporary refs may die as
         soon as serialization returns)."""
         with self._lock:
+            self._drain_removals_locked()
             self._borrows[object_id] = self._borrows.get(object_id, 0) + 1
 
     def bind_borrows(self, task_id: bytes, oids: List[ObjectID]):
@@ -88,6 +102,7 @@ class ReferenceCounter:
         """The task completed (result, error, or gave up retrying): its
         borrows return; objects whose local refs already died free now."""
         with self._lock:
+            self._drain_removals_locked()
             oids = self._task_borrows.pop(task_id, None)
             if not oids:
                 return
@@ -125,40 +140,52 @@ class ReferenceCounter:
                     self._flush_locked()
 
     def remove_owned(self, object_id: ObjectID):
-        with self._lock:
-            c = self._counts.get(object_id)
-            if c is None:
+        """Called from ObjectRef.__del__ — possibly INSIDE a GC pass that
+        interrupted a thread already holding self._lock.  Enqueue, then
+        drain opportunistically: blocking here is the deadlock (see
+        __init__); if the lock is busy, whoever holds it drains."""
+        self._pending_removals.append(object_id)
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            self._drain_removals_locked()
+        finally:
+            self._lock.release()
+
+    def _remove_owned_locked(self, object_id: ObjectID):
+        c = self._counts.get(object_id)
+        if c is None:
+            return
+        if c <= 1:
+            del self._counts[object_id]
+            if object_id in self._escaped:
+                # The ref escaped into other tasks/objects: keep its
+                # lineage for transitive reconstruction (reclaimed by
+                # per-job GC, like the object itself).  The memory-store
+                # blob is redundant once settled — every escape path
+                # promoted it to the shm store — but an in-flight direct
+                # result must keep its pending/promote state so arrival
+                # still triggers promotion.
+                self._escaped.discard(object_id)
+                self._worker.memory_store.free_if_settled(object_id.binary())
                 return
-            if c <= 1:
-                del self._counts[object_id]
-                if object_id in self._escaped:
-                    # The ref escaped into other tasks/objects: keep its
-                    # lineage for transitive reconstruction (reclaimed by
-                    # per-job GC, like the object itself).  The memory-store
-                    # blob is redundant once settled — every escape path
-                    # promoted it to the shm store — but an in-flight direct
-                    # result must keep its pending/promote state so arrival
-                    # still triggers promotion.
-                    self._escaped.discard(object_id)
-                    self._worker.memory_store.free_if_settled(object_id.binary())
-                    return
-                if self._borrows.get(object_id, 0) > 0:
-                    # In-flight tasks still use it as an arg: free when the
-                    # last borrow returns (reference: borrower count in
-                    # reference_count.h).
-                    self._deferred.add(object_id)
-                    self._worker.memory_store.free_if_settled(object_id.binary())
-                    return
-                self._worker.memory_store.free(object_id.binary())
-                # No dependents can exist: drop lineage with the ref
-                # (reference: task_manager.h lineage pinning).
-                self._worker.lineage.pop(object_id.binary(), None)
-                self._to_free.append(object_id.binary())
-                self._ensure_flusher_locked()
-                if len(self._to_free) >= 100:
-                    self._flush_locked()
-            else:
-                self._counts[object_id] = c - 1
+            if self._borrows.get(object_id, 0) > 0:
+                # In-flight tasks still use it as an arg: free when the
+                # last borrow returns (reference: borrower count in
+                # reference_count.h).
+                self._deferred.add(object_id)
+                self._worker.memory_store.free_if_settled(object_id.binary())
+                return
+            self._worker.memory_store.free(object_id.binary())
+            # No dependents can exist: drop lineage with the ref
+            # (reference: task_manager.h lineage pinning).
+            self._worker.lineage.pop(object_id.binary(), None)
+            self._to_free.append(object_id.binary())
+            self._ensure_flusher_locked()
+            if len(self._to_free) >= 100:
+                self._flush_locked()
+        else:
+            self._counts[object_id] = c - 1
 
     def _flush_locked(self):
         batch, self._to_free = self._to_free, []
@@ -179,6 +206,7 @@ class ReferenceCounter:
             while not self._stopped:
                 time.sleep(0.2)
                 with self._lock:
+                    self._drain_removals_locked()
                     if self._to_free:
                         self._flush_locked()
 

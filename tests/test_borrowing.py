@@ -117,3 +117,35 @@ def test_data_streams_many_times_store_capacity():
         f"spilled {spilled_after - spilled_before} objects — blocks are "
         f"not being freed eagerly"
     )
+
+
+def test_refcounter_survives_gc_in_critical_section(ray_start_regular):
+    """Regression: ObjectRef.__del__ used to take the ReferenceCounter
+    lock directly; a cyclic-GC pass firing inside an allocating
+    statement of add_owned() (same thread, same non-reentrant lock)
+    deadlocked the whole process — intermittently, under memory
+    pressure.  __del__ now enqueues to a lock-free deque.  This test
+    forces constant GC passes over ref cycles; before the fix it hung
+    within a few iterations."""
+    import gc
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def produce(x):
+        return [x] * 20
+
+    old = gc.get_threshold()
+    gc.set_threshold(25, 2, 2)
+    try:
+        for i in range(60):
+            class _Holder:
+                pass
+
+            h = _Holder()
+            h.refs = [produce.remote(i) for _ in range(6)]
+            h.me = h  # cycle: only the GC can reclaim these refs
+            assert ray_tpu.get(list(h.refs))[0][0] == i
+            del h
+    finally:
+        gc.set_threshold(*old)
