@@ -71,6 +71,7 @@ __all__ = [
     "read_mongo",
     "read_bigquery",
     "read_iceberg",
+    "read_delta",
     "from_torch",
 ]
 
@@ -231,3 +232,11 @@ def read_iceberg(metadata_path: str, *, parallelism: int = -1) -> Dataset:
     from ray_tpu.data.datasource import IcebergDatasource
 
     return read_datasource(IcebergDatasource(metadata_path), parallelism=parallelism)
+
+
+def read_delta(table_path: str, *, parallelism: int = -1) -> Dataset:
+    """Delta Lake table scan: _delta_log JSON/checkpoint replay ->
+    live parquet files (reference: read_delta_sharing / deltalake)."""
+    from ray_tpu.data.datasource import DeltaLakeDatasource
+
+    return read_datasource(DeltaLakeDatasource(table_path), parallelism=parallelism)

@@ -509,6 +509,67 @@ class BigQueryDatasource(Datasource):
         return tasks
 
 
+class DeltaLakeDatasource(Datasource):
+    """Delta Lake table source (reference: delta_sharing_datasource.py /
+    the deltalake wrapper; neither lib is in this image, so the table
+    FORMAT is read directly — a Delta table is parquet files plus a
+    ``_delta_log/`` of ordered JSON commits whose add/remove actions
+    define the live file set).
+
+    Supported: JSON commits (00000000N.json) and checkpoint parquet
+    files (N.checkpoint.parquet) as a log-replay base; partition
+    pruning and deletion vectors are out of scope — full-scan reads."""
+
+    def __init__(self, table_path: str):
+        self._path = table_path
+
+    def get_name(self) -> str:
+        return "DeltaLake"
+
+    def _live_files(self) -> List[str]:
+        import json as _json
+
+        log_dir = os.path.join(self._path, "_delta_log")
+        if not os.path.isdir(log_dir):
+            raise FileNotFoundError(f"{self._path} has no _delta_log (not a Delta table)")
+        entries = sorted(os.listdir(log_dir))
+        commits = [e for e in entries if e.endswith(".json")]
+        checkpoints = [e for e in entries if e.endswith(".checkpoint.parquet")]
+        live: set = set()
+        start_version = -1
+        if checkpoints:
+            # replay from the newest checkpoint: it snapshots the add-set
+            import pyarrow.parquet as pq
+
+            cp = sorted(checkpoints)[-1]
+            start_version = int(cp.split(".")[0])
+            table = pq.read_table(os.path.join(log_dir, cp))
+            for row in table.to_pylist():
+                add = row.get("add")
+                if add and add.get("path"):
+                    live.add(add["path"])
+        for name in commits:
+            if int(name.split(".")[0]) <= start_version:
+                continue
+            with open(os.path.join(log_dir, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    action = _json.loads(line)
+                    if "add" in action:
+                        live.add(action["add"]["path"])
+                    elif "remove" in action:
+                        live.discard(action["remove"]["path"])
+        return [os.path.join(self._path, p) for p in sorted(live)]
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        files = self._live_files()
+        if not files:
+            return []
+        return ParquetDatasource(files).get_read_tasks(parallelism)
+
+
 class IcebergDatasource(Datasource):
     """Apache Iceberg table source (reference: iceberg_datasource.py,
     which wraps pyiceberg).  pyiceberg is not in this image; the table

@@ -312,3 +312,38 @@ def test_from_torch_map_style_dataset(ray_cluster):
 
     with pytest.raises(TypeError, match="map-style"):
         rd.from_torch(iter([1, 2, 3]))
+
+
+def test_read_delta_log_replay(ray_cluster, tmp_path):
+    """Delta Lake scan replays the _delta_log: checkpoint snapshot +
+    later JSON commits, with remove actions dropping files."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import ray_tpu.data as rd
+
+    root = tmp_path / "delta"
+    (root / "_delta_log").mkdir(parents=True)
+    for name, lo in (("a.parquet", 0), ("b.parquet", 10), ("old.parquet", 100)):
+        pq.write_table(pa.table({"v": list(range(lo, lo + 10))}), str(root / name))
+
+    # checkpoint at version 1 snapshots {a, old}
+    pq.write_table(
+        pa.table({"add": [{"path": "a.parquet"}, {"path": "old.parquet"}]}),
+        str(root / "_delta_log" / "00000000000000000001.checkpoint.parquet"),
+    )
+    # superseded commit BEFORE the checkpoint must be ignored
+    (root / "_delta_log" / "00000000000000000000.json").write_text(
+        json.dumps({"add": {"path": "ghost.parquet"}}) + "\n"
+    )
+    # commit 2: add b, remove old
+    (root / "_delta_log" / "00000000000000000002.json").write_text(
+        json.dumps({"add": {"path": "b.parquet"}}) + "\n"
+        + json.dumps({"remove": {"path": "old.parquet"}}) + "\n"
+    )
+
+    rows = rd.read_delta(str(root)).take_all()
+    assert sorted(r["v"] for r in rows) == list(range(20))  # a + b, not old
+
+    with pytest.raises(FileNotFoundError, match="_delta_log"):
+        rd.read_delta(str(tmp_path)).take_all()
