@@ -203,3 +203,35 @@ def test_moe_expert_parallel_matches_single_device():
     out, aux = jax.jit(lambda p, v: mod.apply({"params": p}, v))(sharded_params, x_sharded)
     assert np.allclose(np.asarray(out), np.asarray(ref_out), atol=1e-4)
     assert abs(float(aux) - float(ref_aux)) < 1e-5
+
+
+def test_vit_overfits_synthetic_batch():
+    """ViT (models/vit.py): forward shapes + a few steps overfit a tiny
+    labeled batch (the standard can-it-learn smoke for a new model
+    family; reference trains ViTs through the Train library)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import vit
+
+    cfg = vit.ViTConfig.tiny(image_size=16, patch_size=4, num_classes=4,
+                             dtype=jnp.float32)
+    params = vit.init_params(cfg)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(16, 16, 16, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 4, 16))
+
+    logits = vit.ViT(cfg).apply({"params": params}, images)
+    assert logits.shape == (16, 4)
+
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(vit.make_train_step(cfg, opt))
+    first = None
+    for _ in range(40):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        first = first if first is not None else float(loss)
+    last = float(loss)
+    assert last < first * 0.5, (first, last)
