@@ -87,6 +87,15 @@ class ObjectRefGenerator:
         self._state = worker._register_stream(spec)
         self._last_poll = time.monotonic()
         self._fallback_deadline: Optional[float] = None
+        # The GENERATOR owns the end-of-stream sentinel's lifetime: without
+        # this owned ref, a submit path that builds-and-drops the usual
+        # return-ref list would eagerly free the sentinel cluster-wide at
+        # submit time, and any consumer reaching _resolve_sentinel after
+        # the ~200ms free flush finds it gone (the first call on a fresh
+        # driver won the race, every later one timed out — the bug shape
+        # that surfaced through serve streaming).  Dropped with the
+        # generator, so abandoned streams still free their sentinel.
+        self._sentinel_ref = ObjectRef(spec.return_ids()[0], owned=True)
 
     # -- iteration ------------------------------------------------------
     def __iter__(self):

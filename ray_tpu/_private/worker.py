@@ -1315,11 +1315,17 @@ class Worker:
         # paths in this process, all of which return the borrows.
         self.reference_counter.bind_borrows(spec.task_id.binary(), borrowed)
         generator = None
+        refs = []
         if is_streaming:
             from ray_tpu._private.streaming import ObjectRefGenerator
 
+            # the generator holds the sentinel's owned ref — building the
+            # usual refs list too would add a second owned ref that dies
+            # at return and (before the generator existed) freed the
+            # sentinel cluster-wide at submit
             generator = ObjectRefGenerator(self, spec)
-        refs = [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
+        else:
+            refs = [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
         if CONFIG.direct_actor_calls:
             # Mark returns in-flight now: gets wait on the memory store
             # until a completion path resolves them (inline result, stored

@@ -27,7 +27,6 @@ class ViTConfig:
     n_layer: int = 6
     n_head: int = 3
     mlp_ratio: int = 4
-    dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -51,8 +50,6 @@ class _Block(nn.Module):
             num_heads=cfg.n_head,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            dropout_rate=cfg.dropout,
-            deterministic=deterministic,
         )(h, h)
         x = x + h
         h = nn.LayerNorm(dtype=cfg.dtype)(x)

@@ -25,7 +25,11 @@ from ray_tpu.serve.schema import (
     build_app_schema,
 )
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
@@ -47,6 +51,7 @@ __all__ = [
     "get_deployment_handle",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "AutoscalingConfig",
     "DeploymentConfig",
     "batch",

@@ -66,7 +66,7 @@ class LocalDeploymentHandle:
         )
         self._loop_thread.start()
 
-    def _call(self, method: str, args: tuple, kwargs: dict) -> _LocalResponse:
+    def _call(self, method: str, args: tuple, kwargs: dict):
         from ray_tpu.serve.multiplex import _set_request_model_id
 
         if method == "__call__":
@@ -76,6 +76,24 @@ class LocalDeploymentHandle:
         if target is None:
             raise AttributeError(f"deployment has no method {method!r}")
         model_id = self._multiplexed_model_id
+        if getattr(self, "_stream", False):
+            # streaming parity with the cluster path: iterate yields,
+            # draining coroutines/async generators on the replica loop
+            _set_request_model_id(model_id)
+            out = target(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = asyncio.run_coroutine_threadsafe(out, self._loop).result(60)
+            if inspect.isasyncgen(out):
+                async def drain(ag):
+                    return [item async for item in ag]
+
+                items = asyncio.run_coroutine_threadsafe(
+                    drain(out), self._loop
+                ).result(60)
+                return iter(items)
+            if inspect.isgenerator(out) or isinstance(out, (list, tuple)):
+                return iter(out)
+            return iter([out])
 
         def run():
             async def invoke():
@@ -93,12 +111,18 @@ class LocalDeploymentHandle:
     def remote(self, *args, **kwargs) -> _LocalResponse:
         return self._call("__call__", args, kwargs)
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None, **_):
-        if multiplexed_model_id is None:
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None, **_):
+        if multiplexed_model_id is None and stream is None:
             return self
         h = LocalDeploymentHandle.__new__(LocalDeploymentHandle)
         h._instance = self._instance
-        h._multiplexed_model_id = multiplexed_model_id
+        h._multiplexed_model_id = (
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self._multiplexed_model_id
+        )
+        h._stream = getattr(self, "_stream", False) if stream is None else stream
         h._loop = self._loop
         h._loop_thread = self._loop_thread
         return h

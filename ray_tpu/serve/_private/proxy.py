@@ -88,6 +88,59 @@ class ProxyActor:
 
         return web.Response(text="ok")
 
+    async def _handle_stream(self, request, handle, payload):
+        """Chunked response over a generator deployment: each yielded
+        item becomes one chunk (json for dict/list, utf-8 text, raw
+        bytes pass through); reference: http_util.py Response streaming."""
+        import json as _json
+
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+        stream_handle = handle.options(stream=True)
+        try:
+            gen = await loop.run_in_executor(
+                self._route_pool, stream_handle.remote, payload
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.exception("proxy stream routing failed")
+            return web.Response(status=500, text=str(e))
+        it = iter(gen)
+
+        def next_item():
+            try:
+                return True, next(it)
+            except StopIteration:
+                return False, None
+
+        # fetch the FIRST item before committing headers: an error
+        # before any yield still gets a clean 500
+        try:
+            more, item = await loop.run_in_executor(None, next_item)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("stream failed before first item")
+            return web.Response(status=500, text=str(e))
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        try:
+            while more:
+                if isinstance(item, (bytes, bytearray)):
+                    chunk = bytes(item)
+                elif isinstance(item, (dict, list)):
+                    chunk = (_json.dumps(item) + "\n").encode()
+                else:
+                    chunk = str(item).encode()
+                await resp.write(chunk)
+                more, item = await loop.run_in_executor(None, next_item)
+        except Exception:  # noqa: BLE001 — mid-stream replica error:
+            # headers are committed; terminate the chunked body cleanly
+            # rather than tearing the connection down
+            logger.exception("stream failed mid-body")
+        finally:
+            await resp.write_eof()
+        return resp
+
     async def _handle(self, request):
         from aiohttp import web
 
@@ -119,7 +172,15 @@ class ProxyActor:
                 payload = (await request.read()).decode("utf-8", "replace")
         else:
             payload = dict(request.query)
+            # transport-level control key, never user data
+            payload.pop("serve_stream", None)
         loop = asyncio.get_event_loop()
+        # streaming opt-in (reference: StreamingResponse deployments):
+        # chunked transfer, one chunk per yielded item
+        if request.headers.get("x-serve-stream") == "1" or request.query.get(
+            "serve_stream"
+        ) == "1":
+            return await self._handle_stream(request, handle, payload)
         try:
             # Routing may block (cold start waits for a replica, refresh
             # does a blocking get) — keep it off the proxy event loop so

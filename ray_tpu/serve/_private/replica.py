@@ -39,6 +39,15 @@ class Replica:
         if fn is not None:
             fn(user_config)
 
+    def _resolve_target(self, method: str):
+        """Method dispatch shared by the one-shot and streaming paths."""
+        target = self.callable if method == "__call__" else getattr(self.callable, method)
+        if method == "__call__" and not callable(target):
+            raise AttributeError(f"deployment {self.deployment_name} is not callable")
+        if method == "__call__" and hasattr(self.callable, "__call__") and not inspect.isfunction(self.callable):
+            target = self.callable.__call__
+        return target
+
     async def handle_request(
         self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""
     ):
@@ -49,15 +58,38 @@ class Replica:
             self._total += 1
             _set_request_model_id(multiplexed_model_id)
             try:
-                target = self.callable if method == "__call__" else getattr(self.callable, method)
-                if method == "__call__" and not callable(target):
-                    raise AttributeError(f"deployment {self.deployment_name} is not callable")
-                if method == "__call__" and hasattr(self.callable, "__call__") and not inspect.isfunction(self.callable):
-                    target = self.callable.__call__
-                result = target(*args, **kwargs)
+                result = self._resolve_target(method)(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     result = await result
                 return result
+            finally:
+                self._ongoing -= 1
+
+    async def handle_request_stream(
+        self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""
+    ):
+        """Streaming requests (reference: replica.py handle_request_streaming
+        — generator deployments yield response chunks).  Runs as an actor
+        STREAMING method: each yielded item becomes one stream element on
+        the caller's side (num_returns=\"streaming\")."""
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
+        async with self._sem:
+            self._ongoing += 1
+            self._total += 1
+            _set_request_model_id(multiplexed_model_id)
+            try:
+                result = self._resolve_target(method)(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+                if inspect.isasyncgen(result):
+                    async for item in result:
+                        yield item
+                elif inspect.isgenerator(result) or isinstance(result, (list, tuple)):
+                    for item in result:
+                        yield item
+                else:
+                    yield result  # non-generator target: one-element stream
             finally:
                 self._ongoing -= 1
 

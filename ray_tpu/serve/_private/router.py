@@ -142,6 +142,22 @@ class Router:
         )
         return ref, rid
 
+    def route_stream(self, method: str, args: tuple, kwargs: dict,
+                     multiplexed_model_id: str = ""):
+        """Streaming dispatch: returns (item-ref generator, replica_id)
+        via the runtime's actor streaming plane (reference: router
+        streaming path feeding StreamingResponse)."""
+        r = self.pick(multiplexed_model_id)
+        rid = r["replica_id"]
+        with self._lock:
+            self._queue_estimate[rid] = self._queue_estimate.get(rid, 0) + 1
+            if multiplexed_model_id:
+                self._model_locations.setdefault(multiplexed_model_id, set()).add(rid)
+        gen = r["actor"].handle_request_stream.options(num_returns="streaming").remote(
+            method, args, kwargs, multiplexed_model_id
+        )
+        return gen, rid
+
     def done(self, replica_id: str):
         with self._lock:
             self._queue_estimate[replica_id] = max(0, self._queue_estimate.get(replica_id, 1) - 1)

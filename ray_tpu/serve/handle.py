@@ -33,22 +33,60 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate to receive each yielded item as it
+    arrives (reference: serve/handle.py DeploymentResponseGenerator)."""
+
+    def __init__(self, gen, router, replica_id: str):
+        self._gen = gen
+        self._router = router
+        self._replica_id = replica_id
+        self._done = False
+
+    def _mark_done(self):
+        if not self._done:
+            self._done = True
+            self._router.done(self._replica_id)
+
+    def __iter__(self):
+        import ray_tpu
+
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            self._mark_done()
+
+    def close(self):
+        self._mark_done()
+
+    def __del__(self):
+        # a never-iterated generator must still release its in-flight
+        # slot, or the replica's queue estimate inflates forever and
+        # pow-2 routing starves it
+        try:
+            self._mark_done()
+        except Exception:
+            pass
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
         self._method = method
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._handle._call(self._method, args, kwargs)
 
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller=None,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller
         self._router = None
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
 
     def _ensure_router(self):
         if self._router is None:
@@ -62,24 +100,35 @@ class DeploymentHandle:
             self._router = get_or_create_router(controller, self.deployment_name)
         return self._router
 
-    def _call(self, method: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+    def _call(self, method: str, args: tuple, kwargs: dict):
         router = self._ensure_router()
+        if self._stream:
+            gen, rid = router.route_stream(
+                method, args, kwargs, self._multiplexed_model_id
+            )
+            return DeploymentResponseGenerator(gen, router, rid)
         ref, rid = router.route(method, args, kwargs, self._multiplexed_model_id)
         return DeploymentResponse(ref, router, rid)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._call("__call__", args, kwargs)
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None, **kwargs) -> "DeploymentHandle":
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None, **kwargs) -> "DeploymentHandle":
         """A derived handle with per-call options (reference:
         serve/handle.py options — multiplexed_model_id routes to a
-        replica already holding that model).  The derived handle SHARES
-        this handle's router so queue estimates and model affinity stay
-        coherent."""
-        if multiplexed_model_id is None:
+        replica holding that model; stream=True makes remote() return a
+        DeploymentResponseGenerator over the target's yields).  The
+        derived handle SHARES this handle's router so queue estimates
+        and model affinity stay coherent."""
+        if multiplexed_model_id is None and stream is None:
             return self
         h = DeploymentHandle(
-            self.deployment_name, self._controller, multiplexed_model_id
+            self.deployment_name,
+            self._controller,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
+            stream=self._stream if stream is None else stream,
         )
         h._router = self._ensure_router()
         return h
@@ -92,4 +141,7 @@ class DeploymentHandle:
     def __reduce__(self):
         # handles cross process boundaries by name (the router
         # re-resolves); per-call options like the model id must survive
-        return (DeploymentHandle, (self.deployment_name, None, self._multiplexed_model_id))
+        return (
+            DeploymentHandle,
+            (self.deployment_name, None, self._multiplexed_model_id, self._stream),
+        )

@@ -309,3 +309,60 @@ def test_local_testing_mode():
     hb = serve.run(boom.bind(), _local_testing_mode=True)
     with pytest.raises(ValueError):
         hb.remote(1).result()
+
+
+def test_streaming_deployment_handle(serve_cluster):
+    """Generator deployments stream items through the handle
+    (reference: serve/handle.py DeploymentResponseGenerator over a
+    streaming replica call)."""
+    @serve.deployment(name="TokenStream")
+    class TokenStream:
+        def __call__(self, n):
+            for i in range(int(n)):
+                yield {"token": i}
+
+        async def agen(self, n):
+            for i in range(int(n)):
+                yield i * 10
+
+    handle = serve.run(TokenStream.bind(), name="stream_app")
+    items = list(handle.options(stream=True).remote(4))
+    assert items == [{"token": i} for i in range(4)]
+    # async generator method, method dispatch through the same option
+    vals = list(handle.options(stream=True).agen.remote(3))
+    assert vals == [0, 10, 20]
+    # non-stream calls on the same deployment still work (one-shot path)
+    sync_handle = handle.options(stream=False)
+    assert hasattr(sync_handle.remote(1), "result")
+
+
+def test_streaming_http_chunked(serve_cluster):
+    """x-serve-stream: 1 streams each yield as a chunk (reference:
+    StreamingResponse over the HTTP proxy)."""
+    @serve.deployment(name="HttpStream")
+    class HttpStream:
+        def __call__(self, payload):
+            for i in range(3):
+                yield f"chunk-{i};"
+
+    # the proxy is a singleton: reuse the module's proxy port (first
+    # http_port wins; later ports are ignored by _ensure_proxy)
+    serve.run(HttpStream.bind(), name="http_stream", route_prefix="/hs",
+              http_port=18123)
+    req = urllib.request.Request(
+        "http://127.0.0.1:18123/hs", headers={"x-serve-stream": "1"}
+    )
+    with urllib.request.urlopen(req, timeout=20) as r:
+        body = r.read().decode()
+    assert body == "chunk-0;chunk-1;chunk-2;"
+
+
+def test_streaming_local_testing_mode(serve_cluster):
+    """Local mode streams generator yields like the cluster path."""
+    @serve.deployment
+    class LocalGen:
+        def __call__(self, n):
+            yield from range(n)
+
+    h = serve.run(LocalGen.bind(), _local_testing_mode=True)
+    assert list(h.options(stream=True).remote(3)) == [0, 1, 2]
