@@ -223,13 +223,67 @@ def _emit(record: dict) -> None:
     print(json.dumps(record), flush=True)
 
 
-def _probe_chip() -> dict | None:
-    """~60 s budget tiny-matmul probe; None if the chip is unreachable."""
-    budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "90"))
-    try:
-        return _run(_PROBE_SNIPPET, timeout=min(budget, max(_remaining() - 60.0, 1.0)))
-    except (RuntimeError, ValueError):
-        return None
+_PROBE_ENV_KEYS = (
+    "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "TPU_SKIP_MDS_QUERY",
+    "TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID", "TPU_CHIPS_PER_HOST_BOUNDS",
+)
+
+
+def _probe_chip() -> tuple[dict | None, dict]:
+    """Tiny-matmul chip probe with a budgeted retry loop.
+
+    The round-5 postmortem lost the flagship TPU number twice to an
+    unretried one-shot probe: a transient tunnel error at second 0 sent
+    the whole bench to CPU.  Now fast failures retry under decorrelated
+    backoff (ray_tpu._private.retry — the same policy the runtime uses)
+    inside a budget of ~half the remaining deadline; a wedged tunnel
+    (hang, not error) still burns the budget at most once.
+
+    Returns (probe_record_or_None, provenance).  The provenance dict is
+    attached to every emitted bench JSON so a fallback record says WHY:
+    "no accelerator env" reads very differently from "tunnel wedged
+    after 3 attempts".
+    """
+    from ray_tpu._private import retry
+
+    cap = float(os.environ.get("BENCH_PROBE_BUDGET_S", "90"))
+    budget = max(min(cap, _remaining() / 2), 1.0)
+    prov: dict = {
+        "probe_attempts": 0,
+        "probe_budget_s": round(budget, 1),
+        "probe_env": {k: os.environ[k] for k in _PROBE_ENV_KEYS if k in os.environ},
+    }
+    tpu_env = bool(
+        prov["probe_env"].get("PALLAS_AXON_POOL_IPS")
+        or any(k.startswith("TPU_") for k in prov["probe_env"])
+    ) and prov["probe_env"].get("JAX_PLATFORMS") != "cpu"
+    bo = retry.BENCH_PROBE.start(deadline_s=budget)
+    last_err = ""
+    while True:
+        prov["probe_attempts"] += 1
+        per_try = max(bo.remaining() or budget, 1.0)
+        try:
+            rec = _run(_PROBE_SNIPPET, timeout=per_try)
+            prov["probe_backend"] = rec.get("backend")
+            if rec.get("backend") != "tpu":
+                prov["fallback_reason"] = (
+                    f"probe_backend_{rec.get('backend')}" if tpu_env else "no_tpu_env"
+                )
+            return rec, prov
+        except (RuntimeError, ValueError) as e:
+            last_err = str(e)
+        delay = bo.next_delay()
+        if delay is None:
+            break
+        time.sleep(delay)
+    prov["probe_error_tail"] = last_err[-500:]
+    if not tpu_env:
+        prov["fallback_reason"] = "no_tpu_env"
+    elif "exceeded its" in last_err:
+        prov["fallback_reason"] = "tunnel_wedged_probe_timeout"
+    else:
+        prov["fallback_reason"] = "probe_error"
+    return None, prov
 
 
 def _run_ppo_bench(timeout: float) -> dict:
@@ -254,16 +308,17 @@ def _run_ppo_bench(timeout: float) -> dict:
     return {}
 
 
-def _measure(force_cpu: bool) -> tuple[dict, dict | None]:
+def _measure(force_cpu: bool, prov: dict | None = None) -> tuple[dict, dict | None]:
     """Framework run first (it IS the headline number), raw second.
 
     Returns (framework, raw_or_None); emits an interim record as soon as
-    the framework number exists.
+    the framework number exists — carrying the probe provenance, so even
+    a record the driver kills mid-enrichment says why it fell back.
     """
     fw_budget = min(600.0, _remaining() - 240.0) if not force_cpu else min(
         300.0, _remaining() - 90.0)
     fw = _run(_FRAMEWORK_SNIPPET, timeout=fw_budget, force_cpu=force_cpu)
-    _emit(_record(fw, None, {}))
+    _emit(_record(fw, None, prov or {}))
     raw = None
     if _remaining() > 90.0:
         try:
@@ -292,19 +347,20 @@ def _record(fw: dict, raw: dict | None, extra: dict) -> dict:
 
 
 def main():
-    probe = _probe_chip()
+    probe, prov = _probe_chip()
     # a present-but-fail-fast tunnel can leave jax on CPU: that is not a
     # chip, and must not be granted TPU-sized budgets or the PPO stage
     chip_ok = probe is not None and probe.get("backend") == "tpu"
     try:
         try:
-            fw, raw = _measure(force_cpu=not chip_ok)
+            fw, raw = _measure(force_cpu=not chip_ok, prov=prov)
         except (RuntimeError, ValueError):
             if not chip_ok:
                 raise  # CPU fallback itself failed: nothing honest to report
             # chip probe passed but the big run wedged: fall back to CPU
             chip_ok = False
-            fw, raw = _measure(force_cpu=True)
+            prov["fallback_reason"] = "measure_wedged_after_probe_ok"
+            fw, raw = _measure(force_cpu=True, prov=prov)
     except (RuntimeError, ValueError) as exc:
         # even total failure must leave a parseable line in the tail
         _emit({
@@ -314,9 +370,10 @@ def main():
             "vs_baseline": 0.0,
             "on_tpu": False,
             "error": str(exc),
+            **prov,
         })
         raise
-    extra: dict = {}
+    extra: dict = dict(prov)
     if probe:
         extra["chip_probe_secs"] = probe["secs"]
     if chip_ok and not os.environ.get("BENCH_SKIP_PPO") and _remaining() > 420.0:

@@ -30,19 +30,46 @@ _lib = None
 _lib_failed = False
 
 
-def _build() -> Optional[str]:
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+def _build(force: bool = False) -> Optional[str]:
+    if not force and os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
         return _LIB
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB + ".tmp", "-lpthread"]
+    # Per-process temp output: every worker on a host may rebuild
+    # concurrently (e.g. a shipped .so that doesn't load here), and a
+    # shared .tmp would race one compiler's truncation against another's
+    # os.replace, promoting a partially written library.
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp, "-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_LIB + ".tmp", _LIB)
+        os.replace(tmp, _LIB)
         return _LIB
     except (subprocess.SubprocessError, OSError) as e:
         stderr = getattr(e, "stderr", b"")
         logger.warning("native arena build failed (%s); falling back to file store: %s",
                        e, stderr.decode(errors="replace")[:500] if stderr else "")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
+
+
+def _dlopen(path: str):
+    """CDLL that treats an unloadable prebuilt .so (e.g. built against a
+    newer GLIBC than this host's) as "rebuild from source", not a crash:
+    a wheel can legitimately ship a library the target machine can't
+    load, and the pure-Python file store is always there to fall back to."""
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        logger.warning("prebuilt %s does not load on this host (%s); rebuilding", path, e)
+        if _build(force=True) is None:
+            return None
+        try:
+            return ctypes.CDLL(path)
+        except OSError as e2:
+            logger.warning("rebuilt arena library still does not load: %s", e2)
+            return None
 
 
 def load_library():
@@ -57,7 +84,10 @@ def load_library():
         if path is None:
             _lib_failed = True
             return None
-        lib = ctypes.CDLL(path)
+        lib = _dlopen(path)
+        if lib is None:
+            _lib_failed = True
+            return None
         lib.arena_create.restype = ctypes.c_void_p
         lib.arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32]
         lib.arena_attach.restype = ctypes.c_void_p

@@ -1,0 +1,281 @@
+"""Deterministic fault-injection plane.
+
+Every comm plane in the system — driver/worker -> GCS, driver/worker ->
+raylet, submitter -> leased worker (direct), raylet -> raylet (object
+manager) — dispatches through rpc.RpcServer, and that dispatch consults
+this module.  One composable spec therefore injects faults into all four
+planes at once (reference: src/ray/rpc/rpc_chaos.h, generalized from
+"drop first N" to a seeded, replayable schedule).
+
+Spec grammar (``testing_chaos_spec``, via ``_system_config`` or the
+``RAY_TPU_testing_chaos_spec`` env var every spawned cluster process
+inherits)::
+
+    rule[,rule...]
+    rule    := pattern:action[:key=value]...
+    pattern := fnmatch glob over the RPC method name ("submit_task",
+               "store_*", "*"), or a process fault point ("@worker.exec",
+               "@raylet.tick", "@gcs.tick")
+    action  := drop_req | drop_rep | delay_req | delay_rep | dup_req | kill
+    keys    := n=<max firings, -1 unlimited; default 1>
+               p=<firing probability per match; default 1.0>
+               ms=<delay milliseconds; default 50>
+               after=<skip the first K matches; default 0>
+               at=<fire exactly on the K-th match; shorthand for
+                  after=K-1:n=1>
+
+Examples::
+
+    submit_task:dup_req:n=1            # duplicate the first submit
+    store_get:delay_req:ms=200:p=0.5:n=-1   # half of all gets +200ms
+    request_worker_lease:drop_rep:n=2  # eat the first two lease grants
+    @worker.exec:kill:at=3             # worker dies on its 3rd task
+
+Determinism: every rule owns a ``random.Random`` seeded from
+(``testing_chaos_seed``, rule index) and its own match counter, so a
+rule's fire/skip verdict depends only on the ordinal of the match —
+never on cross-method interleaving or wall-clock.  The same seed + spec
+replays the identical fault schedule; the schedule is logged (bounded)
+and hashable via ``schedule_digest()`` for drills to assert on.
+
+The legacy ``testing_rpc_failure`` spec ("method:kind:count", kind in
+req|rep) keeps working: it is folded into the rule table as
+``method:drop_<kind>:n=count``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import random
+import threading
+from typing import List, NamedTuple, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+
+_ACTIONS = ("drop_req", "drop_rep", "delay_req", "delay_rep", "dup_req", "kill")
+
+# Bound on the in-memory schedule log; fired entries past this are
+# counted but not stored.
+_MAX_SCHEDULE = 20_000
+
+
+class Decision(NamedTuple):
+    drop: bool
+    delay_s: float
+    dup: bool
+
+    @property
+    def clean(self) -> bool:
+        return not self.drop and not self.dup and self.delay_s <= 0
+
+
+_CLEAN = Decision(False, 0.0, False)
+
+
+class _Rule:
+    __slots__ = ("index", "pattern", "action", "n", "p", "delay_s", "after",
+                 "matches", "fired", "rng")
+
+    def __init__(self, index: int, pattern: str, action: str, n: int,
+                 p: float, delay_s: float, after: int, seed: int):
+        self.index = index
+        self.pattern = pattern
+        self.action = action
+        self.n = n
+        self.p = p
+        self.delay_s = delay_s
+        self.after = after
+        self.matches = 0
+        self.fired = 0
+        # Per-rule stream: verdicts depend only on this rule's match
+        # ordinal, so schedules replay regardless of how other methods
+        # interleave between matches.  seed < 0 = genuinely unseeded
+        # (fresh entropy per rule), matching retry._shared_rng.
+        if seed >= 0:
+            self.rng = random.Random(seed * 1_000_003 + index)
+        else:
+            self.rng = random.Random()
+
+    def evaluate(self) -> bool:
+        """One match of this rule's pattern: fire or skip (deterministic
+        in the match ordinal)."""
+        self.matches += 1
+        if self.matches <= self.after:
+            return False
+        if 0 <= self.n <= self.fired:
+            return False
+        if self.p < 1.0 and self.rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+
+def _parse_rule(index: int, text: str, seed: int) -> _Rule:
+    parts = text.strip().split(":")
+    if len(parts) < 2:
+        raise ValueError(f"chaos rule needs pattern:action, got {text!r}")
+    pattern, action = parts[0], parts[1]
+    if action not in _ACTIONS:
+        raise ValueError(f"unknown chaos action {action!r} in {text!r} "
+                         f"(one of {', '.join(_ACTIONS)})")
+    kv = {}
+    for part in parts[2:]:
+        k, _, v = part.partition("=")
+        kv[k] = v
+    n = int(kv.get("n", 1))
+    p = float(kv.get("p", 1.0))
+    delay_s = float(kv.get("ms", 50)) / 1000.0
+    after = int(kv.get("after", 0))
+    if "at" in kv:
+        after = int(kv["at"]) - 1
+        n = 1
+    return _Rule(index, pattern, action, n, p, delay_s, after, seed)
+
+
+class ChaosPlane:
+    """Process-wide fault scheduler; a no-op (one dict lookup per
+    dispatch is avoided entirely via the `active` fast path) unless a
+    spec is configured."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[_Rule] = []
+        self._parsed_for: Optional[Tuple[str, str, int]] = None
+        self.schedule: List[str] = []
+        self.schedule_len = 0
+        self._active = False
+        self._last_check = 0.0
+
+    # ------------------------------------------------------------------
+    def _ensure(self):
+        # Config revalidation is throttled: the active fast path on a
+        # production dispatch is one monotonic read + a float compare,
+        # not three CONFIG lookups per message.  Spec changes (tests)
+        # are picked up within 200 ms, or instantly via reset().
+        import time
+
+        now = time.monotonic()
+        if self._parsed_for is not None and now - self._last_check < 0.2:
+            return
+        self._last_check = now
+        spec = CONFIG.testing_chaos_spec
+        legacy = CONFIG.testing_rpc_failure
+        seed = int(CONFIG.testing_chaos_seed)
+        key = (spec, legacy, seed)
+        if key == self._parsed_for:
+            return
+        with self._lock:
+            if key == self._parsed_for:
+                return
+            try:
+                rules: List[_Rule] = []
+                if spec:
+                    for part in spec.split(","):
+                        if part.strip():
+                            rules.append(_parse_rule(len(rules), part, seed))
+                if legacy:
+                    # "method:kind:count" -> method:drop_<kind>:n=count
+                    for part in legacy.split(","):
+                        m, kind, count = part.split(":")
+                        rules.append(_parse_rule(
+                            len(rules), f"{m}:drop_{kind}:n={count}", seed))
+            except ValueError:
+                # A malformed spec must not detonate on every dispatch —
+                # this is consulted from the RPC hot path and service
+                # loops.  Log once, disable the plane, and remember the
+                # bad key so the error doesn't re-raise forever.
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "invalid chaos spec %r / %r — fault injection disabled",
+                    spec, legacy,
+                )
+                rules = []
+            self._rules = rules
+            self._active = bool(rules)
+            self.schedule = []
+            self.schedule_len = 0
+            self._parsed_for = key
+
+    @property
+    def active(self) -> bool:
+        self._ensure()
+        return self._active
+
+    def reset(self):
+        """Drop parsed state so counters/schedule restart (tests)."""
+        with self._lock:
+            self._parsed_for = None
+            self._last_check = 0.0
+
+    # ------------------------------------------------------------------
+    def _log(self, rule: _Rule, verdict: str):
+        entry = f"{rule.index}:{rule.pattern}:{rule.action}#{rule.matches}:{verdict}"
+        self.schedule_len += 1
+        if len(self.schedule) < _MAX_SCHEDULE:
+            self.schedule.append(entry)
+
+    def decide(self, method: str, kind: str) -> Decision:
+        """Fault decision for one delivery of `method` (kind: "req" for
+        a request/push arriving at a server, "rep" for its reply)."""
+        if not self.active:
+            return _CLEAN
+        drop = dup = False
+        delay_s = 0.0
+        with self._lock:
+            for rule in self._rules:
+                if rule.action == "kill" or not rule.action.endswith(kind):
+                    continue
+                if not fnmatch.fnmatchcase(method, rule.pattern):
+                    continue
+                fired = rule.evaluate()
+                self._log(rule, "fire" if fired else "skip")
+                if not fired:
+                    continue
+                if rule.action.startswith("drop"):
+                    drop = True
+                elif rule.action.startswith("delay"):
+                    delay_s += rule.delay_s
+                elif rule.action == "dup_req":
+                    dup = True
+        if not drop and not dup and delay_s <= 0:
+            return _CLEAN
+        return Decision(drop, delay_s, dup)
+
+    def should_drop(self, method: str, kind: str) -> bool:
+        """Legacy hook-compatible view (reference: rpc_chaos.h)."""
+        return self.decide(method, kind).drop
+
+    # ------------------------------------------------------------------
+    def maybe_kill(self, point: str) -> bool:
+        """Process fault points ("worker.exec", "raylet.tick",
+        "gcs.tick"): True when a kill rule fires for this ordinal.  The
+        caller performs the death (os._exit) so the plane stays testable."""
+        if not self.active:
+            return False
+        target = "@" + point
+        with self._lock:
+            for rule in self._rules:
+                if rule.action != "kill":
+                    continue
+                if not fnmatch.fnmatchcase(target, rule.pattern):
+                    continue
+                if rule.evaluate():
+                    self._log(rule, "kill")
+                    return True
+                self._log(rule, "skip")
+        return False
+
+    # ------------------------------------------------------------------
+    def schedule_digest(self) -> str:
+        with self._lock:
+            blob = "\n".join(self.schedule).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def schedule_snapshot(self) -> List[str]:
+        with self._lock:
+            return list(self.schedule)
+
+
+CHAOS = ChaosPlane()

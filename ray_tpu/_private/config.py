@@ -114,9 +114,16 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- rpc ---
     "rpc_connect_timeout_s": 30,
     "rpc_call_timeout_s": 120,
-    # Chaos testing: "method:drop:N" spec list, see rpc.py (reference:
-    # src/ray/rpc/rpc_chaos.h).
+    # Chaos testing (legacy): "method:kind:N" drop list, folded into the
+    # chaos plane (reference: src/ray/rpc/rpc_chaos.h).
     "testing_rpc_failure": "",
+    # Chaos testing: composable fault spec consulted by every RPC
+    # dispatch and by process fault points — see chaos.py for the
+    # grammar (drop/delay/dup by method glob, kill at task N).
+    "testing_chaos_spec": "",
+    # Seed for the chaos plane's per-rule RNG streams and retry jitter;
+    # >= 0 makes the fault schedule replayable, -1 = unseeded.
+    "testing_chaos_seed": -1,
     # Artificial delay injected into every rpc handler, microseconds.
     "testing_asio_delay_us": 0,
     # --- task events / observability ---

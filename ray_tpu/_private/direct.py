@@ -18,13 +18,14 @@ Wire protocol (submitter <-> leased worker, framed-pickle rpc.py):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
 
-from ray_tpu._private import rpc
+from ray_tpu._private import retry, rpc
 from ray_tpu._private.common import TaskSpec
 from ray_tpu._private.config import CONFIG
 
@@ -73,8 +74,6 @@ class DirectTaskSubmitter:
     """One per Worker process; submits normal (non-actor) tasks directly."""
 
     def __init__(self, worker):
-        import os
-
         self._worker = worker
         self._lock = threading.Lock()
         self._keys: Dict[Tuple, _KeyState] = {}
@@ -169,23 +168,41 @@ class DirectTaskSubmitter:
     def _request_lease(self, ks: _KeyState, raylet_client=None, hops: int = 0):
         reply = None
         client = raylet_client or self._worker.raylet_client
-        try:
-            reply = client.call(
-                "request_worker_lease",
-                {
-                    "resources": dict(ks.resources),
-                    "job_id": self._worker.job_id.binary(),
-                    "spilled": hops > 0,
-                    "runtime_env": ks.runtime_env,
-                },
-                timeout=CONFIG.worker_lease_timeout_ms / 1000,
-            )
-        except Exception:
-            # Raylet-side errors cross the wire as their original type
-            # (e.g. OSError from a failed worker spawn) — any failure here
-            # must still decrement requests_inflight via _on_lease_reply
-            # or the scheduling key wedges permanently.
-            reply = None
+        # Idempotency token, stable across retries: a redelivered or
+        # retried request joins the original grant on the raylet side
+        # instead of leasing a second worker that would leak LEASED.
+        token = os.urandom(16)
+        bo = retry.SUBMIT.start()
+        while True:
+            try:
+                reply = client.call(
+                    "request_worker_lease",
+                    {
+                        "resources": dict(ks.resources),
+                        "job_id": self._worker.job_id.binary(),
+                        "spilled": hops > 0,
+                        "runtime_env": ks.runtime_env,
+                        "token": token,
+                    },
+                    timeout=CONFIG.worker_lease_timeout_ms / 1000,
+                )
+                break
+            except rpc.CallTimeout:
+                # Reply lost in flight (the grant may be parked on the
+                # raylet): re-ask with the SAME token — we either join
+                # the in-flight grant or start one.
+                delay = bo.next_delay()
+                if delay is None:
+                    reply = None
+                    break
+                time.sleep(delay)
+            except Exception:
+                # Raylet-side errors cross the wire as their original type
+                # (e.g. OSError from a failed worker spawn) — any failure
+                # here must still decrement requests_inflight via
+                # _on_lease_reply or the scheduling key wedges permanently.
+                reply = None
+                break
         if reply and reply.get("runtime_env_error"):
             self._fail_pending_env(ks, reply["runtime_env_error"])
             reply = None

@@ -155,9 +155,19 @@ class GcsServer:
         self._snapshot_dirty = True
 
     async def _snapshot_loop(self):
+        from ray_tpu._private.chaos import CHAOS
+
         interval = CONFIG.gcs_snapshot_interval_ms / 1000
         while True:
             await asyncio.sleep(interval)
+            # Chaos fault point: "@gcs.tick:kill:at=N" crashes the GCS on
+            # the N-th snapshot tick — drills restart it against the same
+            # session dir (reference: redis-backed GCS restart).
+            if CHAOS.active and CHAOS.maybe_kill("gcs.tick"):
+                logger.warning("chaos: killing GCS at snapshot tick")
+                import os as _os
+
+                _os._exit(1)
             if self._snapshot_dirty:
                 self._snapshot_dirty = False
                 try:

@@ -235,9 +235,11 @@ def start_worker_node(
 
 
 def _wait_for_node(gcs_address: str, proc, timeout: float = 30.0):
-    deadline = time.monotonic() + timeout
+    from ray_tpu._private import retry
+
+    bo = retry.POLL.start(deadline_s=timeout)
     last_err = None
-    while time.monotonic() < deadline:
+    while True:
         if proc.poll() is not None:
             raise RuntimeError(f"head process exited with code {proc.returncode}; see session logs")
         try:
@@ -250,13 +252,17 @@ def _wait_for_node(gcs_address: str, proc, timeout: float = 30.0):
                 client.close()
         except rpc.RpcError as e:
             last_err = e
-        time.sleep(0.05)
-    raise TimeoutError(f"cluster did not come up within {timeout}s: {last_err}")
+        delay = bo.next_delay()
+        if delay is None:
+            raise TimeoutError(f"cluster did not come up within {timeout}s: {last_err}")
+        time.sleep(delay)
 
 
 def _wait_for_raylet(gcs_address: str, raylet_address: str, proc, timeout: float = 30.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    from ray_tpu._private import retry
+
+    bo = retry.POLL.start(deadline_s=timeout)
+    while True:
         if proc.poll() is not None:
             raise RuntimeError(f"raylet process exited with code {proc.returncode}")
         try:
@@ -270,8 +276,10 @@ def _wait_for_raylet(gcs_address: str, raylet_address: str, proc, timeout: float
                 client.close()
         except rpc.RpcError:
             pass
-        time.sleep(0.05)
-    raise TimeoutError("worker node did not register in time")
+        delay = bo.next_delay()
+        if delay is None:
+            raise TimeoutError("worker node did not register in time")
+        time.sleep(delay)
 
 
 def head_raylet_address(gcs_address: str) -> str:

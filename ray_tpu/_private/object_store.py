@@ -771,6 +771,34 @@ class StoreClient:
             tag, value = serialization.deserialize(memoryview(data))
         return tag, value
 
+    def _store_get_meta(self, object_id: ObjectID, timeout: Optional[float]):
+        """store_get with bounded re-asks.
+
+        The raylet parks the request until the object seals, so one lost
+        frame (chaos drop, transient raylet stall) used to hang a
+        timeout-less get forever.  Instead of one unbounded call, park in
+        chunks and re-ask — the server-side wait is idempotent, so
+        re-asking is free and every lost frame costs at most one chunk.
+        Returns the meta dict, or None once the caller's deadline passes.
+        """
+        from ray_tpu._private import rpc as rpc_mod
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            park = min(30.0, max(1.0, CONFIG.rpc_call_timeout_s / 2))
+            if deadline is not None:
+                park = min(park, max(0.0, deadline - time.monotonic()))
+            try:
+                meta = self._raylet.call(
+                    "store_get", (object_id.binary(), park), timeout=park + 5
+                )
+            except rpc_mod.CallTimeout:
+                meta = None  # frame lost in flight: re-ask
+            if meta is not None:
+                return meta
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
     def get_serialized(self, object_id: ObjectID, timeout: Optional[float]):
         """Returns (tag, value) or raises GetTimeoutError/ObjectLostError."""
         from ray_tpu import exceptions
@@ -781,11 +809,11 @@ class StoreClient:
             out = self._deserialize_arena(object_id)
             if out is not None:
                 return out
-        for attempt in range(3):
-            meta = self._raylet.call(
-                "store_get", (object_id.binary(), timeout),
-                timeout=(timeout + 5) if timeout is not None else None,
-            )
+        from ray_tpu._private import retry
+
+        bo = retry.STORE_GET.start()
+        while True:
+            meta = self._store_get_meta(object_id, timeout)
             if meta is None:
                 raise exceptions.GetTimeoutError(f"timed out getting {object_id}")
             if meta.get("lost"):
@@ -802,16 +830,20 @@ class StoreClient:
                     return out
                 # Spilled or evicted between the reply and our lookup:
                 # refetch the meta (a spilled object resolves to a file).
-                continue
-            try:
-                f = open(meta["path"], "rb")
-            except FileNotFoundError:
-                # The object spilled (original file moved) between the
-                # reply and our open: refetch the meta.
-                continue
-            break
-        else:
-            raise exceptions.ObjectLostError(f"{object_id} evicted during get")
+                f = None
+            else:
+                try:
+                    f = open(meta["path"], "rb")
+                except FileNotFoundError:
+                    # The object spilled (original file moved) between the
+                    # reply and our open: refetch the meta.
+                    f = None
+            if f is not None:
+                break
+            delay = bo.next_delay()
+            if delay is None:
+                raise exceptions.ObjectLostError(f"{object_id} evicted during get")
+            time.sleep(delay)
         try:
             m = mmap.mmap(f.fileno(), meta["size"], prot=mmap.PROT_READ)
         finally:

@@ -26,7 +26,8 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import rpc, runtime_env as runtime_env_mod, serialization
+from ray_tpu._private import retry, rpc, runtime_env as runtime_env_mod, serialization
+from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import ResourceSet, TaskSpec
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
@@ -174,6 +175,17 @@ class Raylet:
         # granted as resources free up (reference: lease request queue in
         # cluster_task_manager).
         self.lease_waiters: deque = deque()
+
+        # Idempotency (at-least-once RPC discipline — see
+        # docs/failure_semantics.md).  A duplicated submit_task must not
+        # queue a second execution of the same attempt, and a duplicated
+        # lease request must join the original grant instead of leasing
+        # (and leaking) a second worker.
+        self._seen_submits: Set[Tuple[bytes, int, int]] = set()
+        self._seen_submits_order: deque = deque()
+        # token -> (grant future, expiry monotonic time); swept by the
+        # idle reaper once the submitter's retry horizon has passed.
+        self._lease_grants: Dict[bytes, Tuple[asyncio.Future, float]] = {}
 
         # Metrics
         self.num_tasks_dispatched = 0
@@ -442,17 +454,18 @@ class Raylet:
         self.loop.create_task(self._gcs_reconnect_loop())
 
     async def _gcs_reconnect_loop(self):
-        deadline = time.monotonic() + CONFIG.gcs_reconnect_timeout_s
-        delay = 0.5
+        bo = retry.RECONNECT.start(deadline_s=CONFIG.gcs_reconnect_timeout_s)
         logger.warning("GCS connection lost; reconnecting")
-        while not self._stopping and time.monotonic() < deadline:
+        while not self._stopping:
             try:
                 await self._connect_gcs()
                 logger.info("GCS reconnected")
                 return
             except Exception:
+                delay = bo.next_delay()
+                if delay is None:
+                    break
                 await asyncio.sleep(delay)
-                delay = min(delay * 1.5, 5.0)
         if not self._stopping and self.on_fatal:
             self.on_fatal()
 
@@ -542,6 +555,11 @@ class Raylet:
     # ------------------------------------------------------------------
     async def _report_loop(self):
         while not self._stopping:
+            # Chaos fault point: "@raylet.tick:kill:at=N" dies on the
+            # N-th report tick — the raylet-death axis of the fault plane.
+            if CHAOS.active and CHAOS.maybe_kill("raylet.tick"):
+                logger.warning("chaos: killing raylet at report tick")
+                os._exit(1)
             now = time.monotonic()
             self._unmet_lease_demand = {
                 k: v
@@ -594,6 +612,11 @@ class Raylet:
             limit = CONFIG.idle_worker_pool_size
             kill_after = CONFIG.idle_worker_killing_time_ms / 1000
             now = time.monotonic()
+            # Sweep idempotent lease grants past their retry horizon.
+            for token in [
+                t for t, (_f, exp) in self._lease_grants.items() if exp < now
+            ]:
+                self._lease_grants.pop(token, None)
             for pool_key, dq in self.idle_workers.items():
                 while len(dq) > limit:
                     w = dq.popleft()
@@ -904,10 +927,37 @@ class Raylet:
     async def rpc_submit_task(self, payload, conn):
         spec: TaskSpec = payload["spec"]
         spilled = payload.get("spilled", False)
+        # Idempotency: a duplicated delivery (retry after a lost reply,
+        # chaos dup) must not queue the same attempt twice.  The key
+        # includes `reconstructions` because lineage recovery legitimately
+        # resubmits the SAME (task_id, attempt) with a bumped
+        # reconstruction counter (worker._recover_object).  Spilled
+        # deliveries are exempt: raylet-to-raylet forwards are internal
+        # moves, not client retries — a task spilled away and later
+        # forwarded back (infeasible-retry re-spill) must re-queue, and
+        # the forwarder never retries a submit (it falls back to running
+        # locally on RpcError).
+        key = None
+        if not spilled:
+            key = (spec.task_id.binary(), spec.attempt_number, spec.reconstructions)
+            if key in self._seen_submits:
+                return True
+        # The key is recorded only AFTER the submit side effect lands: if
+        # the handler raises, a retry must re-attempt, not get falsely
+        # acked by the dedupe.  The body below never awaits, so the
+        # check-work-record sequence is atomic per event-loop task even
+        # under chaos-duplicated concurrent deliveries.
         if spec.is_actor_task:
-            return self._submit_actor_task(spec)
-        self._queue_and_schedule(spec, allow_spill=not spilled)
-        return True
+            result = self._submit_actor_task(spec)
+        else:
+            self._queue_and_schedule(spec, allow_spill=not spilled)
+            result = True
+        if key is not None:
+            self._seen_submits.add(key)
+            self._seen_submits_order.append(key)
+            while len(self._seen_submits_order) > 8192:
+                self._seen_submits.discard(self._seen_submits_order.popleft())
+        return result
 
     def _queue_and_schedule(self, spec: TaskSpec, allow_spill: bool = True):
         strategy = spec.scheduling_strategy
@@ -1164,6 +1214,40 @@ class Raylet:
     # straight to the leased worker)
     # ------------------------------------------------------------------
     async def rpc_request_worker_lease(self, payload, conn):
+        token = payload.get("token")
+        if token is None:
+            return await self._request_worker_lease_inner(payload, conn)
+        # Idempotency: a duplicated delivery joins the original grant's
+        # future instead of leasing a second worker that nobody would
+        # ever use or return.
+        ent = self._lease_grants.get(token)
+        if ent is not None:
+            return await asyncio.shield(ent[0])
+        fut = self.loop.create_future()
+        # Grants must outlive the submitter's full retry horizon (up to
+        # retry.SUBMIT.max_attempts lease-timeout-bounded attempts) —
+        # expiring earlier would let a late retry miss the table and
+        # lease a second worker, leaking the first grant LEASED forever.
+        # Expired entries are swept by _idle_reaper_loop (one periodic
+        # pass, not one call_later timer per lease request).
+        horizon = (
+            CONFIG.worker_lease_timeout_ms / 1000
+            * (retry.SUBMIT.max_attempts or 1)
+            + 60
+        )
+        self._lease_grants[token] = (fut, time.monotonic() + horizon)
+        try:
+            reply = await self._request_worker_lease_inner(payload, conn)
+            if not fut.done():
+                fut.set_result(reply)
+            return reply
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # consumed: a lone dup must not warn
+            raise
+
+    async def _request_worker_lease_inner(self, payload, conn):
         res = ResourceSet.of(payload["resources"])
         job_id = JobID(payload["job_id"])
         lease_env = payload.get("runtime_env")
@@ -1545,18 +1629,21 @@ class Raylet:
         task.add_done_callback(_cleanup)
         return task
 
-    async def _safe_gcs_push(self, method, payload, retries: int = 3):
+    async def _safe_gcs_push(self, method, payload):
         """Best-effort GCS call with bounded retries — object location
         add/remove must survive transient drops (a location report lost
         forever makes a live object look 'never sealed' to lost-object
         checks, wedging cross-node gets)."""
-        for attempt in range(retries):
+        bo = retry.GCS_PUSH.start()
+        while True:
             try:
                 await self.gcs.call(method, payload, timeout=10)
                 return
             except rpc.RpcError:
-                if attempt + 1 < retries:
-                    await asyncio.sleep(0.2 * (attempt + 1))
+                delay = bo.next_delay()
+                if delay is None:
+                    return
+                await asyncio.sleep(delay)
 
     async def _await_seal_report(self, oid_bytes: bytes):
         task = self._seal_reports.get(oid_bytes)
@@ -1707,7 +1794,9 @@ class Raylet:
 
     async def _pull_loop(self, oid: ObjectID, fut: asyncio.Future):
         key = oid.binary()
-        delay = 0.05
+        # Jittered poll: a whole node's waiters re-probing a not-yet-sealed
+        # object decorrelate instead of stampeding the GCS in lockstep.
+        bo = retry.PULL_PROBE.start()
         try:
             while not self.store.contains(oid):
                 try:
@@ -1739,8 +1828,7 @@ class Raylet:
                         if not fut.done():
                             fut.set_result("lost")
                         return
-                await asyncio.sleep(delay)
-                delay = min(delay * 1.5, 1.0)
+                await asyncio.sleep(bo.next_delay() or 1.0)
         finally:
             self.pulls.pop(key, None)
             if not fut.done():

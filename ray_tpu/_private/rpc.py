@@ -4,8 +4,9 @@ Fills the role of the reference's gRPC wrappers (reference: src/ray/rpc/
 grpc_server.h, grpc_client.h, client_call.h) without a grpc dependency:
 asyncio servers with per-connection dispatch, a threaded synchronous client
 for drivers/workers, an async client for service-to-service calls, and
-chaos-injection hooks (reference: src/ray/rpc/rpc_chaos.h:23,
-RAY_testing_rpc_failure) wired in from day one.
+the fault-injection plane (reference: src/ray/rpc/rpc_chaos.h:23,
+RAY_testing_rpc_failure; generalized in chaos.py to seeded drop/delay/
+duplicate schedules) wired into every dispatch.
 
 Wire format: [u32 length][pickle payload]
 Payload tuples:
@@ -19,14 +20,15 @@ from __future__ import annotations
 import asyncio
 import os
 import pickle
-import random
 import socket
 import struct
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.config import CONFIG
+from ray_tpu._private import retry
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
@@ -46,44 +48,6 @@ class ConnectionLost(RpcError):
 
 class CallTimeout(RpcError):
     pass
-
-
-# --------------------------------------------------------------------------
-# Chaos injection (reference: src/ray/rpc/rpc_chaos.h — drop request or
-# response the first N times a method is seen).
-# --------------------------------------------------------------------------
-class _Chaos:
-    def __init__(self):
-        self._spec: Dict[str, list] = {}
-        self._lock = threading.Lock()
-        self._parsed_for = None
-
-    def _ensure(self):
-        spec = CONFIG.testing_rpc_failure
-        if spec == self._parsed_for:
-            return
-        with self._lock:
-            self._parsed_for = spec
-            self._spec = {}
-            if spec:
-                # "method:kind:count,method2:kind:count"; kind in req|rep
-                for part in spec.split(","):
-                    m, kind, count = part.split(":")
-                    self._spec[m] = [kind, int(count)]
-
-    def should_drop(self, method: str, kind: str) -> bool:
-        self._ensure()
-        ent = self._spec.get(method)
-        if not ent or ent[0] != kind or ent[1] <= 0:
-            return False
-        with self._lock:
-            if ent[1] <= 0:
-                return False
-            ent[1] -= 1
-            return True
-
-
-CHAOS = _Chaos()
 
 
 def _parse_address(address: str):
@@ -194,10 +158,24 @@ class RpcServer:
         delay_us = CONFIG.testing_asio_delay_us
         if delay_us:
             await asyncio.sleep(delay_us / 1e6)
+        if CHAOS.active:
+            # One decision per delivery: drop (handler never runs), delay
+            # (handler runs late), duplicate (handler runs twice — the
+            # second run models a retried RPC whose first reply was lost,
+            # so idempotency tokens on lease/submit are load-bearing).
+            method = msg[2] if msg[0] == "req" else msg[1]
+            d = CHAOS.decide(method, "req")
+            if d.delay_s > 0:
+                await asyncio.sleep(d.delay_s)
+            if d.drop:
+                return
+            if d.dup:
+                asyncio.ensure_future(self._deliver(msg, conn))
+        await self._deliver(msg, conn)
+
+    async def _deliver(self, msg, conn: ClientConn):
         if msg[0] == "req":
             _, req_id, method, payload = msg
-            if CHAOS.should_drop(method, "req"):
-                return
             fn = getattr(self.handler, "rpc_" + method, None)
             try:
                 if fn is None:
@@ -206,8 +184,12 @@ class RpcServer:
                 ok = True
             except Exception as e:  # noqa: BLE001 — errors cross the wire
                 result, ok = e, False
-            if CHAOS.should_drop(method, "rep"):
-                return
+            if CHAOS.active:
+                rep = CHAOS.decide(method, "rep")
+                if rep.delay_s > 0:
+                    await asyncio.sleep(rep.delay_s)
+                if rep.drop:
+                    return
             if conn.closed:
                 return
             try:
@@ -259,7 +241,7 @@ class AsyncRpcClient:
     async def connect(self, timeout: float = None):
         timeout = timeout or CONFIG.rpc_connect_timeout_s
         kind, target = _parse_address(self.address)
-        deadline = time.monotonic() + timeout
+        bo = retry.CONNECT.start(deadline_s=timeout)
         while True:
             try:
                 if kind == "unix":
@@ -268,9 +250,10 @@ class AsyncRpcClient:
                     self._reader, self._writer = await asyncio.open_connection(*target)
                 break
             except (ConnectionRefusedError, FileNotFoundError):
-                if time.monotonic() > deadline:
+                delay = bo.next_delay()
+                if delay is None:
                     raise ConnectionLost(f"cannot connect to {self.address}")
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(delay)
         self._connected = True
         self._read_task = asyncio.ensure_future(self._read_loop())
         return self
@@ -372,7 +355,7 @@ class RpcClient:
 
     def _connect(self):
         kind, target = _parse_address(self.address)
-        deadline = time.monotonic() + CONFIG.rpc_connect_timeout_s
+        bo = retry.CONNECT.start(deadline_s=CONFIG.rpc_connect_timeout_s)
         while True:
             try:
                 if kind == "unix":
@@ -383,9 +366,10 @@ class RpcClient:
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return s
             except (ConnectionRefusedError, FileNotFoundError):
-                if time.monotonic() > deadline:
+                delay = bo.next_delay()
+                if delay is None:
                     raise ConnectionLost(f"cannot connect to {self.address}")
-                time.sleep(0.05 + random.random() * 0.05)
+                time.sleep(delay)
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -488,6 +472,10 @@ class RpcClient:
     def closed(self):
         return self._closed
 
+    @property
+    def ready(self) -> bool:
+        return not self._closed
+
 
 # --------------------------------------------------------------------------
 # Reconnecting sync client (drivers/workers -> GCS).  The reference keeps
@@ -517,13 +505,16 @@ class ReconnectingRpcClient:
                          name=f"rpc-reconnect-{self.address[-16:]}").start()
 
     def _reconnect_loop(self):
-        deadline = time.monotonic() + CONFIG.gcs_reconnect_timeout_s
-        while not self._closed and time.monotonic() < deadline:
+        bo = retry.RECONNECT.start(deadline_s=CONFIG.gcs_reconnect_timeout_s)
+        while not self._closed:
             try:
                 inner = RpcClient(self.address, on_push=self.on_push,
                                   on_close=self._on_inner_close)
             except RpcError:
-                time.sleep(0.5)
+                delay = bo.next_delay()
+                if delay is None:
+                    break
+                time.sleep(delay)
                 continue
             with self._lock:
                 self._inner = inner
@@ -562,6 +553,14 @@ class ReconnectingRpcClient:
 
     def push(self, method: str, payload: Any = None):
         for _ in range(2):
+            if not self._ready.is_set():
+                # Reconnect in progress.  Pushes are best-effort by
+                # contract (every caller catches and compensates) — fail
+                # fast rather than parking the caller for the whole
+                # reconnect window: a blocking push here once stalled
+                # stream consumption for the full 60 s GCS outage budget
+                # (found by the gcs-restart-mid-stream drill).
+                raise ConnectionLost(f"reconnecting to {self.address}")
             try:
                 return self._client().push(method, payload)
             except ConnectionLost:
@@ -581,3 +580,10 @@ class ReconnectingRpcClient:
     @property
     def closed(self):
         return self._closed
+
+    @property
+    def ready(self) -> bool:
+        """Non-blocking liveness probe: False while a reconnect is in
+        progress (calls would park on the reconnect gate) or after
+        give-up.  Best-effort callers consult this instead of blocking."""
+        return self._ready.is_set() and not self._closed

@@ -399,14 +399,17 @@ def _fetch_package(gcs_client, uri: str, dest_dir: str, session_dir: str) -> str
         # the package missing.
         import time as _time
 
-        deadline = _time.monotonic() + 15
+        from ray_tpu._private import retry as _retry
+
+        bo = _retry.KV_STAGING.start(deadline_s=15)
         while True:
             blob = gcs_client.call("kv_get", (KV_NS, name.encode()), timeout=60)
             if blob is not None:
                 break
-            if _time.monotonic() > deadline:
+            delay = bo.next_delay()
+            if delay is None:
                 raise RuntimeEnvError(f"runtime_env package {uri} not found in GCS")
-            _time.sleep(0.2)
+            _time.sleep(delay)
         tmp = final + ".staging"
         if os.path.isdir(tmp):
             import shutil

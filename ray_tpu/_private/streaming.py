@@ -130,6 +130,9 @@ class ObjectRefGenerator:
         oid = self._spec.stream_item_id(self._consumed)
         if self._fallback_deadline is None:
             self._fallback_deadline = time.monotonic() + 2.0
+        from ray_tpu._private import retry
+
+        bo = retry.STREAM_POLL.start()
         while True:
             with self._state.cond:
                 arrived = self._consumed in self._state.arrived
@@ -160,7 +163,7 @@ class ObjectRefGenerator:
                 )
             if not block:
                 return None
-            time.sleep(0.1)
+            time.sleep(bo.next_delay() or 0.1)
 
     def _resolve_sentinel(self):
         """Read return 0: StreamEnd(count) or raises the task error."""
@@ -243,7 +246,12 @@ class ObjectRefGenerator:
         node, which need not be the owner's (a local store_contains would
         never see them)."""
         try:
-            if self._worker.gcs_client.call(
+            gcs = self._worker.gcs_client
+            # A best-effort probe must not park on the GCS reconnect gate:
+            # during an outage, consumption continues on pushes + the
+            # local store check (found by the gcs-restart-mid-stream
+            # drill, which this once stalled for the whole 60 s budget).
+            if getattr(gcs, "ready", False) and gcs.call(
                 "object_locations_get", oid.binary(), timeout=10
             ):
                 return True

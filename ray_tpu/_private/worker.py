@@ -17,11 +17,12 @@ import queue
 import threading
 import time
 import traceback
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions
-from ray_tpu._private import rpc, serialization
+from ray_tpu._private import retry, rpc, serialization
+from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import ResourceSet, SchedulingStrategy, TaskSpec
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -193,7 +194,12 @@ class ReferenceCounter:
             if self._worker.gcs_client and not self._worker.gcs_client.closed:
                 self._worker.gcs_client.push("free_objects", batch)
         except Exception:
-            pass
+            # GCS unreachable (e.g. reconnecting): keep the batch for the
+            # background flusher to retry — frees must not silently vanish
+            # across a GCS restart.  Bounded so a permanently dead GCS
+            # can't grow this without limit.
+            self._to_free = (batch + self._to_free)[:100_000]
+            self._ensure_flusher_locked()
 
     def _ensure_flusher_locked(self):
         """Freed ids batch up to amortize the GCS push, but a trickle of
@@ -386,6 +392,10 @@ class Worker:
         self._actor_expected: Dict[bytes, int] = {}
         self._actor_buffer: Dict[bytes, Dict[int, tuple]] = {}
         self._actor_caller_inc: Dict[bytes, int] = {}
+        # Normal-task dedupe for duplicated exec_direct deliveries:
+        # (task_id, attempt, reconstructions) already admitted here.
+        self._direct_admitted: set = set()
+        self._direct_admitted_order: "deque" = deque()
         # Direct channels to actor workers: actor_id -> _ActorChannel.
         self._actor_channels: Dict[ActorID, Any] = {}
         # Owner-side streaming-generator state: task_id bytes -> _StreamState
@@ -548,6 +558,18 @@ class Worker:
         if spec.is_actor_task:
             self._admit_actor_task(spec, conn)
         else:
+            # Idempotency: a duplicated delivery (resend after reconnect,
+            # chaos dup) of the same attempt must not run the task twice.
+            # Reconstruction resubmits bump spec.reconstructions, so a
+            # legitimate re-execution of a recovered task still admits.
+            key = (spec.task_id.binary(), spec.attempt_number, spec.reconstructions)
+            with self._admit_lock:
+                if key in self._direct_admitted:
+                    return
+                self._direct_admitted.add(key)
+                self._direct_admitted_order.append(key)
+                while len(self._direct_admitted_order) > 8192:
+                    self._direct_admitted.discard(self._direct_admitted_order.popleft())
             self._exec_queue.put((spec, conn))
 
     def _admit_actor_task(self, spec: TaskSpec, conn):
@@ -619,6 +641,8 @@ class Worker:
         self._recovery_inflight.clear()
         self._actor_seq.clear()
         self._actor_send_inc.clear()
+        self._direct_admitted.clear()
+        self._direct_admitted_order.clear()
         self._runtime_env_norm_cache.clear()
         self._oom_worker_kills.clear()
         self._cancelled_tasks.clear()
@@ -814,10 +838,24 @@ class Worker:
             self.gcs_client.call(
                 "objects_resubmitted", [o.binary() for o in spec.return_ids()]
             )
-            self.raylet_client.call("submit_task", {"spec": spec})
+            self._submit_with_retry(self.raylet_client, spec)
         except rpc.RpcError:
             return False
         return True
+
+    def _submit_with_retry(self, client, spec: TaskSpec):
+        """submit_task is at-least-once: the raylet dedupes deliveries by
+        (task_id, attempt, reconstructions), so a lost reply is safely
+        retried — the duplicate acks without queueing a second run."""
+        bo = retry.SUBMIT.start()
+        while True:
+            try:
+                return client.call("submit_task", {"spec": spec})
+            except rpc.CallTimeout:
+                delay = bo.next_delay()
+                if delay is None:
+                    raise
+                time.sleep(delay)
 
     async def get_async(self, ref: ObjectRef):
         """Used by `await ref` inside async actors."""
@@ -1059,12 +1097,12 @@ class Worker:
             except Exception:
                 self.memory_store.resolve_stored(oids)
                 self.reference_counter.escalate_to_escape(tid, borrowed)
-                self.raylet_client.call("submit_task", {"spec": spec})
+                self._submit_with_retry(self.raylet_client, spec)
         else:
             # Raylet-mediated: no owner-side completion signal — args
             # stay pinned until job-end GC (escaped).
             self.reference_counter.escalate_to_escape(tid, borrowed)
-            self.raylet_client.call("submit_task", {"spec": spec})
+            self._submit_with_retry(self.raylet_client, spec)
         if generator is not None:
             return generator
         return [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
@@ -1365,7 +1403,7 @@ class Worker:
             # No owner-side completion signal on this path: the spec's arg
             # borrows escape until job-end GC.
             self.reference_counter.escalate_to_escape(spec.task_id.binary())
-            client.call("submit_task", {"spec": spec})
+            self._submit_with_retry(client, spec)
             # Results will be sealed in the shm store: stop gets from
             # waiting on the memory store for them.
             self.memory_store.resolve_stored(oids)
@@ -1539,6 +1577,14 @@ class Worker:
         self.disconnect()
 
     def _execute_task_guarded(self, spec: TaskSpec, conn=None):
+        # Chaos fault point: "@worker.exec:kill:at=N" hard-kills this
+        # worker process on its N-th task execution (reference:
+        # test_utils RayletKiller generalized to the worker plane).  The
+        # exit is deliberately os._exit — no atexit, no socket teardown —
+        # matching a SIGKILL/OOM death.
+        if CHAOS.active and CHAOS.maybe_kill("worker.exec"):
+            logger.warning("chaos: killing worker before task %s", spec.name)
+            os._exit(1)
         start = time.time()
         error = None
         # enter a child span of the submitter's trace context, so spans
@@ -1609,7 +1655,7 @@ class Worker:
                 _, value = serialization.deserialize(memoryview(payload))
             elif kind == "ref":
                 oid = ObjectID(payload)
-                attempts = 0
+                bo = retry.ARG_RESOLVE.start()
                 while True:
                     try:
                         tag, value = self.store.get_serialized(oid, None)
@@ -1619,12 +1665,12 @@ class Worker:
                         # reconstruct.  Otherwise fail fast: the stored
                         # ObjectLostError-caused error routes recovery to
                         # the owner's get (Worker._get_one).
-                        attempts += 1
                         if self._recover_object(oid):
                             continue
-                        if attempts >= 2:
+                        delay = bo.next_delay()
+                        if delay is None:
                             raise
-                        time.sleep(1.0)
+                        time.sleep(delay)
                 if tag == serialization.TAG_ERROR:
                     raise value if not isinstance(value, exceptions.RayTaskError) else value.as_instanceof_cause()
             values.append(value)

@@ -147,6 +147,47 @@ def test_failure_config_retries(ray_cluster, tmp_path):
     assert result.metrics == {"ok": 1}
 
 
+def _kill_rank1_once_loop(config):
+    """First attempt: rank 1 dies HARD (os._exit — no exception, no
+    teardown, the signature of an OOM/SIGKILL/preempted-host death)
+    mid-training, after the jax.distributed rendezvous is up.  Second
+    attempt: everyone trains to completion."""
+    import jax
+
+    ctx = train.get_context()
+    # The re-rendezvous proof: every attempt sees the FULL world again —
+    # process_count comes from the jax.distributed coordinator, so a
+    # half-rebuilt group would fail here.
+    assert jax.process_count() == config["num_workers"]
+    marker = config["marker"]
+    if ctx.get_world_rank() == 1 and not os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write("killed")
+        os._exit(1)
+    train.report({"ok": 1, "procs": jax.process_count()})
+
+
+def test_killed_worker_whole_mesh_restart(ray_cluster, tmp_path):
+    """Recovery drill (ISSUE 1): a killed training worker triggers a
+    clean WHOLE-mesh restart — XLA's world is static, so the dead rank
+    cannot rejoin; the group is torn down, fresh workers are leased, and
+    jax.distributed re-rendezvouses with a new coordinator — and the job
+    completes."""
+    marker = tmp_path / "rank1_killed"
+    trainer = JaxTrainer(
+        _kill_rank1_once_loop,
+        train_loop_config={"marker": str(marker), "num_workers": 2},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="mesh_restart", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.metrics == {"ok": 1, "procs": 2}
+    assert marker.exists(), "the fault was never injected"
+
+
 def test_failure_without_retries_raises(ray_cluster, tmp_path):
     def always_fail(config):
         raise ValueError("nope")
