@@ -223,6 +223,7 @@ class ChaosPlane:
             return _CLEAN
         drop = dup = False
         delay_s = 0.0
+        fired_rules = []
         with self._lock:
             for rule in self._rules:
                 if rule.action == "kill" or not rule.action.endswith(kind):
@@ -233,12 +234,15 @@ class ChaosPlane:
                 self._log(rule, "fire" if fired else "skip")
                 if not fired:
                     continue
+                fired_rules.append(rule)
                 if rule.action.startswith("drop"):
                     drop = True
                 elif rule.action.startswith("delay"):
                     delay_s += rule.delay_s
                 elif rule.action == "dup_req":
                     dup = True
+        for rule in fired_rules:  # outside the lock: metric writes lock too
+            _count_injection(rule)
         if not drop and not dup and delay_s <= 0:
             return _CLEAN
         return Decision(drop, delay_s, dup)
@@ -263,6 +267,7 @@ class ChaosPlane:
                     continue
                 if rule.evaluate():
                     self._log(rule, "kill")
+                    _count_injection(rule)
                     return True
                 self._log(rule, "skip")
         return False
@@ -276,6 +281,45 @@ class ChaosPlane:
     def schedule_snapshot(self) -> List[str]:
         with self._lock:
             return list(self.schedule)
+
+    def stats(self) -> dict:
+        """Per-rule injection accounting for the dashboard /api/chaos
+        endpoint: the active spec plus each rule's match/fire counters
+        (this process's view; the dashboard merges GCS + raylets)."""
+        self._ensure()
+        with self._lock:
+            rules = [
+                {
+                    "index": r.index,
+                    "pattern": r.pattern,
+                    "action": r.action,
+                    "n": r.n,
+                    "p": r.p,
+                    "delay_ms": round(r.delay_s * 1000, 3),
+                    "after": r.after,
+                    "matches": r.matches,
+                    "fired": r.fired,
+                }
+                for r in self._rules
+            ]
+            schedule_len = self.schedule_len
+        return {
+            "active": bool(rules),
+            "spec": CONFIG.testing_chaos_spec,
+            "legacy_spec": CONFIG.testing_rpc_failure,
+            "seed": int(CONFIG.testing_chaos_seed),
+            "rules": rules,
+            "schedule_len": schedule_len,
+        }
+
+
+def _count_injection(rule: _Rule) -> None:
+    try:
+        from ray_tpu._private import telemetry
+
+        telemetry.count_chaos(rule.pattern, rule.action)
+    except Exception:
+        pass
 
 
 CHAOS = ChaosPlane()

@@ -129,6 +129,15 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # --- task events / observability ---
     "task_events_buffer_size": 10_000,
     "metrics_report_interval_ms": 5_000,
+    # Flight recorder: core-path metric/span instrumentation (rpc latency,
+    # task phases, object store, retries, chaos injections).  Off = the
+    # instrumentation sites become a single boolean check.
+    "telemetry_enabled": True,
+    # GCS-side buffer of finished spans shipped by the per-process span
+    # flusher (util/tracing); oldest spans are dropped past this.
+    "span_buffer_size": 50_000,
+    # Period of the background span flusher in every traced process.
+    "span_flush_interval_ms": 1_000,
     # --- gcs ---
     # "file": periodically snapshot GCS state (actors/PGs/KV/jobs) to the
     # session dir so a restarted GCS resumes the cluster (reference: redis

@@ -25,7 +25,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
 
-from ray_tpu._private import retry, rpc
+from ray_tpu._private import retry, rpc, telemetry
 from ray_tpu._private.common import TaskSpec
 from ray_tpu._private.config import CONFIG
 
@@ -173,6 +173,7 @@ class DirectTaskSubmitter:
         # instead of leasing a second worker that would leak LEASED.
         token = os.urandom(16)
         bo = retry.SUBMIT.start()
+        lease_t0 = time.perf_counter()
         while True:
             try:
                 reply = client.call(
@@ -212,6 +213,8 @@ class DirectTaskSubmitter:
                 return self._request_lease(ks, raylet_client=peer, hops=hops + 1)
             except rpc.RpcError:
                 reply = None
+        if reply and reply.get("worker_id"):
+            telemetry.observe_task_phase("lease", time.perf_counter() - lease_t0)
         self._on_lease_reply(ks, reply, client)
 
     def _on_lease_reply(self, ks: _KeyState, reply: Optional[dict], raylet_client) -> None:
@@ -274,6 +277,7 @@ class DirectTaskSubmitter:
             started = lease.started.pop(tid, None)
             if started is not None:
                 t0, qpos = started
+                telemetry.observe_task_phase("e2e", time.monotonic() - t0)
                 dt_ms = (time.monotonic() - t0) * 1000 / max(1, qpos)
                 ks.ewma_ms = dt_ms if ks.ewma_ms is None else 0.8 * ks.ewma_ms + 0.2 * dt_ms
             self._assign_locked(ks)

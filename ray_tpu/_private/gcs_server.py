@@ -86,6 +86,10 @@ class GcsServer:
         # stats/metric_exporter.h metric aggregation) ---
         self.task_events: "deque" = deque(maxlen=int(CONFIG.task_events_buffer_size))
         self.metrics: Dict[bytes, list] = {}  # worker_id -> latest snapshot
+        # Flight recorder: finished spans from every process's span
+        # flusher (util/tracing.flush); merged cluster-wide by
+        # util.state.timeline() and the dashboard /api/timeline.
+        self.spans: "deque" = deque(maxlen=int(CONFIG.span_buffer_size))
         self.pending_shapes: Dict[NodeID, list] = {}  # autoscaler demand
 
         self.server.on_disconnect = self._on_disconnect
@@ -108,6 +112,11 @@ class GcsServer:
     async def start(self):
         if CONFIG.gcs_storage == "file":
             self._load_snapshot()
+        # This process's own metric/span reports (rpc handler latency,
+        # chaos counters) go straight into the tables — no RPC to self.
+        from ray_tpu.util import metrics as metrics_mod
+
+        metrics_mod.set_report_channel(self._local_report, b"__gcs__")
         await self.server.start()
         self._bg_tasks.append(self.loop.create_task(self._health_loop()))
         if CONFIG.gcs_storage == "file":
@@ -1135,6 +1144,44 @@ class GcsServer:
     async def rpc_metrics_report(self, payload, conn):
         self.metrics[payload.get("worker_id", b"")] = payload.get("metrics", [])
         return True
+
+    def _local_report(self, method: str, payload: dict):
+        """In-process report channel for the GCS's own flusher threads.
+        Mutations hop onto the event loop: handlers iterate self.spans /
+        self.metrics, and a flusher-thread extend mid-iteration would
+        raise 'mutated during iteration' inside rpc_list_spans /
+        rpc_metrics_get."""
+
+        def apply():
+            if method == "metrics_report":
+                self.metrics[payload.get("worker_id", b"")] = payload.get("metrics", [])
+            elif method == "span_report":
+                self.spans.extend(payload.get("spans", ()))
+
+        self.loop.call_soon_threadsafe(apply)
+
+    async def rpc_span_report(self, payload, conn):
+        """Batched finished spans from a process's span flusher
+        (util/tracing.flush — the off-box half of the flight recorder)."""
+        self.spans.extend(payload.get("spans", ()))
+        return True
+
+    async def rpc_list_spans(self, payload, conn):
+        limit = (payload or {}).get("limit", 100_000)
+        n = len(self.spans)
+        if limit >= n:
+            return list(self.spans)
+        # Newest `limit` spans without materializing the whole ring.
+        from itertools import islice
+
+        return list(islice(self.spans, n - limit, n))
+
+    async def rpc_chaos_stats(self, payload, conn):
+        """This process's chaos-plane view (the dashboard merges raylet
+        views from node_stats on top)."""
+        from ray_tpu._private.chaos import CHAOS
+
+        return CHAOS.stats()
 
     async def rpc_metrics_get(self, payload, conn):
         """Aggregate metric records across workers: counters/histograms sum,

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ObjectID
+from ray_tpu._private import telemetry
 
 SEALED = 1
 INLINE = 2
@@ -669,19 +670,35 @@ class StoreClient:
 
     def put_blob(self, object_id: ObjectID, blob: bytes) -> int:
         """Store an already-flattened serialized blob."""
-        if len(blob) <= CONFIG.max_direct_call_object_size:
-            # bytearray ships as-is; the raylet's put_inline owns the copy
-            self._raylet.call("store_put_inline", (object_id.binary(), blob))
-            return len(blob)
-        path = os.path.join(self.store_dir, object_id.hex())
-        tmp = path + ".w"
-        with open(tmp, "w+b") as f:
-            f.write(blob)
-        os.rename(tmp, path)
-        self._raylet.call("store_seal", (object_id.binary(), len(blob)))
-        return len(blob)
+        t0 = time.perf_counter()
+        stored = None
+        try:
+            if len(blob) <= CONFIG.max_direct_call_object_size:
+                # bytearray ships as-is; the raylet's put_inline owns the copy
+                self._raylet.call("store_put_inline", (object_id.binary(), blob))
+                stored = len(blob)
+                return stored
+            path = os.path.join(self.store_dir, object_id.hex())
+            tmp = path + ".w"
+            with open(tmp, "w+b") as f:
+                f.write(blob)
+            os.rename(tmp, path)
+            self._raylet.call("store_seal", (object_id.binary(), len(blob)))
+            stored = len(blob)
+            return stored
+        finally:
+            telemetry.observe_store("put", time.perf_counter() - t0, stored)
 
     def put_serialized(self, object_id: ObjectID, meta: bytes, buffers: List[memoryview]) -> int:
+        t0 = time.perf_counter()
+        total = None
+        try:
+            total = self._put_serialized_inner(object_id, meta, buffers)
+            return total
+        finally:
+            telemetry.observe_store("put", time.perf_counter() - t0, total)
+
+    def _put_serialized_inner(self, object_id: ObjectID, meta: bytes, buffers: List[memoryview]) -> int:
         from ray_tpu._private import serialization
 
         total = serialization.total_size(meta, buffers)
@@ -801,6 +818,13 @@ class StoreClient:
 
     def get_serialized(self, object_id: ObjectID, timeout: Optional[float]):
         """Returns (tag, value) or raises GetTimeoutError/ObjectLostError."""
+        t0 = time.perf_counter()
+        try:
+            return self._get_serialized_inner(object_id, timeout)
+        finally:
+            telemetry.observe_store("get", time.perf_counter() - t0)
+
+    def _get_serialized_inner(self, object_id: ObjectID, timeout: Optional[float]):
         from ray_tpu import exceptions
         from ray_tpu._private import serialization
 
@@ -823,6 +847,7 @@ class StoreClient:
                     object_id, f"all copies of {object_id} were lost from the cluster"
                 )
             if "inline" in meta:
+                telemetry.count_store_bytes("get", len(meta["inline"]))
                 return serialization.deserialize(memoryview(meta["inline"]))
             if meta.get("arena"):
                 out = self._deserialize_arena(object_id)
@@ -848,6 +873,7 @@ class StoreClient:
             m = mmap.mmap(f.fileno(), meta["size"], prot=mmap.PROT_READ)
         finally:
             f.close()
+        telemetry.count_store_bytes("get", meta["size"])
         tag, value = serialization.deserialize(memoryview(m))
         if serialization.buffer_count(memoryview(m)) == 0:
             _close_mmap_quietly(m)

@@ -26,7 +26,7 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import retry, rpc, runtime_env as runtime_env_mod, serialization
+from ray_tpu._private import retry, rpc, runtime_env as runtime_env_mod, serialization, telemetry
 from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import ResourceSet, TaskSpec
 from ray_tpu._private.config import CONFIG
@@ -202,6 +202,14 @@ class Raylet:
     async def start(self):
         await self.server.start()
         await self._connect_gcs(first=True)
+        # Route this process's metric/span reports through the raylet's
+        # own GCS client (there is no connected worker here); keyed by
+        # node id in the GCS metrics table.
+        from ray_tpu.util import metrics as metrics_mod
+
+        metrics_mod.set_report_channel(
+            self._telemetry_channel, b"raylet:" + self.node_id.binary()
+        )
         self._bg.append(self.loop.create_task(self._report_loop()))
         self._bg.append(self.loop.create_task(self._idle_reaper_loop()))
         if CONFIG.memory_monitor_enabled:
@@ -831,7 +839,7 @@ class Raylet:
             spec.attempt_number += 1
             logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt_number, reason)
             self.loop.call_later(
-                CONFIG.task_retry_delay_ms / 1000, lambda: (self.queue.append(spec), self._schedule_dispatch())
+                CONFIG.task_retry_delay_ms / 1000, lambda: (self._enqueue_local(spec), self._schedule_dispatch())
             )
             return
         if reason.startswith("oom:"):
@@ -1001,8 +1009,16 @@ class Raylet:
                     ),
                 )
                 return
-        self.queue.append(spec)
+        self._enqueue_local(spec)
         self._schedule_dispatch()
+
+    def _enqueue_local(self, spec: TaskSpec):
+        """Every local-queue insertion goes through here so queued_at is
+        (re)stamped: retries and failed forwards re-enter the queue, and
+        a stale stamp would fold execution + retry delay into the
+        task_phase_seconds{phase=queue} signal."""
+        spec.queued_at = time.monotonic()
+        self.queue.append(spec)
 
     def _cluster_decision(self, spec: TaskSpec) -> Optional[str]:
         """Return a peer raylet address to spill to, or None to keep local.
@@ -1030,7 +1046,7 @@ class Raylet:
             await client.call("submit_task", {"spec": spec, "spilled": True})
         except rpc.RpcError:
             # Peer vanished: schedule locally/queue.
-            self.queue.append(spec)
+            self._enqueue_local(spec)
             self._schedule_dispatch()
 
     async def _peer(self, address: str) -> rpc.AsyncRpcClient:
@@ -1184,6 +1200,9 @@ class Raylet:
         w.running[spec.task_id.binary()] = spec
         w.resources_held.add(self._task_resources(spec)) if w.actor_id is None else None
         self.num_tasks_dispatched += 1
+        queued_at = getattr(spec, "queued_at", None)
+        if queued_at is not None:
+            telemetry.observe_task_phase("queue", time.monotonic() - queued_at)
         w.conn.push("execute_task", {"spec": spec})
 
     async def rpc_task_done(self, payload, conn):
@@ -1800,7 +1819,12 @@ class Raylet:
         try:
             while not self.store.contains(oid):
                 try:
-                    locations = await self.gcs.call("object_locations_get", key, timeout=10)
+                    # One retry only: the surrounding pull loop already
+                    # re-asks on its own backoff cadence.
+                    locations = await rpc.call_idempotent_async(
+                        self.gcs, "object_locations_get", key, timeout=10,
+                        policy=retry.GCS_READ_BULK,
+                    )
                 except rpc.RpcError:
                     locations = []
                 pulled = False
@@ -1894,6 +1918,26 @@ class Raylet:
 
         await event_loop_lag_loop(self, self.loop, stop_pred=lambda: self._stopping)
 
+    def _telemetry_channel(self, method: str, payload: dict):
+        """Report delivery for util.metrics/tracing flusher threads: hop
+        onto the raylet loop and through its GCS client.  Fails fast
+        when the loop is stopped/stopping — the atexit flush must not
+        park a coroutine on a dead loop and stall raylet shutdown."""
+        gcs = self.gcs
+        if (
+            gcs is None
+            or not gcs._connected
+            or self._stopping
+            or not self.loop.is_running()
+        ):
+            raise rpc.ConnectionLost("gcs not reachable for telemetry report")
+        fut = asyncio.run_coroutine_threadsafe(gcs.call(method, payload), self.loop)
+        try:
+            fut.result(timeout=5)
+        except Exception:
+            fut.cancel()
+            raise
+
     async def rpc_node_stats(self, payload, conn):
         return {
             "node_id": self.node_id.binary(),
@@ -1907,6 +1951,7 @@ class Raylet:
             "num_tasks_spilled": self.num_tasks_spilled,
             "event_loop_lag_ms": round(self.event_loop_lag_ms, 3),
             "event_loop_lag_max_ms": round(self.event_loop_lag_max_ms, 3),
+            "chaos": CHAOS.stats(),
             "running_tasks": [
                 {"task_id": tb, "name": s.name, "worker_pid": w.pid}
                 for w in self.workers.values()

@@ -77,6 +77,8 @@ class RetryPolicy:
     deadline_s: Optional[float] = None
     max_attempts: Optional[int] = None
     jitter: str = "decorrelated"
+    # Metric label for retry_backoff_total; "" = not counted.
+    name: str = ""
 
     def start(self, deadline_s: Optional[float] = None,
               rng: Optional[random.Random] = None) -> "Backoff":
@@ -129,6 +131,10 @@ class Backoff:
             if rem <= 0:
                 return None
             delay = min(delay, rem)
+        if p.name:
+            from ray_tpu._private import telemetry
+
+            telemetry.count_retry(p.name)
         return delay
 
 
@@ -141,39 +147,50 @@ class Backoff:
 # Connect loops (rpc clients dialing a server that is still binding).
 # Low cap: connect latency gates every startup path, so the jitter only
 # decorrelates — it must not grow into whole-second stalls.
-CONNECT = RetryPolicy(base_s=0.05, cap_s=0.25)
+CONNECT = RetryPolicy(base_s=0.05, cap_s=0.25, name="connect")
 
 # Readiness polls (wait-for-node/raylet registration).  Latency-critical:
 # whoever awaits this gates scheduling decisions (e.g. the autoscaler's
 # launch accounting), so delays stay near the base.
-POLL = RetryPolicy(base_s=0.02, cap_s=0.1)
+POLL = RetryPolicy(base_s=0.02, cap_s=0.1, name="poll")
 
 # Reconnect loops against a restarting service (GCS).  Budget supplied
 # by the caller from gcs_reconnect_timeout_s.
-RECONNECT = RetryPolicy(base_s=0.25, cap_s=5.0)
+RECONNECT = RetryPolicy(base_s=0.25, cap_s=5.0, name="reconnect")
 
 # Best-effort control-plane pushes (location reports etc.).
-GCS_PUSH = RetryPolicy(base_s=0.1, cap_s=2.0, max_attempts=4)
+GCS_PUSH = RetryPolicy(base_s=0.1, cap_s=2.0, max_attempts=4, name="gcs_push")
 
 # Local store re-reads racing spilling/eviction.
-STORE_GET = RetryPolicy(base_s=0.02, cap_s=0.5, max_attempts=4)
+STORE_GET = RetryPolicy(base_s=0.02, cap_s=0.5, max_attempts=4, name="store_get")
 
 # Argument resolution racing lineage reconstruction.
-ARG_RESOLVE = RetryPolicy(base_s=0.2, cap_s=2.0, max_attempts=4)
+ARG_RESOLVE = RetryPolicy(base_s=0.2, cap_s=2.0, max_attempts=4, name="arg_resolve")
 
 # KV reads racing an upload that is in flight.
-KV_STAGING = RetryPolicy(base_s=0.1, cap_s=1.0)
+KV_STAGING = RetryPolicy(base_s=0.1, cap_s=1.0, name="kv_staging")
 
 # Idempotent submit/lease RPCs whose reply was lost in flight (the
 # server dedupes redeliveries by token — see docs/failure_semantics.md).
-SUBMIT = RetryPolicy(base_s=0.1, cap_s=1.0, max_attempts=4)
+SUBMIT = RetryPolicy(base_s=0.1, cap_s=1.0, max_attempts=4, name="submit")
 
 # Owner-side stream-item polls (push path fallback probes).
-STREAM_POLL = RetryPolicy(base_s=0.01, cap_s=0.1)
+STREAM_POLL = RetryPolicy(base_s=0.01, cap_s=0.1, name="stream_poll")
 
 # Raylet object-manager pull probes against a not-yet-sealed object.
-PULL_PROBE = RetryPolicy(base_s=0.05, cap_s=1.0)
+PULL_PROBE = RetryPolicy(base_s=0.05, cap_s=1.0, name="pull_probe")
 
 # bench.py chip probe: attempts are whole subprocesses, so delays are
 # coarse.
-BENCH_PROBE = RetryPolicy(base_s=1.0, cap_s=15.0)
+BENCH_PROBE = RetryPolicy(base_s=1.0, cap_s=15.0, name="bench_probe")
+
+# Idempotent GCS reads (kv_get, object locations) whose reply was lost in
+# flight: re-asking has no side effects, so a CallTimeout gets a bounded
+# retry instead of failing the caller (see rpc.call_idempotent).  Callers
+# MUST pass a short per-attempt timeout — retrying multiplies it.
+GCS_READ = RetryPolicy(base_s=0.1, cap_s=1.0, max_attempts=4, name="gcs_read")
+
+# Variant for bulk reads whose single attempt is already expensive (large
+# runtime_env packages): one retry only, so the worst case stays near the
+# pre-retry budget instead of quadrupling it.
+GCS_READ_BULK = RetryPolicy(base_s=0.25, cap_s=1.0, max_attempts=2, name="gcs_read_bulk")

@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.config import CONFIG
-from ray_tpu._private import retry
+from ray_tpu._private import retry, telemetry
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
@@ -177,6 +177,11 @@ class RpcServer:
         if msg[0] == "req":
             _, req_id, method, payload = msg
             fn = getattr(self.handler, "rpc_" + method, None)
+            # Metric label must stay bounded: the method string comes off
+            # the wire, so unknown methods collapse to one label instead
+            # of minting a registry series per (possibly hostile) name.
+            label = method if fn is not None else "<unknown>"
+            t0 = time.perf_counter()
             try:
                 if fn is None:
                     raise RpcError(f"no such rpc method: {method}")
@@ -184,6 +189,8 @@ class RpcServer:
                 ok = True
             except Exception as e:  # noqa: BLE001 — errors cross the wire
                 result, ok = e, False
+                telemetry.count_rpc_error(label, "handler")
+            telemetry.observe_rpc(label, "server", time.perf_counter() - t0)
             if CHAOS.active:
                 rep = CHAOS.decide(method, "rep")
                 if rep.delay_s > 0:
@@ -303,6 +310,7 @@ class AsyncRpcClient:
         fut = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
         data = pickle.dumps(("req", req_id, method, payload), protocol=5)
+        t0 = time.perf_counter()
         async with self._wlock:
             self._writer.write(_LEN.pack(len(data)) + data)
             await self._writer.drain()
@@ -310,11 +318,24 @@ class AsyncRpcClient:
             timeout = CONFIG.rpc_call_timeout_s
         try:
             if timeout is None:
-                return await fut
-            return await asyncio.wait_for(fut, timeout)
+                result = await fut
+            else:
+                result = await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             self._pending.pop(req_id, None)
+            telemetry.count_rpc_error(method, "timeout")
             raise CallTimeout(f"{method} on {self.address} timed out after {timeout}s")
+        except ConnectionLost:
+            telemetry.count_rpc_error(method, "connection_lost")
+            raise
+        except Exception:
+            # Handler error crossed the wire: the round trip completed,
+            # so it still counts toward client-side latency (matches the
+            # sync RpcClient path).
+            telemetry.observe_rpc(method, "client", time.perf_counter() - t0)
+            raise
+        telemetry.observe_rpc(method, "client", time.perf_counter() - t0)
+        return result
 
     async def push(self, method: str, payload: Any = None):
         if not self._connected:
@@ -427,21 +448,27 @@ class RpcClient:
             ev = threading.Event()
             self._pending[req_id] = ev
         data = pickle.dumps(("req", req_id, method, payload), protocol=5)
+        t0 = time.perf_counter()
         try:
             with self._lock:
                 self._sock.sendall(_LEN.pack(len(data)) + data)
         except OSError as e:
             with self._lock:
                 self._pending.pop(req_id, None)
+            telemetry.count_rpc_error(method, "connection_lost")
             raise ConnectionLost(f"send to {self.address} failed: {e}") from e
         if timeout is _UNSET_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
         if not ev.wait(timeout):
             with self._lock:
                 self._pending.pop(req_id, None)
+            telemetry.count_rpc_error(method, "timeout")
             raise CallTimeout(f"{method} on {self.address} timed out after {timeout}s")
         ok, result = self._results.pop(req_id)
+        telemetry.observe_rpc(method, "client", time.perf_counter() - t0)
         if not ok:
+            if isinstance(result, ConnectionLost):
+                telemetry.count_rpc_error(method, "connection_lost")
             raise result
         return result
 
@@ -587,3 +614,42 @@ class ReconnectingRpcClient:
         progress (calls would park on the reconnect gate) or after
         give-up.  Best-effort callers consult this instead of blocking."""
         return self._ready.is_set() and not self._closed
+
+
+# --------------------------------------------------------------------------
+# Idempotent reads.  GCS lookups (kv_get, object locations) are safe to
+# re-ask on a lost reply — re-reading returns the same (or fresher) value
+# with no side effects — so a CallTimeout becomes a bounded retry instead
+# of an immediate failure (ROADMAP follow-up from the PR 1 retry work).
+# --------------------------------------------------------------------------
+def call_idempotent(client, method: str, payload: Any = None,
+                    timeout: float = _UNSET_TIMEOUT, policy=None):
+    """Sync read with CallTimeout retries under retry.GCS_READ (or the
+    given policy).  Only for idempotent methods — never writes."""
+    bo = (policy or retry.GCS_READ).start()
+    while True:
+        try:
+            if timeout is _UNSET_TIMEOUT:
+                return client.call(method, payload)
+            return client.call(method, payload, timeout=timeout)
+        except CallTimeout:
+            delay = bo.next_delay()
+            if delay is None:
+                raise
+            time.sleep(delay)
+
+
+async def call_idempotent_async(client, method: str, payload: Any = None,
+                                timeout: float = _UNSET_TIMEOUT, policy=None):
+    """Async twin of call_idempotent for service-to-service reads."""
+    bo = (policy or retry.GCS_READ).start()
+    while True:
+        try:
+            if timeout is _UNSET_TIMEOUT:
+                return await client.call(method, payload)
+            return await client.call(method, payload, timeout=timeout)
+        except CallTimeout:
+            delay = bo.next_delay()
+            if delay is None:
+                raise
+            await asyncio.sleep(delay)

@@ -401,9 +401,17 @@ def _fetch_package(gcs_client, uri: str, dest_dir: str, session_dir: str) -> str
 
         from ray_tpu._private import retry as _retry
 
+        from ray_tpu._private import rpc as _rpc
+
         bo = _retry.KV_STAGING.start(deadline_s=15)
         while True:
-            blob = gcs_client.call("kv_get", (KV_NS, name.encode()), timeout=60)
+            # Large package blobs: 60s per attempt is sizing, not slack —
+            # GCS_READ_BULK allows one retry so the worst case stays near
+            # the pre-retry budget.
+            blob = _rpc.call_idempotent(
+                gcs_client, "kv_get", (KV_NS, name.encode()), timeout=60,
+                policy=_retry.GCS_READ_BULK,
+            )
             if blob is not None:
                 break
             delay = bo.next_delay()

@@ -1,0 +1,212 @@
+"""Flight-recorder instrumentation for the core hot paths.
+
+One place defines every built-in metric (catalog: docs/observability.md)
+so names/tags stay consistent across layers: RPC latency on both client
+and server sides, task phase transitions (submit -> lease -> queue ->
+exec -> e2e), object-store put/get, retry/backoff activity, chaos
+injections, and Train step timing.  Everything funnels through
+``ray_tpu.util.metrics`` and rides its per-process flusher to the GCS
+metrics table.
+
+The module is deliberately lazy: nothing imports ``ray_tpu.util`` until
+the first instrumented event fires, because rpc.py (imported at the very
+bottom of the package import graph) pulls this module in at import time.
+The per-event fast path when telemetry is off is a single cached boolean
+check.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.config import CONFIG
+
+_enabled: Optional[bool] = None
+_m = None
+
+# Finer low-end than the Prometheus defaults: local-socket RPCs and store
+# ops sit well under 5 ms, and the interesting regressions are 100 us
+# shifts, not whole buckets.
+_LATENCY_BUCKETS = [
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+]
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        try:
+            _enabled = bool(CONFIG.telemetry_enabled)
+        except Exception:
+            _enabled = True
+    return _enabled
+
+
+def refresh() -> None:
+    """Re-read CONFIG.telemetry_enabled (tests toggle it)."""
+    global _enabled
+    _enabled = None
+
+
+class _Metrics:
+    """Lazily-constructed metric instances (shared registry lives in
+    util.metrics; constructing twice under race is harmless — instances
+    are just views onto (name, tags) records)."""
+
+    def __init__(self):
+        from ray_tpu.util import metrics as m
+
+        self.rpc_latency = m.Histogram(
+            "rpc_latency_seconds",
+            "RPC latency: side=client is full round-trip, side=server is handler time",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("method", "side"),
+        )
+        self.rpc_errors = m.Counter(
+            "rpc_errors_total",
+            "RPC failures by kind (timeout, connection_lost, handler)",
+            tag_keys=("method", "kind"),
+        )
+        self.retries = m.Counter(
+            "retry_backoff_total",
+            "retries scheduled by the unified backoff policies",
+            tag_keys=("policy",),
+        )
+        self.chaos = m.Counter(
+            "chaos_injections_total",
+            "fault injections fired by the chaos plane",
+            tag_keys=("pattern", "action"),
+        )
+        self.task_phase = m.Histogram(
+            "task_phase_seconds",
+            "task lifecycle phases: submit (driver push), lease (worker grant), "
+            "queue (raylet wait), exec (worker run), e2e (submit->result)",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("phase",),
+        )
+        self.store_latency = m.Histogram(
+            "object_store_op_seconds",
+            "object store client op latency",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("op",),
+        )
+        self.store_bytes = m.Counter(
+            "object_store_bytes_total",
+            "bytes moved through the object store client",
+            tag_keys=("op",),
+        )
+        self.train_step = m.Histogram(
+            "train_step_seconds",
+            "wall time between consecutive train.report calls per rank",
+            boundaries=[0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0],
+            tag_keys=("rank",),
+        )
+
+
+def _metrics() -> _Metrics:
+    global _m
+    if _m is None:
+        _m = _Metrics()
+    return _m
+
+
+# ----------------------------------------------------------------------
+# event helpers — each is a no-op (one boolean check) when telemetry is
+# off, and one pre-bound histogram/counter write when on.  Bound
+# instruments (series resolved once per label combo, cached here) keep
+# the per-event cost at lock + record update; label cardinality is
+# bounded by (method x side), so the cache can't grow unboundedly.
+# ----------------------------------------------------------------------
+# Per-helper caches keyed directly by the label values (flat keys) so
+# the hot path is one dict lookup + one bound write; the shared miss
+# path binds the series once per label combo.
+_rpc_bound: dict = {}
+_rpc_err_bound: dict = {}
+_retry_bound: dict = {}
+_chaos_bound: dict = {}
+_phase_bound: dict = {}
+_store_bound: dict = {}
+_store_bytes_bound: dict = {}
+_train_bound: dict = {}
+
+
+def _bind(cache: dict, key, metric_attr: str, tags: dict):
+    """Cache-miss path: resolve the (metric, tags) series once.  Off the
+    hot path by construction — callers only land here on a new label
+    combo."""
+    return cache.setdefault(key, getattr(_metrics(), metric_attr).bound(tags))
+
+
+def observe_rpc(method: str, side: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _rpc_bound.get((method, side)) or _bind(
+        _rpc_bound, (method, side), "rpc_latency", {"method": method, "side": side}
+    )
+    b.observe(seconds)
+
+
+def count_rpc_error(method: str, kind: str) -> None:
+    if not enabled():
+        return
+    b = _rpc_err_bound.get((method, kind)) or _bind(
+        _rpc_err_bound, (method, kind), "rpc_errors", {"method": method, "kind": kind}
+    )
+    b.inc(1.0)
+
+
+def count_retry(policy: str) -> None:
+    if not enabled():
+        return
+    policy = policy or "anonymous"
+    b = _retry_bound.get(policy) or _bind(
+        _retry_bound, policy, "retries", {"policy": policy}
+    )
+    b.inc(1.0)
+
+
+def count_chaos(pattern: str, action: str) -> None:
+    if not enabled():
+        return
+    b = _chaos_bound.get((pattern, action)) or _bind(
+        _chaos_bound, (pattern, action), "chaos", {"pattern": pattern, "action": action}
+    )
+    b.inc(1.0)
+
+
+def observe_task_phase(phase: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _phase_bound.get(phase) or _bind(
+        _phase_bound, phase, "task_phase", {"phase": phase}
+    )
+    b.observe(seconds if seconds > 0.0 else 0.0)
+
+
+def observe_store(op: str, seconds: float, nbytes: Optional[int] = None) -> None:
+    if not enabled():
+        return
+    b = _store_bound.get(op) or _bind(_store_bound, op, "store_latency", {"op": op})
+    b.observe(seconds)
+    if nbytes:
+        count_store_bytes(op, nbytes)
+
+
+def count_store_bytes(op: str, nbytes: int) -> None:
+    if not enabled() or not nbytes:
+        return
+    b = _store_bytes_bound.get(op) or _bind(
+        _store_bytes_bound, op, "store_bytes", {"op": op}
+    )
+    b.inc(float(nbytes))
+
+
+def observe_train_step(rank: int, seconds: float) -> None:
+    if not enabled():
+        return
+    rank_s = str(rank)
+    b = _train_bound.get(rank_s) or _bind(
+        _train_bound, rank_s, "train_step", {"rank": rank_s}
+    )
+    b.observe(seconds)

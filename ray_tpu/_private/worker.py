@@ -21,7 +21,7 @@ from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions
-from ray_tpu._private import retry, rpc, serialization
+from ray_tpu._private import retry, rpc, serialization, telemetry
 from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import ResourceSet, SchedulingStrategy, TaskSpec
 from ray_tpu._private.config import CONFIG
@@ -937,7 +937,14 @@ class Worker:
     def _fetch_function(self, key: bytes):
         fn = self._function_cache.get(key)
         if fn is None:
-            blob = self.gcs_client.call("kv_get", (FUNCTION_KV_NS, key))
+            # Function blobs can be large (cloudpickled closures), so the
+            # per-attempt timeout must leave room for a slow-but-moving
+            # transfer; one retry keeps the worst case at the old
+            # single-call budget (2 x 60s ~= rpc_call_timeout_s=120).
+            blob = rpc.call_idempotent(
+                self.gcs_client, "kv_get", (FUNCTION_KV_NS, key), timeout=60,
+                policy=retry.GCS_READ_BULK,
+            )
             if blob is None:
                 raise exceptions.RaySystemError(f"function {key.hex()} missing from GCS")
             fn = serialization.loads_function(blob)
@@ -1083,6 +1090,7 @@ class Worker:
             for oid in spec.return_ids():
                 self.lineage[oid.binary()] = spec
         tid = spec.task_id.binary()
+        submit_t0 = time.perf_counter()
         if (
             self._direct_submitter is not None
             and spec.scheduling_strategy.kind == "DEFAULT"
@@ -1103,6 +1111,7 @@ class Worker:
             # stay pinned until job-end GC (escaped).
             self.reference_counter.escalate_to_escape(tid, borrowed)
             self._submit_with_retry(self.raylet_client, spec)
+        telemetry.observe_task_phase("submit", time.perf_counter() - submit_t0)
         if generator is not None:
             return generator
         return [ObjectRef(oid, owned=True) for oid in spec.return_ids()]
@@ -1597,7 +1606,18 @@ class Worker:
         except BaseException as e:  # pragma: no cover — never crash the loop
             error = repr(e)
             traceback.print_exc()
-        self._record_task_event(spec, start, time.time(), error)
+        end = time.time()
+        # The installed context's span id is what child submissions were
+        # stamped with — record THAT id as the task span so the tree
+        # reassembles across the process hop.
+        _tracing.record_span(
+            "task::" + spec.name,
+            start,
+            end,
+            {"task_id": spec.task_id.hex(), "ok": error is None},
+        )
+        telemetry.observe_task_phase("exec", end - start)
+        self._record_task_event(spec, start, end, error)
 
     def _record_task_event(self, spec: TaskSpec, start: float, end: float, error):
         """Buffer a task event; a background thread flushes batches to the
@@ -1934,6 +1954,7 @@ class Worker:
                 self._send_task_finished(spec, conn, sink)
             self.current_spec = None
             return
+        exec_start = time.time()
         try:
             if spec.method_name == "__ray_terminate__":
                 self._store_returns(spec, None, sink)
@@ -1979,6 +2000,14 @@ class Worker:
             else:
                 raise
         finally:
+            exec_end = time.time()
+            _tracing.record_span(
+                "task::" + spec.name + "." + (spec.method_name or ""),
+                exec_start,
+                exec_end,
+                {"task_id": spec.task_id.hex()},
+            )
+            telemetry.observe_task_phase("exec", exec_end - exec_start)
             self.current_spec = None
             if conn is not None:
                 self._send_task_finished(spec, conn, sink)

@@ -220,7 +220,15 @@ def _fetch_blob(spec) -> bytes:
     if getattr(worker, "mode", None) == "client":
         blob = worker.fetch_function_blob(spec.function_key)
     else:
-        blob = worker.gcs_client.call("kv_get", (FUNCTION_KV_NS, spec.function_key))
+        from ray_tpu._private import retry as _retry
+        from ray_tpu._private import rpc as _rpc
+
+        # Actor class blobs can be large: long per-attempt timeout, one
+        # retry (worst case ~= the old single-call 120s budget).
+        blob = _rpc.call_idempotent(
+            worker.gcs_client, "kv_get", (FUNCTION_KV_NS, spec.function_key),
+            timeout=60, policy=_retry.GCS_READ_BULK,
+        )
     if blob is None:
         raise ValueError("actor class definition missing from GCS")
     return blob

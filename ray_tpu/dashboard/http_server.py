@@ -127,6 +127,59 @@ class _DashboardState:
                 out.append(obj)
         return out
 
+    def spans(self, limit: int = 100_000):
+        from ray_tpu.util.state import _dedupe_spans
+
+        return _dedupe_spans(self.gcs.call("list_spans", {"limit": limit}) or [])
+
+    def traces(self):
+        from ray_tpu.util.state import group_traces
+
+        return group_traces(self.spans())
+
+    def timeline_trace(self):
+        """Cluster flight-recorder export: GCS task events + spans from
+        every process merged into one Chrome-trace/Perfetto event list."""
+        from ray_tpu.util.state import build_chrome_trace
+
+        events = self.gcs.call("list_task_events", {"limit": 100_000})
+        return build_chrome_trace(events, self.spans())
+
+    def chaos(self):
+        """Active chaos schedule + per-rule injection counts: the GCS
+        process's view, every alive raylet's view (node_stats), and the
+        chaos_injections_total counters flushed by worker processes."""
+        out = {"gcs": None, "nodes": {}, "injections": [], "active": False}
+        try:
+            out["gcs"] = self.gcs.call("chaos_stats", None)
+        except Exception:
+            out["gcs"] = None
+        try:
+            nodes = self.nodes()
+        except Exception:
+            nodes = []
+        for n in nodes:
+            if n["state"] != "ALIVE":
+                continue
+            try:
+                stats = self._raylet(n["raylet_address"]).call("node_stats", {})
+            except Exception:
+                continue
+            if "chaos" in stats:
+                out["nodes"][n["node_id"]] = stats["chaos"]
+        try:
+            recs = self.gcs.call("metrics_get", None) or []
+            out["injections"] = [
+                {"tags": r.get("tags", {}), "count": r.get("value", 0.0)}
+                for r in recs
+                if r.get("name") == "chaos_injections_total"
+            ]
+        except Exception:
+            pass
+        views = [v for v in [out["gcs"], *out["nodes"].values()] if v]
+        out["active"] = any(v.get("active") for v in views)
+        return out
+
     def prometheus_metrics(self) -> str:
         """User metrics (util.metrics flushed through the GCS) PLUS
         built-in operational gauges derived from cluster state, so a
@@ -278,6 +331,22 @@ class _Handler(BaseHTTPRequestHandler):
                 if info is None:
                     return self._error(404, f"job {rest!r} not found")
                 return self._json(info)
+            if path == "/api/traces":
+                return self._json(self.state.traces())
+            if path == "/api/timeline":
+                body = json.dumps(self.state.timeline_trace(), default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Content-Disposition",
+                    'attachment; filename="ray_tpu_timeline.json"',
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path == "/api/chaos":
+                return self._json(self.state.chaos())
             if path == "/metrics":
                 return self._send(
                     200, self.state.prometheus_metrics().encode(), "text/plain; version=0.0.4"
@@ -376,7 +445,8 @@ class _Handler(BaseHTTPRequestHandler):
             + _html_table("Actors", self.state.actors())
             + _html_table("Jobs (submitted)", self.jobs.list_jobs())
             + "<p>API: /api/nodes /api/actors /api/tasks /api/jobs "
-            "/api/objects /api/placement_groups /api/workers /metrics</p>"
+            "/api/objects /api/placement_groups /api/workers /api/traces "
+            "/api/timeline /api/chaos /metrics</p>"
             "</body></html>"
         )
         self._send(200, html.encode(), "text/html")

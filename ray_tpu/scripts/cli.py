@@ -119,6 +119,22 @@ def cmd_summary(args):
     from ray_tpu.util import state
 
     _connect(args)
+    if args.kind == "cluster":
+        tasks = state.summarize_tasks()
+        traces = state.traces()
+        recs = state.metrics()
+        print(json.dumps(
+            {
+                "nodes_alive": tasks["node_count"],
+                "tasks": tasks["summary"],
+                "actors": state.summarize_actors()["summary"],
+                "metric_series": len(recs),
+                "traces": len(traces),
+                "cross_process_traces": sum(1 for t in traces if len(t["pids"]) >= 2),
+            },
+            indent=1, default=str,
+        ))
+        return 0
     fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors}[args.kind]
     print(json.dumps(fn(), indent=1, default=str))
     return 0
@@ -129,7 +145,7 @@ def cmd_timeline(args):
 
     _connect(args)
     path = args.output or f"ray_tpu_timeline_{int(time.time())}.json"
-    state.timeline(path)
+    state.timeline(path, include_spans=not args.tasks_only)
     print(f"wrote chrome trace to {path} (open in chrome://tracing or perfetto)")
     return 0
 
@@ -278,13 +294,18 @@ def main(argv=None):
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
 
-    p = sub.add_parser("summary", help="summarize tasks/actors")
-    p.add_argument("kind", choices=["tasks", "actors"])
+    p = sub.add_parser("summary", help="summarize tasks/actors/cluster observability")
+    p.add_argument("kind", choices=["tasks", "actors", "cluster"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)
 
-    p = sub.add_parser("timeline", help="export chrome trace of task events")
+    p = sub.add_parser(
+        "timeline",
+        help="export cluster flight-recorder trace (task events + cross-process spans)",
+    )
     p.add_argument("-o", "--output", default=None)
+    p.add_argument("--tasks-only", action="store_true",
+                   help="omit spans; task events only (pre-flight-recorder shape)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
 

@@ -8,6 +8,7 @@ import os
 import queue
 import shutil
 import threading
+import time
 import traceback
 from typing import Any, Dict, Optional
 
@@ -46,6 +47,7 @@ class _TrainSession:
         self._queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._thread: Optional[threading.Thread] = None
         self._report_idx = 0
+        self._last_report_t: Optional[float] = None
         self.error: Optional[BaseException] = None
 
     def start(self):
@@ -62,6 +64,15 @@ class _TrainSession:
         self._thread.start()
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        # Per-train-step wall time (report-to-report) feeds the
+        # train_step_seconds histogram — the pod-scale "where does step
+        # time go" signal (flight recorder, docs/observability.md).
+        now = time.monotonic()
+        if self._last_report_t is not None:
+            from ray_tpu._private import telemetry
+
+            telemetry.observe_train_step(self.world_rank, now - self._last_report_t)
+        self._last_report_t = now
         persisted = None
         if checkpoint is not None:
             # Persist into the run's storage dir; rank-tagged (reference:

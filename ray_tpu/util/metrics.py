@@ -10,10 +10,12 @@ the aggregated view (Prometheus text format).
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
+from bisect import bisect_left
 from collections import defaultdict
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _FLUSH_INTERVAL_S = 2.0
 
@@ -21,9 +23,64 @@ _lock = threading.Lock()
 _registry: Dict[Tuple[str, tuple], dict] = {}
 _flusher_started = False
 
+# Processes without a connected worker (raylet, GCS, dashboard helpers)
+# register a delivery channel instead: fn(method, payload) -> None ships
+# one report RPC to the GCS by whatever transport the process owns.
+_report_channel: Optional[Callable[[str, dict], Any]] = None
+_reporter_id: bytes = b""
+
+
+def set_report_channel(fn: Optional[Callable[[str, dict], Any]], reporter_id: bytes = b""):
+    """Route metric/span reports through `fn(method, payload)` rather than
+    the global worker's GCS client (raylet/GCS processes have no worker).
+    reporter_id keys this process's snapshot in the GCS metrics table."""
+    global _report_channel, _reporter_id
+    _report_channel = fn
+    _reporter_id = reporter_id
+
+
+def report(method: str, payload: dict) -> bool:
+    """Deliver one report RPC to the GCS via the registered channel or the
+    connected global worker.  Returns False when neither is available."""
+    if _report_channel is not None:
+        try:
+            _report_channel(method, payload)
+            return True
+        except Exception:
+            return False
+    from ray_tpu._private.worker import global_worker_maybe
+
+    w = global_worker_maybe()
+    if w is None or not w.connected or w.gcs_client is None:
+        return False
+    try:
+        # Bounded: this runs on flusher threads and at interpreter exit —
+        # it must never park a dying worker on the full rpc call timeout.
+        w.gcs_client.call(method, payload, timeout=10)
+        return True
+    except Exception:
+        return False
+
+
+def reporter_id() -> bytes:
+    if _reporter_id:
+        return _reporter_id
+    from ray_tpu._private.worker import global_worker_maybe
+
+    w = global_worker_maybe()
+    if w is not None and w.worker_id is not None:
+        return w.worker_id.binary()
+    return b""
+
 
 def _ensure_flusher():
+    # Deferred to the first metric WRITE (not construction): importing a
+    # module that defines metrics must not spawn threads — that breaks
+    # fork-based process spawning and burns a thread in every process
+    # that merely imports an instrumented module.
     global _flusher_started
+    if _flusher_started:
+        return
     with _lock:
         if _flusher_started:
             return
@@ -38,15 +95,26 @@ def _ensure_flusher():
                 pass
 
     threading.Thread(target=flush_loop, daemon=True, name="metrics-flush").start()
+    # Short-lived workers die between flush ticks; push the final
+    # snapshot (and any unflushed spans) on interpreter exit.
+    atexit.register(_flush_at_exit)
+
+
+def _flush_at_exit():
+    try:
+        flush()
+    except Exception:
+        pass
+    try:
+        from ray_tpu.util import tracing
+
+        tracing.flush()
+    except Exception:
+        pass
 
 
 def flush():
     """Push the current snapshot to GCS (no-op when not connected)."""
-    from ray_tpu._private.worker import global_worker_maybe
-
-    w = global_worker_maybe()
-    if w is None or not w.connected or w.gcs_client is None:
-        return
     with _lock:
         snapshot = [
             {
@@ -63,13 +131,7 @@ def flush():
             for (name, tags), rec in _registry.items()
         ]
     if snapshot:
-        try:
-            w.gcs_client.call(
-                "metrics_report",
-                {"worker_id": w.worker_id.binary() if w.worker_id else b"", "metrics": snapshot},
-            )
-        except Exception:
-            pass
+        report("metrics_report", {"worker_id": reporter_id(), "metrics": snapshot})
 
 
 class _Metric:
@@ -80,7 +142,6 @@ class _Metric:
         self._description = description
         self._tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
-        _ensure_flusher()
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
@@ -102,12 +163,38 @@ class _Metric:
         }
 
 
+class _Bound:
+    """A metric pre-resolved to one (name, tags) series: the per-event
+    cost drops to lock + record update — no tag merge, no sorted() — so
+    hot-path instrumentation (every RPC, every task) stays well under
+    the <5% overhead budget.  The registry record is cached after first
+    touch; records are never replaced for counters/histograms, so the
+    cache cannot go stale."""
+
+    __slots__ = ("_key", "_template", "_rec")
+
+    def __init__(self, key: Tuple[str, tuple], template: dict):
+        self._key = key
+        self._template = template
+        self._rec = None
+
+
+class _BoundCounter(_Bound):
+    def inc(self, value: float = 1.0):
+        rec = self._rec
+        with _lock:
+            if rec is None:
+                rec = self._rec = _registry.setdefault(self._key, dict(self._template))
+            rec["value"] += value
+
+
 class Counter(_Metric):
     """Monotonically increasing (reference: util/metrics.py:137)."""
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
         if value <= 0:
             raise ValueError("Counter.inc() requires value > 0")
+        _ensure_flusher()
         key = self._key(tags)
         with _lock:
             rec = _registry.setdefault(
@@ -115,11 +202,21 @@ class Counter(_Metric):
             )
             rec["value"] += value
 
+    def bound(self, tags: Optional[Dict[str, str]] = None) -> _BoundCounter:
+        """Pre-resolve the tag set for hot-path increments.  The flusher
+        check happens here, once, so per-event writes skip it."""
+        _ensure_flusher()
+        return _BoundCounter(
+            self._key(tags),
+            {"type": "counter", "value": 0.0, "description": self._description},
+        )
+
 
 class Gauge(_Metric):
     """Last-value-wins (reference: util/metrics.py:262)."""
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _ensure_flusher()
         key = self._key(tags)
         with _lock:
             _registry[key] = {"type": "gauge", "value": float(value), "description": self._description}
@@ -145,25 +242,59 @@ class Histogram(_Metric):
         self._boundaries = list(bounds)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _ensure_flusher()
         key = self._key(tags)
         with _lock:
-            rec = _registry.setdefault(
-                key,
-                {
-                    "type": "histogram",
-                    "buckets": self._boundaries,
-                    "counts": [0] * (len(self._boundaries) + 1),
-                    "sum": 0.0,
-                    "count": 0,
-                    "description": self._description,
-                },
-            )
-            i = 0
-            while i < len(self._boundaries) and value > self._boundaries[i]:
-                i += 1
+            rec = _registry.setdefault(key, self._template())
+            i = bisect_left(self._boundaries, value)
             rec["counts"][i] += 1
             rec["sum"] += value
             rec["count"] += 1
+
+    def _template(self) -> dict:
+        return {
+            "type": "histogram",
+            "buckets": self._boundaries,
+            "counts": [0] * (len(self._boundaries) + 1),
+            "sum": 0.0,
+            "count": 0,
+            "description": self._description,
+        }
+
+    def bound(self, tags: Optional[Dict[str, str]] = None) -> "_BoundHistogram":
+        """Pre-resolve the tag set for hot-path observations.  The
+        flusher check happens here, once, so per-event writes skip it."""
+        _ensure_flusher()
+        return _BoundHistogram(self._key(tags), self._template(), self._boundaries)
+
+
+class _BoundHistogram(_Bound):
+    __slots__ = ("_boundaries",)
+
+    def __init__(self, key, template, boundaries):
+        super().__init__(key, template)
+        self._boundaries = boundaries
+
+    def observe(self, value: float):
+        rec = self._rec
+        with _lock:
+            if rec is None:
+                rec = self._rec = _registry.setdefault(self._key, dict(self._template))
+                rec["counts"] = list(rec["counts"])  # never alias the template
+            rec["counts"][bisect_left(self._boundaries, value)] += 1
+            rec["sum"] += value
+            rec["count"] += 1
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and newline (in that order — backslash first or the other
+    escapes get double-escaped)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def prometheus_text(metrics: List[dict]) -> str:
@@ -174,11 +305,14 @@ def prometheus_text(metrics: List[dict]) -> str:
         by_name[m["name"]].append(m)
     for name, group in sorted(by_name.items()):
         mtype = group[0]["type"]
-        desc = group[0].get("description", "")
+        desc = _escape_help(group[0].get("description", ""))
         lines.append(f"# HELP {name} {desc}")
         lines.append(f"# TYPE {name} {mtype if mtype != 'histogram' else 'histogram'}")
         for m in group:
-            label = ",".join(f'{k}="{v}"' for k, v in sorted((m.get("tags") or {}).items()))
+            label = ",".join(
+                f'{k}="{_escape_label_value(v)}"'
+                for k, v in sorted((m.get("tags") or {}).items())
+            )
             label = "{" + label + "}" if label else ""
             if mtype == "histogram":
                 cum = 0

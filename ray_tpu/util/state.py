@@ -134,12 +134,76 @@ def metrics() -> List[Dict[str, Any]]:
     return _gcs().call("metrics_get", None)
 
 
-def timeline(filename: Optional[str] = None) -> Optional[str]:
-    """Chrome-trace (catapult) export of task events (reference:
-    `ray timeline`, GcsTaskManager → chrome://tracing format)."""
-    events = _gcs().call("list_task_events", {"limit": 100000})
-    trace = []
-    for e in events:
+def _dedupe_spans(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Span delivery to the GCS is at-least-once (a lost span_report
+    reply re-sends the batch), so collapse duplicates by span_id —
+    duplicate records are byte-identical, keep the first."""
+    seen = set()
+    out = []
+    for s in records:
+        sid = s.get("span_id")
+        if sid is not None and sid in seen:
+            continue
+        if sid is not None:
+            seen.add(sid)
+        out.append(s)
+    return out
+
+
+def spans(limit: int = 100_000) -> List[Dict[str, Any]]:
+    """Cluster-wide finished spans from the GCS span table.  The local
+    process's unflushed spans are shipped first so a driver's root spans
+    appear alongside the worker spans they parent."""
+    from ray_tpu.util import tracing
+
+    tracing.flush()
+    return _dedupe_spans(_gcs().call("list_spans", {"limit": limit}) or [])
+
+
+def traces(limit: int = 100_000) -> List[Dict[str, Any]]:
+    """Spans grouped per trace (cluster-wide), newest-first: each entry
+    carries the span tree of one distributed call graph."""
+    return group_traces(spans(limit))
+
+
+def group_traces(span_records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Pure grouping of span records into per-trace summaries (shared by
+    the state API and the dashboard, which has no connected worker)."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in span_records:
+        tid = s.get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(s)
+    out = []
+    for tid, group in by_trace.items():
+        group.sort(key=lambda s: s.get("start_time", 0.0))
+        start = min(s.get("start_time", 0.0) for s in group)
+        end = max(s.get("end_time", 0.0) for s in group)
+        out.append(
+            {
+                "trace_id": tid,
+                "span_count": len(group),
+                "pids": sorted({s.get("pid") for s in group if s.get("pid") is not None}),
+                "start_time": start,
+                "duration_s": max(0.0, end - start),
+                "root_names": [s.get("name") for s in group if not s.get("parent_span_id")],
+                "spans": group,
+            }
+        )
+    out.sort(key=lambda t: t["start_time"], reverse=True)
+    return out
+
+
+def build_chrome_trace(
+    task_events: List[Dict[str, Any]], span_records: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Merge GCS task events and cross-process spans into one
+    Chrome-trace/Perfetto event list.  Spans keep their real (pid, tid)
+    so Perfetto renders one track per process/thread, and carry
+    trace_id/span_id/parent_span_id in args so the call tree is
+    reconstructable across process boundaries."""
+    trace: List[Dict[str, Any]] = []
+    for e in task_events:
         start = e.get("start_time")
         end = e.get("end_time") or time.time()
         if start is None:
@@ -156,6 +220,61 @@ def timeline(filename: Optional[str] = None) -> Optional[str]:
                 "args": {k: v for k, v in e.items() if isinstance(v, (str, int, float, bool))},
             }
         )
+    span_pids = set()
+    for s in span_records:
+        start = s.get("start_time")
+        if start is None:
+            continue
+        end = s.get("end_time") or start
+        args = {
+            "trace_id": s.get("trace_id"),
+            "span_id": s.get("span_id"),
+            "parent_span_id": s.get("parent_span_id"),
+        }
+        for k, v in (s.get("attributes") or {}).items():
+            if isinstance(v, (str, int, float, bool)):
+                args[k] = v
+        pid = s.get("pid", 0)
+        span_pids.add(pid)
+        trace.append(
+            {
+                "cat": "span",
+                "name": s.get("name", "span"),
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(0.0, end - start) * 1e6,
+                "pid": pid,
+                "tid": s.get("tid", 0),
+                "args": args,
+            }
+        )
+    for pid in sorted(span_pids, key=str):
+        trace.append(
+            {
+                "ph": "M",
+                "cat": "__metadata",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"pid {pid}"},
+            }
+        )
+    return trace
+
+
+def timeline(filename: Optional[str] = None, include_spans: bool = True) -> Optional[str]:
+    """Chrome-trace (catapult) export of the cluster flight recorder:
+    task events PLUS spans merged from every process (reference:
+    `ray timeline`, GcsTaskManager → chrome://tracing format; open the
+    output in Perfetto or chrome://tracing)."""
+    events = _gcs().call("list_task_events", {"limit": 100000})
+    span_records: List[Dict[str, Any]] = []
+    if include_spans:
+        try:
+            span_records = spans()
+        except Exception:
+            span_records = []
+    trace = build_chrome_trace(events, span_records)
     if filename is None:
         return json.dumps(trace)
     with open(filename, "w") as f:

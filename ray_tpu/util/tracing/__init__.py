@@ -20,15 +20,29 @@ from __future__ import annotations
 import contextvars
 import os
 import secrets
+import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 _ctx: contextvars.ContextVar = contextvars.ContextVar("ray_tpu_trace", default=None)
 _otel_tracer = None
-# process-local span log (drained by tests/exporters)
+# process-local span log (drained by tests/exporters; shipped off-box by
+# the background flusher — see flush())
 _finished_spans: List[Dict[str, Any]] = []
 _MAX_SPANS = 10_000
+_span_lock = threading.Lock()
+# Index into _finished_spans up to which the flusher already shipped
+# spans to the GCS span table.  The flusher never REMOVES spans, so
+# drain_spans() keeps its pop-everything semantics for local consumers.
+_flushed_upto = 0
+_flusher_started = False
+# Concurrency bookkeeping for flush(): ring-buffer trims and drains
+# shift/clear indices while a report RPC is in flight; these counters
+# let the post-report cursor advance account for that instead of
+# skipping (and silently dropping) spans recorded mid-flight.
+_trim_total = 0
+_drain_epoch = 0
 
 
 def _new_trace_id() -> str:
@@ -55,6 +69,15 @@ def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str]]:
 def get_trace_id() -> Optional[str]:
     cur = _ctx.get()
     return cur[0] if cur else None
+
+
+def current_context() -> Optional[Tuple[str, str, Optional[str]]]:
+    """(trace_id, span_id, parent_span_id) of the active context, or None.
+    The executor side uses this to record the task's own span — the span
+    id minted by install_context IS the task span, so recording it (rather
+    than opening a fresh child) keeps parent links intact across the
+    process hop."""
+    return _ctx.get()
 
 
 def get_span_id() -> Optional[str]:
@@ -127,15 +150,119 @@ class SpanHandle:
 
 
 def _record_span(span: Dict[str, Any]) -> None:
-    _finished_spans.append(span)
-    if len(_finished_spans) > _MAX_SPANS:
-        del _finished_spans[: len(_finished_spans) - _MAX_SPANS]
+    global _flushed_upto, _trim_total
+    span.setdefault("tid", threading.get_ident())
+    with _span_lock:
+        _finished_spans.append(span)
+        if len(_finished_spans) > _MAX_SPANS:
+            trim = len(_finished_spans) - _MAX_SPANS
+            del _finished_spans[:trim]
+            _trim_total += trim
+            _flushed_upto = max(0, _flushed_upto - trim)
+    _ensure_flusher()
+
+
+def record_span(
+    name: str,
+    start_time: float,
+    end_time: float,
+    attributes: Optional[Dict[str, Any]] = None,
+    context: Optional[Tuple[str, str, Optional[str]]] = None,
+) -> None:
+    """Record an already-timed span at the given (or current) context
+    WITHOUT minting a new span id.  Used by the task executor: the
+    context installed from TaskSpec.trace_parent is the task's span, and
+    its id is what child tasks were told their parent is."""
+    ctx = context if context is not None else _ctx.get()
+    if ctx is None:
+        return
+    trace_id, span_id, parent = ctx
+    _record_span(
+        {
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_span_id": parent,
+            "start_time": start_time,
+            "end_time": end_time,
+            "pid": os.getpid(),
+            "attributes": attributes or {},
+        }
+    )
 
 
 def drain_spans() -> List[Dict[str, Any]]:
     """Pop and return this process's finished spans."""
-    out, _finished_spans[:] = list(_finished_spans), []
+    global _flushed_upto, _drain_epoch
+    with _span_lock:
+        out, _finished_spans[:] = list(_finished_spans), []
+        _flushed_upto = 0
+        _drain_epoch += 1
     return out
+
+
+def flush() -> bool:
+    """Ship spans recorded since the last flush to the GCS span table
+    (mirrors util.metrics.flush; delivery goes through the same report
+    channel so raylet/GCS processes export too).  Local consumers are
+    unaffected: spans stay drainable until drain_spans() pops them.
+
+    Delivery is at-least-once: a reply lost after the GCS applied the
+    batch leaves the cursor behind and the batch is re-sent — readers
+    dedupe by span_id (state._dedupe_spans)."""
+    global _flushed_upto
+    with _span_lock:
+        pending = _finished_spans[_flushed_upto:]
+        mark = len(_finished_spans)
+        base_trim = _trim_total
+        base_epoch = _drain_epoch
+    if not pending:
+        return True
+    from ray_tpu.util import metrics as _metrics
+
+    if _metrics.report("span_report", {"reporter": _metrics.reporter_id(), "spans": pending}):
+        with _span_lock:
+            if _drain_epoch == base_epoch:
+                # Shift the snapshot index by whatever the ring trimmed
+                # during the RPC so spans recorded mid-flight are not
+                # marked as shipped.
+                mark -= _trim_total - base_trim
+                _flushed_upto = max(_flushed_upto, min(mark, len(_finished_spans)))
+            # else: a drain cleared the log mid-flight; cursor already 0
+        return True
+    return False
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    if _flusher_started:
+        return
+    with _span_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def flush_loop():
+        from ray_tpu._private.config import CONFIG
+
+        while True:
+            try:
+                time.sleep(max(0.05, CONFIG.span_flush_interval_ms / 1000))
+                flush()
+            except Exception:
+                pass
+
+    threading.Thread(target=flush_loop, daemon=True, name="span-flush").start()
+    import atexit
+
+    atexit.register(lambda: _safe_flush())
+
+
+def _safe_flush():
+    try:
+        flush()
+    except Exception:
+        pass
 
 
 def use_opentelemetry(tracer=None) -> bool:

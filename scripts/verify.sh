@@ -11,4 +11,15 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Observability smoke (flight recorder end-to-end): local cluster, 10
+# traced tasks, /metrics parses, /api/timeline shows a cross-process
+# trace.  Skippable via RAY_TPU_SKIP_OBS_SMOKE=1.
+if [ "${RAY_TPU_SKIP_OBS_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      python scripts/observability_smoke.py; then
+    echo "observability smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
 exit $rc
