@@ -327,7 +327,11 @@ def nodes() -> List[dict]:
         out.append(
             {
                 "NodeID": NodeID(n["node_id"]).hex(),
-                "Alive": n["state"] == "ALIVE",
+                # DRAINING nodes are still up (running out their notice)
+                # but schedulable-nowhere; State carries the distinction.
+                "Alive": n["state"] in ("ALIVE", "DRAINING"),
+                "State": n["state"],
+                "DrainReason": n.get("drain_reason"),
                 "Resources": n["resources_total"],
                 "RayletAddress": n["raylet_address"],
                 "IsHead": n.get("is_head", False),

@@ -14,12 +14,16 @@ inherits)::
     rule[,rule...]
     rule    := pattern:action[:key=value]...
     pattern := fnmatch glob over the RPC method name ("submit_task",
-               "store_*", "*"), or a process fault point ("@worker.exec",
-               "@raylet.tick", "@gcs.tick")
-    action  := drop_req | drop_rep | delay_req | delay_rep | dup_req | kill
+               "store_*", "*"), a pubsub channel ("pubsub:nodes",
+               "pubsub:actors" — one decision per published message), or
+               a process fault point ("@worker.exec", "@raylet.tick",
+               "@gcs.tick")
+    action  := drop_req | drop_rep | delay_req | delay_rep | dup_req |
+               kill | preempt
     keys    := n=<max firings, -1 unlimited; default 1>
                p=<firing probability per match; default 1.0>
-               ms=<delay milliseconds; default 50>
+               ms=<delay milliseconds; for preempt: the advance-notice
+                  window before the process kill; default 50>
                after=<skip the first K matches; default 0>
                at=<fire exactly on the K-th match; shorthand for
                   after=K-1:n=1>
@@ -30,6 +34,10 @@ Examples::
     store_get:delay_req:ms=200:p=0.5:n=-1   # half of all gets +200ms
     request_worker_lease:drop_rep:n=2  # eat the first two lease grants
     @worker.exec:kill:at=3             # worker dies on its 3rd task
+    pubsub:nodes:drop_req:n=1          # eat one nodes-channel publish
+    @raylet.tick:preempt:at=5:ms=3000  # on its 5th report tick the
+                                       # raylet receives a 3 s preemption
+                                       # notice (drain), then dies
 
 Determinism: every rule owns a ``random.Random`` seeded from
 (``testing_chaos_seed``, rule index) and its own match counter, so a
@@ -53,7 +61,8 @@ from typing import List, NamedTuple, Optional, Tuple
 
 from ray_tpu._private.config import CONFIG
 
-_ACTIONS = ("drop_req", "drop_rep", "delay_req", "delay_rep", "dup_req", "kill")
+_ACTIONS = ("drop_req", "drop_rep", "delay_req", "delay_rep", "dup_req", "kill",
+            "preempt")
 
 # Bound on the in-memory schedule log; fired entries past this are
 # counted but not stored.
@@ -115,12 +124,16 @@ def _parse_rule(index: int, text: str, seed: int) -> _Rule:
     parts = text.strip().split(":")
     if len(parts) < 2:
         raise ValueError(f"chaos rule needs pattern:action, got {text!r}")
-    pattern, action = parts[0], parts[1]
-    if action not in _ACTIONS:
-        raise ValueError(f"unknown chaos action {action!r} in {text!r} "
+    # Patterns may themselves contain ":" (pubsub channels like
+    # "pubsub:nodes"): the action is the first segment that names one,
+    # everything before it is the pattern.
+    action_idx = next((i for i, p in enumerate(parts) if p in _ACTIONS), -1)
+    if action_idx < 1:
+        raise ValueError(f"unknown chaos action in {text!r} "
                          f"(one of {', '.join(_ACTIONS)})")
+    pattern, action = ":".join(parts[:action_idx]), parts[action_idx]
     kv = {}
-    for part in parts[2:]:
+    for part in parts[action_idx + 1:]:
         k, _, v = part.partition("=")
         kv[k] = v
     n = int(kv.get("n", 1))
@@ -226,7 +239,7 @@ class ChaosPlane:
         fired_rules = []
         with self._lock:
             for rule in self._rules:
-                if rule.action == "kill" or not rule.action.endswith(kind):
+                if rule.action in ("kill", "preempt") or not rule.action.endswith(kind):
                     continue
                 if not fnmatch.fnmatchcase(method, rule.pattern):
                     continue
@@ -271,6 +284,29 @@ class ChaosPlane:
                     return True
                 self._log(rule, "skip")
         return False
+
+    def maybe_preempt(self, point: str) -> Optional[float]:
+        """Preemption fault for process fault points ("raylet.tick"):
+        when a ``preempt`` rule fires for this ordinal, return the
+        advance-notice window in seconds (the rule's ``ms`` key).  The
+        caller models the preemption — deliver a drain notice to the
+        GCS, then die at the deadline — so the whole drain plane is
+        drillable and seed-replayable."""
+        if not self.active:
+            return None
+        target = "@" + point
+        with self._lock:
+            for rule in self._rules:
+                if rule.action != "preempt":
+                    continue
+                if not fnmatch.fnmatchcase(target, rule.pattern):
+                    continue
+                if rule.evaluate():
+                    self._log(rule, "preempt")
+                    _count_injection(rule)
+                    return rule.delay_s
+                self._log(rule, "skip")
+        return None
 
     # ------------------------------------------------------------------
     def schedule_digest(self) -> str:

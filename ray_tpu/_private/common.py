@@ -166,10 +166,19 @@ class NodeInfo:
     object_store_dir: str
     resources_total: ResourceSet
     labels: Dict[str, str] = field(default_factory=dict)
-    state: str = "ALIVE"  # ALIVE | DEAD
+    state: str = "ALIVE"  # ALIVE | DRAINING | DEAD
     start_time: float = field(default_factory=time.time)
     is_head: bool = False
     hostname: str = ""
+    # Drain plane (reference: gcs_node_manager DrainNode + autoscaler
+    # drain API): set when the node enters DRAINING.  reason is
+    # "PREEMPTION" (spot/preemptible termination notice) or
+    # "IDLE_TERMINATION" (autoscaler scale-down); deadline is the wall
+    # time the node is expected to disappear; drain_complete flips once
+    # actors are migrated and sole-copy objects are re-replicated.
+    drain_reason: Optional[str] = None
+    drain_deadline: float = 0.0
+    drain_complete: bool = False
 
 
 @dataclass

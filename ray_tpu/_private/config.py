@@ -138,6 +138,24 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "span_buffer_size": 50_000,
     # Period of the background span flusher in every traced process.
     "span_flush_interval_ms": 1_000,
+    # Per-flush cap on spans shipped to the GCS in one span_report batch;
+    # the remainder waits for the next interval (sustained load must not
+    # produce unbounded report frames).
+    "span_flush_max_batch": 2_048,
+    # Head-sampling rate for spans, applied per trace id at record time
+    # (1.0 = keep everything).  Deterministic in the trace id, so every
+    # process keeps or drops the SAME traces and trees stay whole.
+    "span_sample_rate": 1.0,
+    # --- drain / preemption (reference: gcs DrainNode + autoscaler drain
+    # API; RLAX-style planned-interruption handling) ---
+    # Fallback drain notice window when a drain_node call carries none.
+    "drain_deadline_s_default": 30.0,
+    # Notice window the autoscaler grants an idle node before terminating
+    # it (idle scale-down goes ALIVE -> DRAINING -> terminate).
+    "idle_drain_deadline_s": 30.0,
+    # Poll period of the GCS drain task waiting for actor migration and
+    # object re-replication to finish.
+    "drain_poll_ms": 100,
     # --- gcs ---
     # "file": periodically snapshot GCS state (actors/PGs/KV/jobs) to the
     # session dir so a restarted GCS resumes the cluster (reference: redis

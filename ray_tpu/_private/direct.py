@@ -35,7 +35,7 @@ logger = logging.getLogger(__name__)
 class _Lease:
     __slots__ = (
         "worker_id", "address", "client", "inflight", "started",
-        "idle_since", "key", "dead", "raylet",
+        "idle_since", "key", "dead", "raylet", "draining",
     )
 
     def __init__(self, worker_id: bytes, address: str, client: rpc.RpcClient, key, raylet):
@@ -50,6 +50,9 @@ class _Lease:
         # The raylet client that granted this lease — returns must go back
         # to it (a spilled lease belongs to the REMOTE node's raylet).
         self.raylet = raylet
+        # Set when the lease's node enters DRAINING: no new specs are
+        # assigned; the lease is returned once its in-flight work drains.
+        self.draining = False
 
 
 class _KeyState:
@@ -120,13 +123,19 @@ class DirectTaskSubmitter:
             return CONFIG.max_leases_per_scheduling_key
         return self._lease_cap
 
+    @staticmethod
+    def _live_leases(ks: _KeyState) -> int:
+        # Draining leases take no new work — they must not suppress
+        # replacement lease requests.
+        return sum(1 for l in ks.leases.values() if not l.dead and not l.draining)
+
     def _assign_locked(self, ks: _KeyState) -> None:
         # While more leases can still be granted, keep one task per worker
         # (parallelism first); once at the cap, pipeline deeper so workers
         # never sit idle waiting on the submit round trip.  Until the first
         # completion calibrates the key, stay at depth 1 so long tasks
         # aren't queued behind each other on one worker.
-        live = sum(1 for l in ks.leases.values() if not l.dead)
+        live = self._live_leases(ks)
         saturated = live + ks.requests_inflight >= self._dynamic_cap(ks)
         short_tasks = ks.ewma_ms is not None and ks.ewma_ms <= CONFIG.lease_grow_task_ms
         depth = CONFIG.lease_pipeline_depth if (saturated and short_tasks) else 1
@@ -135,7 +144,12 @@ class DirectTaskSubmitter:
         while ks.pending and progress:
             progress = False
             for lease in ks.leases.values():
-                if lease.dead or len(lease.inflight) >= depth or not ks.pending:
+                if (
+                    lease.dead
+                    or lease.draining
+                    or len(lease.inflight) >= depth
+                    or not ks.pending
+                ):
                     continue
                 spec = ks.pending.popleft()
                 tid = spec.task_id.binary()
@@ -156,7 +170,7 @@ class DirectTaskSubmitter:
     def _maybe_request_leases_locked(self, ks: _KeyState) -> None:
         if self._closed or not ks.pending:
             return
-        live = sum(1 for l in ks.leases.values() if not l.dead)
+        live = self._live_leases(ks)
         # One outstanding request per pending task, up to the cap — the
         # raylet parks requests it can't grant yet, so over-requesting is
         # cheap and under-requesting serializes the whole queue.
@@ -268,6 +282,7 @@ class DirectTaskSubmitter:
         self._worker._notify_stream_finished(payload["task_id"])
         self._worker.reference_counter.return_borrows(payload["task_id"])
         self._worker._cancelled_tasks.discard(payload["task_id"])
+        retire = None
         with self._lock:
             lease = ks.leases.get(wid)
             if lease is None:
@@ -284,6 +299,47 @@ class DirectTaskSubmitter:
             self._maybe_request_leases_locked(ks)
             if not lease.inflight:
                 lease.idle_since = time.monotonic()
+                if lease.draining:
+                    # Last in-flight task on a draining node finished:
+                    # hand the worker back before the node disappears.
+                    ks.leases.pop(wid, None)
+                    lease.dead = True
+                    retire = lease
+        if retire is not None:
+            try:
+                retire.client.close()
+            except Exception:
+                pass
+            self._return_lease_to_raylet(retire.worker_id, retire.raylet)
+
+    def on_node_draining(self, raylet_address: Optional[str]) -> None:
+        """The named node entered DRAINING (nodes pubsub): stop feeding
+        its leases, return idle ones now, and request replacement leases
+        for queued work — the proactive path, instead of waiting for the
+        node to die under our in-flight tasks."""
+        if raylet_address is None:
+            return
+        to_return = []
+        with self._lock:
+            for ks in self._keys.values():
+                for wid, lease in list(ks.leases.items()):
+                    if lease.dead or lease.draining:
+                        continue
+                    if getattr(lease.raylet, "address", None) != raylet_address:
+                        continue
+                    lease.draining = True
+                    if not lease.inflight:
+                        ks.leases.pop(wid, None)
+                        lease.dead = True
+                        to_return.append(lease)
+                if ks.pending:
+                    self._maybe_request_leases_locked(ks)
+        for lease in to_return:
+            try:
+                lease.client.close()
+            except Exception:
+                pass
+            self._return_lease_to_raylet(lease.worker_id, lease.raylet)
 
     def _on_lease_lost(self, wid: bytes, ks: _KeyState) -> None:
         """The leased worker's connection dropped (worker crash, exit, or

@@ -26,7 +26,8 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, telemetry
+from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import (
     ActorInfo,
     Bundle,
@@ -264,6 +265,27 @@ class GcsServer:
         # dirtying here so persistence can't drift from visible state.
         if channel == "actors" or channel.startswith("actor:") or channel == "placement_groups":
             self._dirty()
+        # Chaos on the pubsub plane (ROADMAP PR-1 follow-up): one decision
+        # per published message, pattern "pubsub:<channel>" — so drain and
+        # death notices can themselves be dropped/delayed/duplicated in
+        # drills and the reactive heartbeat path is exercised as the
+        # fallback.  Decisions stay deterministic in the per-rule match
+        # ordinal, like every other chaos site.
+        if CHAOS.active:
+            d = CHAOS.decide(f"pubsub:{channel}", "req")
+            if d.drop:
+                return
+            if d.delay_s > 0:
+                self.loop.call_later(
+                    d.delay_s, self._deliver_publish, channel, message
+                )
+                if not d.dup:
+                    return
+            elif d.dup:
+                self._deliver_publish(channel, message)
+        self._deliver_publish(channel, message)
+
+    def _deliver_publish(self, channel: str, message: Any):
         dead = []
         for conn in self.subs.get(channel, ()):
             if conn.closed:
@@ -311,6 +333,9 @@ class GcsServer:
             "is_head": info.is_head,
             "hostname": info.hostname,
             "start_time": info.start_time,
+            "drain_reason": info.drain_reason,
+            "drain_deadline": info.drain_deadline,
+            "drain_complete": info.drain_complete,
         }
 
     # ------------------------------------------------------------------
@@ -381,7 +406,11 @@ class GcsServer:
             await asyncio.sleep(period)
             now = time.monotonic()
             for node_id, info in list(self.nodes.items()):
-                if info.state != "ALIVE":
+                # DRAINING nodes stay under heartbeat watch: the reactive
+                # path is the fallback when the drain notice (or the whole
+                # drain) is lost — a preempted node that dies at its
+                # deadline is detected here like any other death.
+                if info.state not in ("ALIVE", "DRAINING"):
                     continue
                 conn = self.node_conns.get(node_id)
                 if (conn is None or conn.closed) and now - self.last_heartbeat.get(node_id, now) > threshold:
@@ -435,6 +464,174 @@ class GcsServer:
             if pg.state == "CREATED" and any(b.node_id == node_id for b in pg.bundles):
                 pg.state = "RESCHEDULING"
                 self.loop.create_task(self._schedule_pg(pg))
+
+    # ------------------------------------------------------------------
+    # drain plane (reference: gcs_node_manager DrainNode; the autoscaler
+    # and preemption notices turn planned node loss into a cheap,
+    # proactive path instead of a heartbeat-timeout + lineage repair)
+    # ------------------------------------------------------------------
+    async def rpc_drain_node(self, payload, conn):
+        """Start draining a node: ALIVE -> DRAINING.  The node stops
+        receiving new work (its raylet rejects leases and bundle
+        reservations; this GCS stops placing actors there), restartable
+        actors are migrated ahead of the kill, and objects whose only
+        live copy sits on the draining node are re-replicated so lineage
+        reconstruction is never needed on the happy path.  Idempotent —
+        a duplicate drain joins the in-flight one."""
+        node_id = NodeID(payload["node_id"])
+        info = self.nodes.get(node_id)
+        if info is None or info.state == "DEAD":
+            return {"accepted": False, "state": info.state if info else None}
+        reason = payload.get("reason") or "PREEMPTION"
+        deadline_s = float(payload.get("deadline_s") or CONFIG.drain_deadline_s_default)
+        if info.state == "DRAINING":
+            # Keep the earliest deadline (a second, tighter notice wins).
+            info.drain_deadline = min(info.drain_deadline, time.time() + deadline_s)
+            return {"accepted": True, "state": "DRAINING"}
+        info.state = "DRAINING"
+        info.drain_reason = reason
+        info.drain_deadline = time.time() + deadline_s
+        info.drain_complete = False
+        self.available.pop(node_id, None)
+        self.pending_shapes.pop(node_id, None)
+        telemetry.count_drain_event(reason)
+        logger.warning(
+            "node %s draining (%s, deadline in %.1fs)",
+            node_id.hex()[:8], reason, deadline_s,
+        )
+        # Direct push to the raylet (not only pubsub, which drills may
+        # chaos-drop): it must stop granting leases immediately.
+        client = self.node_clients.get(node_id)
+        if client is not None:
+            try:
+                await client.push(
+                    "drain", {"reason": reason, "deadline": info.drain_deadline}
+                )
+            except Exception:
+                pass
+        self.publish("nodes", ("DRAINING", self._node_dict(info)))
+        self.loop.create_task(self._drain_node_task(info))
+        return {"accepted": True, "state": "DRAINING"}
+
+    async def _drain_node_task(self, info: NodeInfo):
+        """Background migration for one draining node: restart-capable
+        actors are restarted elsewhere NOW (reusing the idempotent
+        lease/submit machinery), and sole-copy objects are pulled to a
+        live node via the object manager, then the node is marked
+        drain-complete."""
+        node_id = info.node_id
+        t0 = time.monotonic()
+        # Actor kills run CONCURRENTLY with object replication: a slow
+        # actor __init__ on the new host (restart is awaited inside
+        # _kill_actor -> _schedule_actor) must not stall the sole-copy
+        # scan past the deadline.
+        kill_tasks = []
+        for actor in list(self.actors.values()):
+            if actor.node_id != node_id or actor.state not in ("ALIVE", "PENDING_CREATION"):
+                continue
+            if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
+                # no_restart=False: kill the old worker, then the normal
+                # restart path schedules the actor on a non-draining node
+                # (_pick_node only considers ALIVE nodes).
+                kill_tasks.append(
+                    self.loop.create_task(
+                        self._kill_actor(
+                            actor,
+                            f"node {node_id.hex()[:8]} draining ({info.drain_reason})",
+                            no_restart=False,
+                        )
+                    )
+                )
+        # Objects whose every live location is draining: replicate to the
+        # most-available ALIVE node.  DRAINING locations still serve
+        # reads, so the pull path can fetch from the doomed node.  The
+        # doomed set is RECOMPUTED on every pass — in-flight work is
+        # allowed to run to completion during the notice, and anything it
+        # seals on the draining node becomes a new sole copy.
+        requested: set = set()
+        replication_failed = False
+
+        def current_doomed():
+            return [
+                bytes(oid)
+                for oid, locs in self.object_locations.items()
+                if node_id in locs
+                and not any(
+                    (ni := self.nodes.get(n)) is not None and ni.state == "ALIVE"
+                    for n in locs
+                )
+            ]
+
+        async def replicate_new():
+            """Ask a live node to pull any not-yet-requested sole copies;
+            returns the currently-doomed set."""
+            nonlocal replication_failed
+            doomed = current_doomed()
+            new = [o for o in doomed if o not in requested]
+            if not new:
+                return doomed
+            targets = [n for n, i in self.nodes.items() if i.state == "ALIVE"]
+            tclient = (
+                self.node_clients.get(
+                    max(
+                        targets,
+                        key=lambda n: sum(self.available.get(n, ResourceSet()).values()),
+                    )
+                )
+                if targets
+                else None
+            )
+            if tclient is None:
+                replication_failed = True  # nowhere to put the only copies
+                return doomed
+            try:
+                await tclient.push("replicate_objects", {"oids": new})
+                requested.update(new)
+            except Exception:
+                replication_failed = True
+            return doomed
+
+        poll = CONFIG.drain_poll_ms / 1000
+        while info.state == "DRAINING" and time.time() < info.drain_deadline:
+            if not await replicate_new():
+                break
+            await asyncio.sleep(poll)
+        # Bound the wait on actor restarts by the notice window; a wait
+        # (not gather+wait_for) so a timeout doesn't cancel the restarts.
+        if kill_tasks:
+            await asyncio.wait(
+                kill_tasks, timeout=max(0.1, info.drain_deadline - time.time())
+            )
+        # Final sweep: anything sealed while the actors were restarting.
+        while (
+            info.state == "DRAINING"
+            and time.time() < info.drain_deadline
+            and await replicate_new()
+        ):
+            await asyncio.sleep(poll)
+        if info.state != "DRAINING":
+            return  # died mid-drain; _mark_node_dead already handled it
+        elapsed = time.monotonic() - t0
+        migrated = sum(1 for t in kill_tasks if t.done())
+        if replication_failed or current_doomed():
+            # drain_complete stays False: the node still holds the only
+            # copy of something.  The autoscaler's terminate-by deadline
+            # is the (pre-drain-plane) fallback; a preempted node dies
+            # regardless and lineage reconstruction repairs reactively.
+            logger.warning(
+                "node %s drain incomplete after %.2fs: %d sole-copy "
+                "object(s) still unreplicated",
+                node_id.hex()[:8], elapsed, len(current_doomed()),
+            )
+            return
+        info.drain_complete = True
+        telemetry.observe_drain_migration(elapsed)
+        logger.info(
+            "node %s drain complete in %.2fs: %d actor(s) migrated, "
+            "%d sole-copy object(s) replicated",
+            node_id.hex()[:8], elapsed, migrated, len(requested),
+        )
+        self.publish("nodes", ("DRAINING", self._node_dict(info)))
 
     # ------------------------------------------------------------------
     # job manager
@@ -577,7 +774,10 @@ class GcsServer:
         out = []
         for n in locs:
             info = self.nodes.get(n)
-            if info and info.state == "ALIVE":
+            # DRAINING nodes still serve reads: their copies are valid
+            # until the deadline, and drain-time re-replication pulls
+            # FROM them.
+            if info and info.state in ("ALIVE", "DRAINING"):
                 out.append({"node_id": n.binary(), "raylet_address": info.raylet_address})
         return out
 
@@ -608,7 +808,9 @@ class GcsServer:
             return False
         locs = self.object_locations.get(oid) or ()
         return not any(
-            (info := self.nodes.get(n)) is not None and info.state == "ALIVE" for n in locs
+            (info := self.nodes.get(n)) is not None
+            and info.state in ("ALIVE", "DRAINING")
+            for n in locs
         )
 
     async def rpc_objects_resubmitted(self, payload, conn):
@@ -717,6 +919,11 @@ class GcsServer:
             node_id = self._pick_node(resources, strategy)
         if node_id is None:
             # No node fits now — queue and retry when resources change.
+            # The actor is between homes: clear its placement so a dead
+            # node's sweep (or a stale death report from the old host)
+            # can't fail/restart it again while it waits.
+            info.node_id = None
+            info.raylet_address = None
             if info.actor_id not in self.pending_actors:
                 self.pending_actors.append(info.actor_id)
             return
@@ -748,6 +955,7 @@ class GcsServer:
                 "insufficient resources" in msg
                 or "bundle cannot host" in msg
                 or "spawn gate saturated" in msg
+                or "draining" in msg  # raced a drain notice: place elsewhere
             )
             if "failed to start" in msg:
                 # a start timeout under machine load is transient: retry
@@ -759,6 +967,8 @@ class GcsServer:
                 # node).  Queue and retry when the view refreshes — the
                 # reference never fails an actor for transient resource
                 # shortage (gcs_actor_scheduler retries leases).
+                info.node_id = None
+                info.raylet_address = None
                 if info.actor_id not in self.pending_actors:
                     self.pending_actors.append(info.actor_id)
                 self.loop.call_later(0.2, self._kick_pending)
@@ -833,6 +1043,22 @@ class GcsServer:
         actor_id = ActorID(payload["actor_id"])
         info = self.actors.get(actor_id)
         if info is None:
+            return False
+        reporter = conn.meta.get("node_id")
+        if (
+            reporter is not None
+            and info.node_id is not None
+            and reporter != info.node_id
+        ):
+            # Stale report from a node the actor already left (drain-time
+            # migration kills the old worker AFTER rescheduling): the old
+            # host's death report must not restart the actor again at its
+            # new home.
+            return False
+        if info.state == "RESTARTING" and info.node_id is None:
+            # Parked between homes (no worker exists anywhere): any death
+            # report is from the previous host and must not double-charge
+            # num_restarts or fail the actor outright.
             return False
         if payload.get("intended"):
             await self._fail_actor(info, payload.get("reason", "ray.kill / __ray_terminate__"))
@@ -1115,13 +1341,18 @@ class GcsServer:
                 demands.extend(dict(b.resources) for b in pg.bundles)
         nodes = {}
         for node_id, info in self.nodes.items():
-            if info.state != "ALIVE":
+            # DRAINING nodes stay visible (state-tagged) so the autoscaler
+            # can poll drain progress before terminating; consumers must
+            # not count them as free capacity.
+            if info.state not in ("ALIVE", "DRAINING"):
                 continue
             nodes[node_id.hex()] = {
                 "total": dict(info.resources_total),
                 "available": dict(self.available.get(node_id, ResourceSet())),
                 "is_head": info.is_head,
                 "raylet_address": info.raylet_address,
+                "state": info.state,
+                "drain_complete": info.drain_complete,
             }
         return {"pending_demands": demands, "nodes": nodes}
 

@@ -635,6 +635,10 @@ class ObjectStoreCore:
             "num_spilled": self.num_spilled,
             "spilled_bytes": self.spilled_bytes,
             "num_restored": self.num_restored,
+            # Pinned objects (actor/borrow pins + drain-time replicas):
+            # excluded from LRU eviction, so drain migration can't be
+            # silently undone by memory pressure.
+            "num_pinned": sum(1 for e in self.objects.values() if e.pin_count > 0),
         }
 
 

@@ -187,6 +187,15 @@ class Raylet:
         # idle reaper once the submitter's retry horizon has passed.
         self._lease_grants: Dict[bytes, Tuple[asyncio.Future, float]] = {}
 
+        # Drain plane: set by the GCS "drain" push (preemption notice or
+        # autoscaler idle scale-down).  A draining raylet grants no new
+        # leases, refuses bundle reservations and actor creations, and
+        # spills queued work to peers; in-flight tasks run to completion
+        # inside the deadline.
+        self.draining = False
+        self.drain_reason: Optional[str] = None
+        self.drain_deadline = 0.0
+
         # Metrics
         self.num_tasks_dispatched = 0
         self.num_tasks_spilled = 0
@@ -553,7 +562,10 @@ class Raylet:
                         "total": node.get("resources_total", {}),
                         "labels": node.get("labels", {}),
                     }
-                elif state == "DEAD":
+                elif state in ("DEAD", "DRAINING"):
+                    # A DRAINING peer grants no leases and takes no spills
+                    # — drop it from the spill/spillback candidate view
+                    # (objects are still pulled from it via GCS locations).
                     self.cluster_view.pop(nb, None)
         # NOTE: kill_actor/job_finished/store_free arrive via the GCS's
         # node client as push_* handlers below, not on this channel.
@@ -568,6 +580,14 @@ class Raylet:
             if CHAOS.active and CHAOS.maybe_kill("raylet.tick"):
                 logger.warning("chaos: killing raylet at report tick")
                 os._exit(1)
+            # "@raylet.tick:preempt:at=N:ms=K": on the N-th tick this node
+            # receives a K-ms preemption notice — it asks the GCS to drain
+            # it, then hard-dies at the deadline, modeling a spot/
+            # preemptible TPU host (seed-replayable like every fault).
+            if CHAOS.active and not self.draining:
+                notice = CHAOS.maybe_preempt("raylet.tick")
+                if notice is not None:
+                    self._begin_chaos_preemption(notice)
             now = time.monotonic()
             self._unmet_lease_demand = {
                 k: v
@@ -613,6 +633,33 @@ class Raylet:
                 for spec in infeasible:
                     self._queue_and_schedule(spec)
             await asyncio.sleep(0.2)
+
+    def _begin_chaos_preemption(self, notice_s: float):
+        """Deliver the preemption notice (drain_node to the GCS) and
+        schedule the hard kill at the deadline.  The drain itself may be
+        chaos-dropped — then the cluster only finds out via the reactive
+        heartbeat path when the process dies."""
+        logger.warning(
+            "chaos: preemption notice on %s — draining, killing in %.1fs",
+            self.node_id.hex()[:8], notice_s,
+        )
+
+        async def deliver():
+            try:
+                await self.gcs.call(
+                    "drain_node",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "reason": "PREEMPTION",
+                        "deadline_s": notice_s,
+                    },
+                    timeout=min(10.0, max(1.0, notice_s)),
+                )
+            except rpc.RpcError:
+                logger.warning("chaos: preemption drain notice lost")
+
+        self.loop.create_task(deliver())
+        self.loop.call_later(notice_s, os._exit, 1)
 
     async def _idle_reaper_loop(self):
         while not self._stopping:
@@ -1025,9 +1072,12 @@ class Raylet:
 
         Hybrid policy: keep local while local available resources fit
         (pack); otherwise pick the least-utilized remote that fits
-        (reference: hybrid_scheduling_policy.cc top-k pack-then-spread)."""
+        (reference: hybrid_scheduling_policy.cc top-k pack-then-spread).
+        A draining node inverts the bias: spill whenever any peer fits,
+        keep local only as a last resort (the work would race the drain
+        deadline)."""
         res = spec.resources
-        if res.fits_in(self.resources_available):
+        if not self.draining and res.fits_in(self.resources_available):
             return None
         best = None
         best_avail = -1.0
@@ -1269,6 +1319,12 @@ class Raylet:
     async def _request_worker_lease_inner(self, payload, conn):
         res = ResourceSet.of(payload["resources"])
         job_id = JobID(payload["job_id"])
+        if self.draining:
+            # A draining node grants no new leases (reference: raylet
+            # lease rejection while draining): point the submitter at a
+            # live peer, or reject outright so it re-asks elsewhere.
+            target = self._spill_target(res) if not payload.get("spilled") else None
+            return {"spill": target, "draining": True} if target else {"draining": True}
         lease_env = payload.get("runtime_env")
         lease_env_hash = runtime_env_mod.env_hash(lease_env)
         bad = self.bad_runtime_envs.get(lease_env_hash)
@@ -1302,9 +1358,14 @@ class Raylet:
             self.lease_waiters.append((res, fut))
             self._grant_lease_waiters()  # may grant immediately (empty queue ahead)
             try:
-                await asyncio.wait_for(
+                verdict = await asyncio.wait_for(
                     fut, max(1.0, deadline - time.monotonic())
                 )
+                if verdict is not True:
+                    # Drain flush woke us without granting (no resources
+                    # were debited): send the submitter elsewhere.
+                    target = self._spill_target(res)
+                    return {"spill": target, "draining": True} if target else None
             except asyncio.TimeoutError:
                 # wait_for cancelled the future, so it can never have been
                 # granted (a granted future makes wait_for return instead):
@@ -1414,6 +1475,8 @@ class Raylet:
         return True
 
     def _grant_lease_waiters(self):
+        if self.draining:
+            return  # push_drain flushes the queue; no new grants
         while self.lease_waiters:
             res, fut = self.lease_waiters[0]
             if fut.done():
@@ -1444,6 +1507,10 @@ class Raylet:
         """From GCS: spawn a dedicated worker and run the creation task."""
         spec: TaskSpec = payload["spec"]
         res = spec.resources
+        if self.draining:
+            # The GCS treats this as transient and re-schedules the actor
+            # on a live node (its view may lag the drain by one tick).
+            raise RuntimeError("node is draining; retry actor creation elsewhere")
         # Spawn flow control FIRST — before any resources are reserved,
         # so a parked creation can't block task leases on the node.  A
         # creation burst (many actors at once) must not fork more
@@ -1583,6 +1650,8 @@ class Raylet:
         res = ResourceSet.of(payload["resources"])
         if key in self.bundles:
             return True
+        if self.draining:
+            return False  # no new reservations on a node about to vanish
         if not res.fits_in(self.resources_available):
             return False
         self.resources_available.subtract(res)
@@ -1769,6 +1838,47 @@ class Raylet:
         """From GCS over its node client (reference: raylet KillActor rpc)."""
         self._kill_actor_local(ActorID(payload["actor_id"]), intended=True)
 
+    async def push_drain(self, payload, conn):
+        """From GCS: this node is draining (preemption notice or idle
+        scale-down).  Stop granting leases, reject new reservations, and
+        spill queued work; running tasks finish inside the deadline."""
+        if self.draining:
+            return
+        self.draining = True
+        self.drain_reason = payload.get("reason")
+        self.drain_deadline = payload.get("deadline", 0.0)
+        logger.warning(
+            "raylet %s draining (%s): rejecting new leases/reservations",
+            self.node_id.hex()[:8], self.drain_reason,
+        )
+        # Parked lease requests can never be granted here anymore — wake
+        # them with a non-grant verdict so their submitters re-lease on
+        # another node instead of waiting out the lease timeout.
+        while self.lease_waiters:
+            _res, fut = self.lease_waiters.popleft()
+            if not fut.done():
+                fut.set_result("draining")
+        # Queued tasks re-run the spill decision (now drain-aware).
+        self._schedule_dispatch()
+
+    async def push_replicate_objects(self, payload, conn):
+        """From GCS during a peer node's drain: pull the listed objects
+        here so the cluster keeps a live copy after the draining node
+        dies.  Pinned on arrival so eviction can't immediately undo the
+        migration (per-job GC still reclaims them at job end)."""
+        for oid_bytes in payload.get("oids", ()):
+            oid = ObjectID(oid_bytes)
+            if self.store.contains(oid):
+                self.store.pin(oid)
+                continue
+            fut = self._start_pull(oid)
+
+            def _pin(_f, o=oid):
+                if self.store.contains(o):
+                    self.store.pin(o)
+
+            fut.add_done_callback(_pin)
+
     async def push_job_finished(self, payload, conn):
         self._on_job_finished(JobID(payload))
 
@@ -1943,6 +2053,9 @@ class Raylet:
             "node_id": self.node_id.binary(),
             "resources_total": dict(self.resources_total),
             "resources_available": dict(self.resources_available),
+            "draining": self.draining,
+            "drain_reason": self.drain_reason,
+            "drain_deadline": self.drain_deadline,
             "num_workers": len(self.workers),
             "queue_len": len(self.queue),
             "infeasible": len(self.infeasible),

@@ -102,6 +102,17 @@ class _Metrics:
             boundaries=[0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0],
             tag_keys=("rank",),
         )
+        self.drain_events = m.Counter(
+            "drain_events_total",
+            "node drains initiated, by reason (PREEMPTION, IDLE_TERMINATION)",
+            tag_keys=("reason",),
+        )
+        self.drain_migration = m.Histogram(
+            "drain_migration_seconds",
+            "time from drain start until actors are migrated and sole-copy "
+            "objects are re-replicated off the draining node",
+            boundaries=[0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0],
+        )
 
 
 def _metrics() -> _Metrics:
@@ -210,3 +221,21 @@ def observe_train_step(rank: int, seconds: float) -> None:
         _train_bound, rank_s, "train_step", {"rank": rank_s}
     )
     b.observe(seconds)
+
+
+_drain_bound: dict = {}
+
+
+def count_drain_event(reason: str) -> None:
+    if not enabled():
+        return
+    b = _drain_bound.get(reason) or _bind(
+        _drain_bound, reason, "drain_events", {"reason": reason}
+    )
+    b.inc(1.0)
+
+
+def observe_drain_migration(seconds: float) -> None:
+    if not enabled():
+        return
+    _metrics().drain_migration.observe(max(0.0, seconds))

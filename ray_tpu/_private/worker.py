@@ -407,6 +407,12 @@ class Worker:
         # Owner side: task ids cancelled via ray_tpu.cancel — retry paths
         # consult this to fail instead of resubmitting.
         self._cancelled_tasks: set = set()
+        # Node lifecycle listeners (drain plane): callbacks invoked with
+        # (state, node_dict) for every "nodes" pubsub event.  The direct
+        # submitter uses this to proactively re-lease off DRAINING nodes;
+        # the train backend executor uses it to trigger a pre-preemption
+        # checkpoint.
+        self._node_listeners: list = []
         # Executor side: cancel requests for tasks queued/running here,
         # plus live execution registries so a cancel targets exactly the
         # right thread / asyncio task (a shared "current thread" would
@@ -435,6 +441,9 @@ class Worker:
         self.namespace = reply["namespace"]
         self.session_info = reply["session_info"]
         self.gcs_client.call("subscribe", "actors")
+        # Node lifecycle events: owners react to DRAINING targets by
+        # re-leasing proactively instead of waiting for RPC failure.
+        self.gcs_client.call("subscribe", "nodes")
         if CONFIG.log_to_driver:
             # Worker stdout/stderr of this job streams here (reference:
             # log_monitor.py → driver printing with worker prefixes).
@@ -469,6 +478,7 @@ class Worker:
             on_reconnect=self._on_gcs_reconnected,
         )
         self.gcs_client.call("subscribe", "actors")
+        self.gcs_client.call("subscribe", "nodes")
         # The raylet owns this worker's lifetime: if it dies, exit
         # (reference: workers suicide when their raylet disappears).
         self.raylet_client = rpc.RpcClient(
@@ -647,6 +657,7 @@ class Worker:
         self._oom_worker_kills.clear()
         self._cancelled_tasks.clear()
         self._cancel_requested.clear()
+        self._node_listeners.clear()
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
         self.actor_cache = ActorStateCache(self)
@@ -661,6 +672,15 @@ class Worker:
             channel, msg = payload
             if channel == "actors":
                 self.actor_cache.on_update(msg)
+            elif channel == "nodes":
+                # Off the RPC read thread: listeners may issue synchronous
+                # GCS/actor calls (drain handoffs do), and a call from the
+                # read loop would deadlock on its own reply.  Node events
+                # are rare (lifecycle only), so a thread per event is fine.
+                threading.Thread(
+                    target=self._on_node_event, args=(msg,),
+                    daemon=True, name="node-event",
+                ).start()
             elif channel.startswith("logs:"):
                 import sys as _sys
 
@@ -668,11 +688,43 @@ class Worker:
                 for line in msg.get("lines", ()):
                     print(f"{prefix} {line}", file=_sys.stderr)
 
+    def _on_node_event(self, msg):
+        """A "nodes" pubsub event (ALIVE/DRAINING/DEAD).  Fan out to the
+        drain-aware subsystems: the direct submitter stops feeding leases
+        on a draining node and re-leases elsewhere; registered listeners
+        (train's backend executor) get the raw event."""
+        try:
+            state, node = msg
+        except (TypeError, ValueError):
+            return
+        if state == "DRAINING" and self._direct_submitter is not None:
+            try:
+                self._direct_submitter.on_node_draining(node.get("raylet_address"))
+            except Exception:
+                logger.exception("drain handoff to direct submitter failed")
+        for cb in list(self._node_listeners):
+            try:
+                cb(state, node)
+            except Exception:
+                logger.exception("node event listener failed")
+
+    def add_node_listener(self, cb) -> None:
+        """Register cb(state, node_dict) for cluster node lifecycle
+        events (every connected process subscribes to "nodes")."""
+        self._node_listeners.append(cb)
+
+    def remove_node_listener(self, cb) -> None:
+        try:
+            self._node_listeners.remove(cb)
+        except ValueError:
+            pass
+
     def _on_gcs_reconnected(self):
         """The GCS restarted: re-subscribe and re-bind this driver's job so
         disconnect-driven cleanup keeps working."""
         try:
             self.gcs_client.call("subscribe", "actors")
+            self.gcs_client.call("subscribe", "nodes")
             if self.mode == "driver" and self.job_id is not None:
                 if CONFIG.log_to_driver:
                     self.gcs_client.call("subscribe", f"logs:{self.job_id.hex()}")

@@ -1,13 +1,15 @@
 """StandardAutoscaler (reference: autoscaler/_private/autoscaler.py:172):
 periodic loop — read load from GCS, launch nodes for unmet demand,
-terminate idle nodes past the timeout."""
+drain then terminate idle nodes past the timeout (idle scale-down goes
+ALIVE -> DRAINING -> terminate so leases stop, actors migrate, and
+sole-copy objects are re-replicated before the node disappears)."""
 
 from __future__ import annotations
 
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.autoscaler.node_provider import (
     TAG_NODE_KIND,
@@ -18,6 +20,34 @@ from ray_tpu.autoscaler.node_provider import (
 from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
 
 logger = logging.getLogger(__name__)
+
+
+def request_node_drain(gcs_client, node_hex: Optional[str]) -> Optional[float]:
+    """Ask the GCS to drain a node for idle scale-down (shared by the v1
+    and v2 autoscalers).  Returns the monotonic terminate-by time (drain
+    deadline + grace) on success, None when there is no drain path (no
+    GCS client / unknown node / RPC failure) — callers fall back to the
+    hard kill."""
+    if node_hex is None or gcs_client is None:
+        return None
+    from ray_tpu._private.config import CONFIG
+
+    deadline_s = float(CONFIG.idle_drain_deadline_s)
+    try:
+        reply = gcs_client.call(
+            "drain_node",
+            {
+                "node_id": bytes.fromhex(node_hex),
+                "reason": "IDLE_TERMINATION",
+                "deadline_s": deadline_s,
+            },
+            timeout=10,
+        )
+    except Exception:
+        return None
+    if not (reply and reply.get("accepted")):
+        return None
+    return time.monotonic() + deadline_s + 10.0
 
 
 class StandardAutoscaler:
@@ -41,9 +71,14 @@ class StandardAutoscaler:
         # launches whose nodes have not yet registered with the GCS:
         # (node_type, launch time) — trimmed as nodes come up
         self._booting: List[tuple] = []
+        # provider node id -> monotonic terminate-by time for nodes the
+        # GCS is draining on our behalf; terminated once drain_complete
+        # (or the node dies / the deadline passes).
+        self._draining: Dict[str, float] = {}
         self._warned_no_mapping = False
         self.num_launches = 0
         self.num_terminations = 0
+        self.num_drains = 0
 
     # -- one reconcile pass ---------------------------------------------
     def update(self, load_metrics: Optional[dict] = None):
@@ -63,8 +98,13 @@ class StandardAutoscaler:
         for node_type, _t in self._booting:
             pending_launches[node_type] = pending_launches.get(node_type, 0) + 1
 
-        # free capacity on live worker+head nodes
-        existing_free = [dict(n["available"]) for n in nodes_view.values()]
+        # free capacity on live worker+head nodes (DRAINING nodes are
+        # visible in the view for drain tracking but grant nothing)
+        existing_free = [
+            dict(n["available"])
+            for n in nodes_view.values()
+            if n.get("state", "ALIVE") == "ALIVE"
+        ]
 
         to_launch = get_nodes_to_launch(
             demands,
@@ -92,9 +132,29 @@ class StandardAutoscaler:
             self._booting.extend((node_type, now) for _ in range(count))
             self.num_launches += count
 
-        # idle termination: a worker node with full availability == idle
+        # finalize in-flight drains: terminate once the GCS reports the
+        # migration complete (or the node died / the deadline passed)
         now = time.monotonic()
+        for node_id in list(self._draining):
+            addr = self.provider.raylet_address(node_id)
+            _hex, rec = self._node_view_for(nodes_view, addr)
+            if (
+                rec is None
+                or rec.get("state") == "DEAD"
+                or rec.get("drain_complete")
+                or now > self._draining[node_id]
+            ):
+                logger.info("autoscaler: terminating drained node %s", node_id)
+                self._draining.pop(node_id, None)
+                self.provider.terminate_node(node_id)
+                self.num_terminations += 1
+
+        # idle termination: a worker node with full availability == idle.
+        # Scale-down is graceful: drain through the GCS first so in-flight
+        # work lands and nothing new is scheduled, then terminate.
         for node_id in workers:
+            if node_id in self._draining:
+                continue
             addr = self.provider.raylet_address(node_id)
             if addr is None:
                 if not self._warned_no_mapping:
@@ -105,26 +165,40 @@ class StandardAutoscaler:
                     )
                     self._warned_no_mapping = True
                 continue
-            rec = self._node_view_for(nodes_view, addr)
-            idle = rec is not None and _dicts_equal(rec["available"], rec["total"])
+            node_hex, rec = self._node_view_for(nodes_view, addr)
+            idle = (
+                rec is not None
+                and rec.get("state", "ALIVE") == "ALIVE"
+                and _dicts_equal(rec["available"], rec["total"])
+            )
             if idle and not demands:
                 first = self._idle_since.setdefault(node_id, now)
                 if now - first > self.idle_timeout_s:
-                    logger.info("autoscaler: terminating idle node %s", node_id)
-                    self.provider.terminate_node(node_id)
-                    self.num_terminations += 1
                     self._idle_since.pop(node_id, None)
+                    terminate_by = request_node_drain(self.gcs_client, node_hex)
+                    if terminate_by is not None:
+                        logger.info("autoscaler: draining idle node %s", node_id)
+                        self.num_drains += 1
+                        self._draining[node_id] = terminate_by
+                    else:
+                        # No drain path (GCS unreachable / unknown node):
+                        # fall back to the hard kill.
+                        logger.info("autoscaler: terminating idle node %s", node_id)
+                        self.provider.terminate_node(node_id)
+                        self.num_terminations += 1
             else:
                 self._idle_since.pop(node_id, None)
 
     @staticmethod
-    def _node_view_for(nodes_view: dict, raylet_address: Optional[str]):
+    def _node_view_for(
+        nodes_view: dict, raylet_address: Optional[str]
+    ) -> Tuple[Optional[str], Optional[dict]]:
         if raylet_address is None:
-            return None
-        for rec in nodes_view.values():
+            return None, None
+        for node_hex, rec in nodes_view.items():
             if rec.get("raylet_address") == raylet_address:
-                return rec
-        return None
+                return node_hex, rec
+        return None, None
 
 
 def _dicts_equal(a: Dict[str, float], b: Dict[str, float]) -> bool:

@@ -16,6 +16,7 @@ import logging
 import time
 from typing import Dict, Optional
 
+from ray_tpu.autoscaler.autoscaler import request_node_drain
 from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
 from ray_tpu.autoscaler.v2.instance_manager import InstanceManager
 from ray_tpu.autoscaler.v2.sdk import get_cluster_resource_constraints
@@ -39,6 +40,9 @@ class AutoscalerV2:
         self.idle_timeout_s = idle_timeout_s
         self.gcs_client = gcs_client
         self._idle_since: Dict[str, float] = {}
+        # instance_id -> monotonic terminate-by time while the GCS drains
+        # the node (graceful scale-down: drain, then queue_terminate).
+        self._draining: Dict[str, float] = {}
 
     def update(self, load_metrics: Optional[dict] = None):
         if load_metrics is None:
@@ -51,13 +55,14 @@ class AutoscalerV2:
                 pass
         nodes_view: Dict[str, dict] = load_metrics.get("nodes", {})
 
-        # Ray nodes by cloud instance id (provider maps the address).
+        # Ray nodes by cloud instance id (provider maps the address);
+        # the GCS node id rides along for drain requests.
         ray_by_cloud: Dict[str, dict] = {}
         for cloud_id in self.im.provider.non_terminated_nodes({}):
             addr = self.im.provider.raylet_address(cloud_id)
-            for rec in nodes_view.values():
+            for node_hex, rec in nodes_view.items():
                 if rec.get("raylet_address") == addr:
-                    ray_by_cloud[cloud_id] = rec
+                    ray_by_cloud[cloud_id] = dict(rec, node_id=node_hex)
 
         live = self.im.live()
         pending_by_type: Dict[str, int] = {}
@@ -65,7 +70,11 @@ class AutoscalerV2:
             if inst.status != "RAY_RUNNING":
                 pending_by_type[inst.node_type] = pending_by_type.get(inst.node_type, 0) + 1
 
-        existing_free = [dict(n["available"]) for n in nodes_view.values()]
+        existing_free = [
+            dict(n["available"])
+            for n in nodes_view.values()
+            if n.get("state", "ALIVE") == "ALIVE"
+        ]
         to_launch = get_nodes_to_launch(
             demands,
             existing_free,
@@ -82,15 +91,34 @@ class AutoscalerV2:
                 logger.info("autoscaler_v2: queueing %d x %s", count, node_type)
                 self.im.queue_launch(node_type, count)
 
-        # Idle scale-down (never below the declarative constraints —
-        # those demands keep the packer wanting the node, and we only
-        # retire nodes that are fully free AND unneeded).
+        # Finalize in-flight drains: queue the terminate once the GCS
+        # reports migration complete (or the node died / deadline passed).
         now = time.monotonic()
-        for inst in self.im.live():
-            if inst.status != "RAY_RUNNING":
+        for iid in list(self._draining):
+            inst = self.im.instances.get(iid)
+            if inst is None or inst.status not in ("RAY_RUNNING", "ALLOCATED"):
+                self._draining.pop(iid, None)
                 continue
             rec = ray_by_cloud.get(inst.cloud_instance_id)
-            if rec is None:
+            if (
+                rec is None
+                or rec.get("state") == "DEAD"
+                or rec.get("drain_complete")
+                or now > self._draining[iid]
+            ):
+                logger.info("autoscaler_v2: retiring drained %s", iid)
+                self._draining.pop(iid, None)
+                self.im.queue_terminate(iid)
+
+        # Idle scale-down (never below the declarative constraints —
+        # those demands keep the packer wanting the node, and we only
+        # retire nodes that are fully free AND unneeded).  Graceful:
+        # drain through the GCS first, terminate when drained.
+        for inst in self.im.live():
+            if inst.status != "RAY_RUNNING" or inst.instance_id in self._draining:
+                continue
+            rec = ray_by_cloud.get(inst.cloud_instance_id)
+            if rec is None or rec.get("state", "ALIVE") != "ALIVE":
                 continue
             fully_free = all(
                 abs(rec["available"].get(k, 0.0) - v) < 1e-9
@@ -99,9 +127,16 @@ class AutoscalerV2:
             if fully_free and not demands:
                 first = self._idle_since.setdefault(inst.instance_id, now)
                 if now - first > self.idle_timeout_s:
-                    logger.info("autoscaler_v2: retiring idle %s", inst.instance_id)
-                    self.im.queue_terminate(inst.instance_id)
                     self._idle_since.pop(inst.instance_id, None)
+                    terminate_by = request_node_drain(
+                        self.gcs_client, rec.get("node_id")
+                    )
+                    if terminate_by is not None:
+                        logger.info("autoscaler_v2: draining idle %s", inst.instance_id)
+                        self._draining[inst.instance_id] = terminate_by
+                    else:
+                        logger.info("autoscaler_v2: retiring idle %s", inst.instance_id)
+                        self.im.queue_terminate(inst.instance_id)
             else:
                 self._idle_since.pop(inst.instance_id, None)
 

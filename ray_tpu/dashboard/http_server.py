@@ -43,11 +43,14 @@ class _DashboardState:
         return [
             {
                 "node_id": NodeID(n["node_id"]).hex(),
-                "state": n["state"],
+                "state": n["state"],  # ALIVE | DRAINING | DEAD
                 "is_head": n.get("is_head", False),
                 "resources_total": n["resources_total"],
                 "raylet_address": n["raylet_address"],
                 "hostname": n.get("hostname", ""),
+                "drain_reason": n.get("drain_reason"),
+                "drain_deadline": n.get("drain_deadline", 0.0),
+                "drain_complete": n.get("drain_complete", False),
             }
             for n in info["nodes"].values()
         ]
@@ -57,6 +60,8 @@ class _DashboardState:
         total: dict = {}
         available: dict = {}
         for n in info["nodes"].values():
+            # Capacity view: DRAINING nodes grant nothing, so they are
+            # excluded from the totals (they still appear in /api/nodes).
             if n["state"] != "ALIVE":
                 continue
             for k, v in n["resources_total"].items():
@@ -66,6 +71,9 @@ class _DashboardState:
                 available[k] = available.get(k, 0) + v
         return {
             "nodes_alive": sum(1 for n in info["nodes"].values() if n["state"] == "ALIVE"),
+            "nodes_draining": sum(
+                1 for n in info["nodes"].values() if n["state"] == "DRAINING"
+            ),
             "nodes_dead": sum(1 for n in info["nodes"].values() if n["state"] == "DEAD"),
             "resources_total": total,
             "resources_available": available,
@@ -100,7 +108,7 @@ class _DashboardState:
     def workers(self):
         out = []
         for n in self.nodes():
-            if n["state"] != "ALIVE":
+            if n["state"] not in ("ALIVE", "DRAINING"):
                 continue
             try:
                 stats = self._raylet(n["raylet_address"]).call("node_stats", {})
@@ -114,7 +122,7 @@ class _DashboardState:
     def objects(self):
         out = []
         for n in self.nodes():
-            if n["state"] != "ALIVE":
+            if n["state"] not in ("ALIVE", "DRAINING"):
                 continue
             try:
                 stats = self._raylet(n["raylet_address"]).call(
@@ -159,7 +167,7 @@ class _DashboardState:
         except Exception:
             nodes = []
         for n in nodes:
-            if n["state"] != "ALIVE":
+            if n["state"] not in ("ALIVE", "DRAINING"):
                 continue
             try:
                 stats = self._raylet(n["raylet_address"]).call("node_stats", {})
@@ -227,7 +235,7 @@ class _DashboardState:
             nodes = []
         for n in nodes:
             try:
-                if n["state"] != "ALIVE":
+                if n["state"] not in ("ALIVE", "DRAINING"):
                     continue
                 stats = self._raylet(n["raylet_address"]).call("node_stats", {})
                 nid = n["node_id"][:12]
@@ -438,6 +446,7 @@ class _Handler(BaseHTTPRequestHandler):
             "<html><head><title>ray_tpu dashboard</title></head><body>"
             "<h2>ray_tpu cluster</h2>"
             f"<p>alive nodes: {status['nodes_alive']} &nbsp; "
+            f"draining: {status.get('nodes_draining', 0)} &nbsp; "
             f"dead: {status['nodes_dead']}</p>"
             f"<p>resources: {html_mod.escape(str(status['resources_total']))} &nbsp; "
             f"available: {html_mod.escape(str(status['resources_available']))}</p>"

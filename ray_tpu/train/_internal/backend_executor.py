@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
@@ -42,6 +43,13 @@ class BackendExecutor:
         self._ranks_meta: List[dict] = []
         self.storage_dir = os.path.join(run_config.resolved_storage_path(), experiment_name)
         os.makedirs(self.storage_dir, exist_ok=True)
+        # Drain plane: set when any node hosting a rank enters DRAINING
+        # (preemption notice / scale-down).  The trainer reads
+        # drain_imminent() and restarts the group from a drain-triggered
+        # checkpoint instead of discovering the death mid-collective.
+        self._drain_event = threading.Event()
+        self._drained_nodes: set = set()
+        self._node_listener = None
 
     def start(self):
         pg = None
@@ -57,6 +65,48 @@ class BackendExecutor:
         )
         self._ranks_meta = self.worker_group.metadata()
         self.backend.on_start(self.worker_group, self.backend_config)
+        self._watch_drain_events()
+
+    def _watch_drain_events(self):
+        from ray_tpu._private.worker import get_global_worker
+
+        rank_nodes = {m["node_id"] for m in self._ranks_meta}
+        group = self.worker_group
+
+        def on_node_event(state, node):
+            if state != "DRAINING":
+                return
+            try:
+                node_hex = node["node_id"].hex() if isinstance(
+                    node.get("node_id"), bytes
+                ) else str(node.get("node_id"))
+            except Exception:
+                return
+            if node_hex not in rank_nodes or node_hex in self._drained_nodes:
+                return
+            self._drained_nodes.add(node_hex)
+            logger.warning(
+                "drain notice covers rank node %s: requesting immediate "
+                "checkpoint from all ranks", node_hex[:8],
+            )
+            self._drain_event.set()
+            # Best-effort: ask every rank's session for a checkpoint at
+            # the next step boundary (fire-and-forget actor calls).
+            for w in list(group.workers):
+                try:
+                    w.notify_drain.remote()
+                except Exception:
+                    pass
+
+        self._node_listener = on_node_event
+        try:
+            get_global_worker().add_node_listener(on_node_event)
+        except Exception:
+            self._node_listener = None
+
+    def drain_imminent(self) -> bool:
+        """True once any node hosting a rank received a drain notice."""
+        return self._drain_event.is_set()
 
     def _rank_info(self) -> List[dict]:
         """world/local/node ranks per worker, grouped by node (reference:
@@ -113,6 +163,14 @@ class BackendExecutor:
         return results
 
     def shutdown(self):
+        if self._node_listener is not None:
+            from ray_tpu._private.worker import get_global_worker
+
+            try:
+                get_global_worker().remove_node_listener(self._node_listener)
+            except Exception:
+                pass
+            self._node_listener = None
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group, self.backend_config)

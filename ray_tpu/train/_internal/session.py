@@ -49,6 +49,20 @@ class _TrainSession:
         self._report_idx = 0
         self._last_report_t: Optional[float] = None
         self.error: Optional[BaseException] = None
+        # Drain plane: set when any rank's node received a preemption /
+        # scale-down notice.  The train loop polls it via
+        # train.get_context().drain_requested() and should checkpoint at
+        # the next step boundary — the proactive path that avoids losing
+        # progress to the mid-collective death.
+        self._drain_requested = threading.Event()
+
+    def request_drain_checkpoint(self):
+        """A drain notice covers this worker group: ask the user loop for
+        an immediate best-effort checkpoint."""
+        self._drain_requested.set()
+
+    def drain_requested(self) -> bool:
+        return self._drain_requested.is_set()
 
     def start(self):
         def runner():

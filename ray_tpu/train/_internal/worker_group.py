@@ -47,6 +47,13 @@ class RayTrainWorker:
     def next_report(self, timeout: Optional[float] = None):
         return self._session.next_report(timeout)
 
+    def notify_drain(self):
+        """Drain notice covers this worker group: surface it to the user
+        loop via train.get_context().drain_requested()."""
+        if self._session is not None:
+            self._session.request_drain_checkpoint()
+        return True
+
     def shutdown_session(self):
         self._session = None
         return True

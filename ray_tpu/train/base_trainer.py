@@ -266,6 +266,12 @@ class DataParallelTrainer(BaseTrainer):
         failure_config = self.run_config.failure_config or FailureConfig()
         max_failures = failure_config.max_failures
         attempts = 0
+        # Drain-triggered (proactive) restarts: a drain notice covering a
+        # rank's node triggers one best-effort checkpoint + whole-group
+        # restart that does NOT count against max_failures — the failure
+        # budget is only charged when the proactive checkpoint never
+        # materializes and the death is discovered reactively.
+        drain_restarts = 0
         latest_checkpoint: Optional[Checkpoint] = self.resume_from_checkpoint
         last_error: Optional[BaseException] = None
 
@@ -273,6 +279,7 @@ class DataParallelTrainer(BaseTrainer):
             executor = BackendExecutor(
                 self.backend_config, self.scaling_config, self.run_config, name
             )
+            proactive = False
             try:
                 executor.start()
                 self._save_trainer_state(executor.storage_dir)
@@ -292,11 +299,33 @@ class DataParallelTrainer(BaseTrainer):
                         continue
                     metrics = reports[0]["metrics"]  # rank 0 convention
                     metrics_history.append(metrics)
+                    round_ckpt = False
                     for r in reports:
                         if r.get("checkpoint") is not None:
                             latest_checkpoint = r["checkpoint"]
+                            round_ckpt = True
                     if reports and reports[0].get("checkpoint"):
                         best_checkpoints.append((reports[0]["checkpoint"], metrics))
+                    if (
+                        drain_restarts == 0
+                        and round_ckpt
+                        and executor.drain_imminent()
+                    ):
+                        # A drain notice covers the group and a checkpoint
+                        # landed after it (the report round is the
+                        # barrier: every rank reached this step).  Restart
+                        # NOW, off the doomed node, from that checkpoint.
+                        proactive = True
+                        break
+                if proactive:
+                    drain_restarts += 1
+                    executor.shutdown()
+                    logger.warning(
+                        "drain notice: restarting worker group from the "
+                        "drain-triggered checkpoint (not counted against "
+                        "max_failures=%d)", max_failures,
+                    )
+                    continue
                 executor.shutdown()
                 return Result(
                     metrics=metrics_history[-1] if metrics_history else None,

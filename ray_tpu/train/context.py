@@ -48,6 +48,13 @@ class TrainContext:
     def get_storage(self):
         return _get_session().storage_dir
 
+    def drain_requested(self) -> bool:
+        """True once any node hosting this worker group received a drain
+        notice (preemption or scale-down).  Loops that poll this and
+        report a checkpoint at the next step boundary resume from that
+        step instead of the last periodic checkpoint."""
+        return _get_session().drain_requested()
+
 
 def get_context() -> TrainContext:
     return TrainContext()

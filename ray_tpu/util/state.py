@@ -30,11 +30,14 @@ def list_nodes() -> List[Dict[str, Any]]:
     return [
         {
             "node_id": NodeID(n["node_id"]).hex(),
-            "state": n["state"],
+            "state": n["state"],  # ALIVE | DRAINING | DEAD
             "is_head": n.get("is_head", False),
             "resources_total": n["resources_total"],
             "raylet_address": n["raylet_address"],
             "hostname": n.get("hostname", ""),
+            "drain_reason": n.get("drain_reason"),
+            "drain_deadline": n.get("drain_deadline", 0.0),
+            "drain_complete": n.get("drain_complete", False),
         }
         for n in info["nodes"].values()
     ]
@@ -80,7 +83,7 @@ def list_objects() -> List[Dict[str, Any]]:
     """Aggregate object-store stats over all raylets."""
     out = []
     for n in list_nodes():
-        if n["state"] != "ALIVE":
+        if n["state"] not in ("ALIVE", "DRAINING"):
             continue
         try:
             stats = _node_call(n["raylet_address"], "node_stats", {"include_objects": True})
@@ -95,7 +98,7 @@ def list_objects() -> List[Dict[str, Any]]:
 def list_workers() -> List[Dict[str, Any]]:
     out = []
     for n in list_nodes():
-        if n["state"] != "ALIVE":
+        if n["state"] not in ("ALIVE", "DRAINING"):
             continue
         try:
             stats = _node_call(n["raylet_address"], "node_stats", {})

@@ -149,8 +149,31 @@ class SpanHandle:
         self.span_id = span_id
 
 
+def _sampled(trace_id: Optional[str]) -> bool:
+    """Head sampling, deterministic in the trace id: every process keeps
+    or drops the SAME traces, so sampled trees stay whole across hops.
+    Spans with no trace id (shouldn't happen) are kept."""
+    from ray_tpu._private.config import CONFIG
+
+    try:
+        rate = float(CONFIG.span_sample_rate)
+    except Exception:
+        return True
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0 or not trace_id:
+        return rate > 0.0
+    try:
+        bucket = int(trace_id[:8], 16) / float(0xFFFFFFFF)
+    except ValueError:
+        return True
+    return bucket < rate
+
+
 def _record_span(span: Dict[str, Any]) -> None:
     global _flushed_upto, _trim_total
+    if not _sampled(span.get("trace_id")):
+        return
     span.setdefault("tid", threading.get_ident())
     with _span_lock:
         _finished_spans.append(span)
@@ -207,13 +230,24 @@ def flush() -> bool:
     channel so raylet/GCS processes export too).  Local consumers are
     unaffected: spans stay drainable until drain_spans() pops them.
 
+    Each call ships at most CONFIG.span_flush_max_batch spans (ROADMAP
+    PR-2 follow-up): sustained load produces a bounded report frame per
+    interval instead of one unbounded ship-everything RPC; the remainder
+    goes on the next interval (or the next explicit flush call).
+
     Delivery is at-least-once: a reply lost after the GCS applied the
     batch leaves the cursor behind and the batch is re-sent — readers
     dedupe by span_id (state._dedupe_spans)."""
     global _flushed_upto
+    from ray_tpu._private.config import CONFIG
+
+    try:
+        max_batch = max(1, int(CONFIG.span_flush_max_batch))
+    except Exception:
+        max_batch = 2048
     with _span_lock:
-        pending = _finished_spans[_flushed_upto:]
-        mark = len(_finished_spans)
+        pending = _finished_spans[_flushed_upto : _flushed_upto + max_batch]
+        mark = _flushed_upto + len(pending)
         base_trim = _trim_total
         base_epoch = _drain_epoch
     if not pending:
@@ -227,7 +261,7 @@ def flush() -> bool:
                 # during the RPC so spans recorded mid-flight are not
                 # marked as shipped.
                 mark -= _trim_total - base_trim
-                _flushed_upto = max(_flushed_upto, min(mark, len(_finished_spans)))
+                _flushed_upto = max(_flushed_upto, min(max(0, mark), len(_finished_spans)))
             # else: a drain cleared the log mid-flight; cursor already 0
         return True
     return False
@@ -260,7 +294,14 @@ def _ensure_flusher() -> None:
 
 def _safe_flush():
     try:
-        flush()
+        # flush() ships one bounded batch per call; at exit, drain what
+        # remains (bounded — the ring holds at most _MAX_SPANS).
+        for _ in range(16):
+            flush()
+            with _span_lock:
+                done = _flushed_upto >= len(_finished_spans)
+            if done:
+                break
     except Exception:
         pass
 

@@ -22,4 +22,16 @@ if [ "${RAY_TPU_SKIP_OBS_SMOKE:-0}" != "1" ]; then
     [ "$rc" -eq 0 ] && rc=1
   fi
 fi
+
+# Drain smoke (graceful node drain end-to-end): 2-node local cluster,
+# drain a node hosting a live actor + sole-copy object, assert the actor
+# migrates, the object survives the kill, and util.state + /api/nodes
+# show DRAINING -> DEAD.  Skippable via RAY_TPU_SKIP_DRAIN_SMOKE=1.
+if [ "${RAY_TPU_SKIP_DRAIN_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/drain_smoke.py; then
+    echo "drain smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
 exit $rc

@@ -113,8 +113,18 @@ def test_v2_scales_up_for_tasks(ray_cluster):
             if len(ready) == len(refs):
                 break
         assert ray_tpu.get(refs, timeout=30) == ["ok"] * 3
+        # The instance state machine is eventually consistent: the tasks
+        # can finish inside the same tick that launched the node, before
+        # a later update() observes the registration and flips
+        # ALLOCATED -> RAY_RUNNING.  Keep reconciling until it converges.
+        while (
+            time.monotonic() < deadline
+            and scaler.status()["counts"].get("RAY_RUNNING", 0) < 1
+        ):
+            scaler.update()
+            time.sleep(0.2)
         counts = scaler.status()["counts"]
-        assert counts.get("RAY_RUNNING", 0) >= 1
+        assert counts.get("RAY_RUNNING", 0) >= 1, counts
         for a in actors:
             ray_tpu.kill(a)
     finally:
@@ -326,6 +336,15 @@ def test_v2_drives_tpu_slice_provider(ray_cluster):
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=1)
             done = bool(ready)
         assert ray_tpu.get(ref, timeout=30) == "v2-on-slice"
+        # Same eventual-consistency as test_v2_scales_up_for_tasks: the
+        # task can land inside the launching tick; reconcile until the
+        # instance is observed RAY_RUNNING.
+        while (
+            time.monotonic() < deadline
+            and scaler.status()["counts"].get("RAY_RUNNING", 0) < 1
+        ):
+            scaler.update()
+            time.sleep(0.2)
         counts = scaler.status()["counts"]
         assert counts.get("RAY_RUNNING", 0) >= 1, counts
         assert len(client.list()) >= 1

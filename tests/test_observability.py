@@ -381,3 +381,68 @@ def test_telemetry_kill_switch():
         CONFIG._overrides.pop("telemetry_enabled", None)
         telemetry.refresh()
         assert telemetry.enabled() is True
+
+
+def test_span_flush_batch_cap():
+    """Each flush() ships at most span_flush_max_batch spans (ROADMAP
+    PR-2 follow-up: bounded report frames under sustained load); the
+    remainder goes out on subsequent flushes."""
+    from ray_tpu._private.config import CONFIG
+
+    tracing.drain_spans()  # clean slate
+    shipped_batches = []
+
+    orig_report = metrics_mod.report
+
+    def capture(method, payload):
+        if method == "span_report":
+            shipped_batches.append(len(payload["spans"]))
+            return True
+        return orig_report(method, payload)
+
+    CONFIG._overrides["span_flush_max_batch"] = 10
+    metrics_mod.report, orig = capture, metrics_mod.report
+    try:
+        for i in range(25):
+            with tracing.start_span(f"cap-span-{i}"):
+                pass
+        for _ in range(5):
+            tracing.flush()
+        assert shipped_batches, "flush never shipped"
+        assert max(shipped_batches) <= 10, shipped_batches
+        assert sum(shipped_batches) >= 25  # everything eventually ships
+    finally:
+        metrics_mod.report = orig
+        CONFIG._overrides.pop("span_flush_max_batch", None)
+        tracing.drain_spans()
+
+
+def test_span_head_sampling_deterministic():
+    """span_sample_rate head-samples whole traces at record time,
+    deterministically in the trace id: rate 0 records nothing, rate 1
+    records everything, and the keep/drop verdict for one trace id is
+    stable (so multi-process trees stay whole)."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.util.tracing import _sampled
+
+    tracing.drain_spans()
+    CONFIG._overrides["span_sample_rate"] = 0.0
+    try:
+        with tracing.start_span("never-kept"):
+            pass
+        assert tracing.drain_spans() == []
+        CONFIG._overrides["span_sample_rate"] = 1.0
+        with tracing.start_span("always-kept"):
+            pass
+        assert [s["name"] for s in tracing.drain_spans()] == ["always-kept"]
+        # Determinism of the per-trace verdict at a partial rate.
+        CONFIG._overrides["span_sample_rate"] = 0.5
+        # Sampling keys off the FIRST 8 hex chars of the trace id.
+        ids = [f"{i:08x}" + "0" * 24 for i in range(0, 2**32, 2**28)]
+        v1 = [_sampled(t) for t in ids]
+        v2 = [_sampled(t) for t in ids]
+        assert v1 == v2
+        assert any(v1) and not all(v1)  # rate actually partitions
+    finally:
+        CONFIG._overrides.pop("span_sample_rate", None)
+        tracing.drain_spans()
