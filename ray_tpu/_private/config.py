@@ -156,6 +156,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # Poll period of the GCS drain task waiting for actor migration and
     # object re-replication to finish.
     "drain_poll_ms": 100,
+    # How long a preempted node's lost-capacity record stays in the
+    # autoscaler feed.  Consumption is tracked per-autoscaler in memory,
+    # so the TTL bounds duplicate replacement launches after an
+    # autoscaler restart to entries younger than this.
+    "lost_capacity_ttl_s": 600.0,
     # --- gcs ---
     # "file": periodically snapshot GCS state (actors/PGs/KV/jobs) to the
     # session dir so a restarted GCS resumes the cluster (reference: redis
@@ -175,6 +180,24 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "maximum_gcs_dead_node_cache": 100,
     # --- collectives ---
     "collective_chunk_bytes": 16 * 1024**2,
+    # Rendezvous deadline budget for collective group formation: how long
+    # a member polls the GCS KV for its peers before raising a typed
+    # RendezvousTimeoutError naming the missing ranks.
+    "collective_rendezvous_timeout_s": 60.0,
+    # --- elastic training ---
+    # How long the elastic backend executor waits for a replacement
+    # worker lease before concluding capacity has NOT returned and
+    # continuing at the current (shrunken) size.
+    "elastic_grow_lease_timeout_s": 15.0,
+    # Minimum seconds between grow attempts (each failed attempt costs a
+    # lease timeout; don't spin on a capacity-starved cluster).
+    "elastic_grow_backoff_s": 5.0,
+    # Shared liveness-ping budget when partitioning survivors from
+    # casualties at shrink time.  Must exceed one train step: a survivor
+    # whose actor is busy finishing an abandoned next_report only answers
+    # the ping at its next report boundary — a too-small budget
+    # misclassifies slow-but-alive ranks as casualties.
+    "elastic_ping_timeout_s": 60.0,
     # --- logging ---
     "log_to_driver": True,
     # Worker-log tail period for the per-node log monitor.

@@ -92,6 +92,13 @@ class GcsServer:
         # util.state.timeline() and the dashboard /api/timeline.
         self.spans: "deque" = deque(maxlen=int(CONFIG.span_buffer_size))
         self.pending_shapes: Dict[NodeID, list] = {}  # autoscaler demand
+        # Capacity-return signal: preempted nodes whose resources the
+        # autoscaler should replace even when no task demand is pending
+        # (an elastic trainer running shrunken generates none — it adapts
+        # instead of queueing).  Each entry is consumed once per
+        # autoscaler via its node_id key (get_load_metrics exposes it);
+        # entries expire after lost_capacity_ttl_s.
+        self.lost_capacity: "deque" = deque(maxlen=256)
 
         self.server.on_disconnect = self._on_disconnect
         self._bg_tasks: List[asyncio.Task] = []
@@ -444,6 +451,27 @@ class GcsServer:
             return
         info.state = "DEAD"
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        if info.drain_reason == "PREEMPTION" and not info.is_head:
+            # Preempted capacity: the cluster WANTS this back.  Surface it
+            # to the autoscaler so a replacement launches even when no
+            # task demand is pending (an elastic trainer running shrunken
+            # queues nothing — it adapted instead of stalling).
+            if len(self.lost_capacity) == self.lost_capacity.maxlen:
+                evicted = self.lost_capacity[0]
+                logger.warning(
+                    "lost_capacity log full (%d): dropping record for "
+                    "preempted node %s — its replacement will NOT be "
+                    "auto-launched", self.lost_capacity.maxlen,
+                    evicted.get("node_id", "?")[:8],
+                )
+            self.lost_capacity.append(
+                {
+                    "node_id": node_id.hex(),
+                    "resources_total": dict(info.resources_total),
+                    "reason": info.drain_reason,
+                    "time": time.time(),
+                }
+            )
         self.available.pop(node_id, None)
         self.pending_shapes.pop(node_id, None)
         client = self.node_clients.pop(node_id, None)
@@ -510,6 +538,22 @@ class GcsServer:
             except Exception:
                 pass
         self.publish("nodes", ("DRAINING", self._node_dict(info)))
+        # CREATED placement groups with a bundle on the doomed node are
+        # rescheduled AHEAD of the kill (the reactive path at node death
+        # still covers notice-less losses).  Only the AFFECTED bundles
+        # move: bundles on healthy nodes keep their reservations and the
+        # actors running in them.
+        for pg in self.placement_groups.values():
+            if pg.state == "CREATED" and any(
+                b.node_id == node_id for b in pg.bundles
+            ):
+                logger.info(
+                    "PG %s has bundle(s) on draining node %s: rescheduling "
+                    "them ahead of the kill", pg.pg_id.hex()[:8], node_id.hex()[:8],
+                )
+                self.loop.create_task(
+                    self._reschedule_pg_bundles(pg, node_id)
+                )
         self.loop.create_task(self._drain_node_task(info))
         return {"accepted": True, "state": "DRAINING"}
 
@@ -735,6 +779,22 @@ class GcsServer:
         ns, keys = payload
         table = self.kv.get(ns, {})
         return {k: table[k] for k in keys if k in table}
+
+    async def rpc_kv_put_max(self, payload, conn):
+        """Monotonic integer cell: store max(current, value) and return
+        the stored value.  Atomic (single handler on the GCS loop) — the
+        collective generation marker uses this so a stale joiner's write
+        can never regress a newer generation bump."""
+        ns, key, value = payload
+        table = self.kv[ns]
+        try:
+            cur = int(table.get(key, b"").decode() or -1)
+        except ValueError:
+            cur = -1
+        new = max(cur, int(value))
+        table[key] = str(new).encode()
+        self._dirty()
+        return new
 
     async def rpc_kv_del(self, payload, conn):
         ns, key = payload
@@ -1229,6 +1289,172 @@ class GcsServer:
         self.publish("placement_groups", {"pg_id": pg.pg_id.binary(), "state": "CREATED"})
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": "CREATED"})
 
+    async def _reschedule_pg_bundles(self, pg: PlacementGroupInfo,
+                                     from_node: NodeID):
+        """Drain-ahead partial reschedule: move ONLY the bundles sitting
+        on `from_node` to live nodes, two-phase, while unaffected bundles
+        (and the actors running in them) stay put.  On any failure the
+        group returns to CREATED with its old placement — the reactive
+        whole-group reschedule at node death remains the fallback."""
+        if pg.state != "CREATED":
+            return
+        affected = [i for i, b in enumerate(pg.bundles) if b.node_id == from_node]
+        if not affected:
+            return
+        pg.state = "RESCHEDULING"
+        avail = {
+            n: rs.copy()
+            for n, rs in self.available.items()
+            if self.nodes[n].state == "ALIVE"
+        }
+        used = {
+            b.node_id for b in pg.bundles
+            if b.node_id is not None and b.node_id != from_node
+        }
+        prepared: List[Tuple[NodeID, int]] = []
+        ok = True
+        pack_node: Optional[NodeID] = None  # STRICT_PACK co-location target
+        for idx in affected:
+            res = pg.bundles[idx].resources
+            cands = sorted(avail, key=lambda n: -sum(avail[n].values()))
+            if pg.strategy == "STRICT_SPREAD":
+                cands = [n for n in cands if n not in used]
+            elif pg.strategy == "STRICT_PACK":
+                # All bundles of a STRICT_PACK group are co-located (so a
+                # drain affects all of them): every move must land on ONE
+                # node or the co-location contract silently breaks.  No
+                # single node fits -> fail into the reactive fallback,
+                # which re-places the whole group strategy-aware.
+                cands = [pack_node] if pack_node is not None else cands
+            placed = None
+            for n in cands:
+                if not res.fits_in(avail[n]):
+                    continue
+                client = self.node_clients.get(n)
+                if client is None:
+                    continue
+                try:
+                    r = await client.call(
+                        "prepare_bundle",
+                        {"pg_id": pg.pg_id.binary(), "bundle_index": idx,
+                         "resources": dict(res)},
+                    )
+                except Exception:
+                    continue
+                if r:
+                    placed = n
+                    prepared.append((n, idx))
+                    avail[n].subtract(res)
+                    used.add(n)
+                    if pg.strategy == "STRICT_PACK":
+                        pack_node = n
+                    break
+            if placed is None:
+                ok = False
+                break
+        if not ok or pg.state == "REMOVED":
+            # Return the new reservations, KEEP the old placement (the
+            # affected bundles still sit on the draining node until its
+            # death triggers the reactive path).
+            for n, idx in prepared:
+                client = self.node_clients.get(n)
+                if client:
+                    try:
+                        await client.call(
+                            "return_bundle",
+                            {"pg_id": pg.pg_id.binary(), "bundle_index": idx},
+                        )
+                    except Exception:
+                        pass
+            if pg.state != "REMOVED":
+                pg.state = "CREATED"
+                logger.info(
+                    "PG %s drain-ahead reschedule found no placement; "
+                    "falling back to reschedule at node death",
+                    pg.pg_id.hex()[:8],
+                )
+                self._reschedule_if_node_dead(pg, from_node)
+            return
+        # Commit the moves; free the doomed reservations best-effort (the
+        # draining raylet still accepts return_bundle).
+        old_client = self.node_clients.get(from_node)
+        committed: set = set()
+        for n, idx in prepared:
+            client = self.node_clients.get(n)
+            try:
+                if client is None:
+                    raise rpc.RpcError(f"node {n.hex()[:8]} vanished before commit")
+                await client.call(
+                    "commit_bundle",
+                    {"pg_id": pg.pg_id.binary(), "bundle_index": idx},
+                )
+            except Exception:
+                # Same posture as the prepare failure: return the not-yet-
+                # committed reservations — INCLUDING the one whose commit
+                # just failed (return_bundle is idempotent; if the commit
+                # actually applied and only the reply was lost, this frees
+                # it rather than leaking a reservation forever) — keep
+                # what already moved, and let node death redo the rest
+                # reactively.
+                logger.exception(
+                    "PG %s drain-ahead commit failed; deferring to the "
+                    "reactive path", pg.pg_id.hex()[:8],
+                )
+                for n2, idx2 in prepared:
+                    if idx2 in committed:
+                        continue
+                    c2 = self.node_clients.get(n2)
+                    if c2:
+                        try:
+                            await c2.call(
+                                "return_bundle",
+                                {"pg_id": pg.pg_id.binary(), "bundle_index": idx2},
+                            )
+                        except Exception:
+                            pass
+                if pg.state != "REMOVED":
+                    pg.state = "CREATED"
+                    self._reschedule_if_node_dead(pg, from_node)
+                return
+            committed.add(idx)
+            pg.bundles[idx].node_id = n
+            if old_client is not None:
+                try:
+                    await old_client.call(
+                        "return_bundle",
+                        {"pg_id": pg.pg_id.binary(), "bundle_index": idx},
+                    )
+                except Exception:
+                    pass
+        if pg.state == "REMOVED":
+            await self._rollback_bundles(pg, prepared)
+            return
+        pg.state = "CREATED"
+        self._signal_pg(pg.pg_id)
+        self.publish("placement_groups", {"pg_id": pg.pg_id.binary(), "state": "CREATED"})
+        self.publish(f"pg:{pg.pg_id.hex()}", {"state": "CREATED"})
+        logger.info(
+            "PG %s: %d bundle(s) moved off draining node %s pre-kill",
+            pg.pg_id.hex()[:8], len(prepared), from_node.hex()[:8],
+        )
+
+    def _reschedule_if_node_dead(self, pg: PlacementGroupInfo, node_id: NodeID):
+        """Drain-ahead fallback closing a race: the draining node died
+        WHILE the partial move was in flight.  _mark_node_dead's reactive
+        sweep only matches CREATED groups, so a group restored to CREATED
+        here with bundles still on the now-dead node would be wedged
+        forever — re-trigger the full reschedule ourselves."""
+        info = self.nodes.get(node_id)
+        if (info is None or info.state == "DEAD") and any(
+            b.node_id == node_id for b in pg.bundles
+        ):
+            logger.info(
+                "PG %s: draining node %s died mid-move; rescheduling "
+                "reactively", pg.pg_id.hex()[:8], node_id.hex()[:8],
+            )
+            pg.state = "RESCHEDULING"
+            self.loop.create_task(self._schedule_pg(pg))
+
     def _pg_event(self, pg_id: PlacementGroupID) -> asyncio.Event:
         return self._pg_events.setdefault(pg_id, asyncio.Event())
 
@@ -1354,7 +1580,18 @@ class GcsServer:
                 "state": info.state,
                 "drain_complete": info.drain_complete,
             }
-        return {"pending_demands": demands, "nodes": nodes}
+        # Expire stale lost-capacity records: the consumed-once set lives
+        # in the autoscaler process, so without a TTL an autoscaler
+        # restart would replay every retained entry as a fresh launch.
+        ttl = float(CONFIG.lost_capacity_ttl_s)
+        now = time.time()
+        while self.lost_capacity and now - self.lost_capacity[0]["time"] > ttl:
+            self.lost_capacity.popleft()
+        return {
+            "pending_demands": demands,
+            "nodes": nodes,
+            "lost_capacity": list(self.lost_capacity),
+        }
 
     # ------------------------------------------------------------------
     # observability (reference: gcs_task_manager.h:86, metric export

@@ -194,3 +194,11 @@ GCS_READ = RetryPolicy(base_s=0.1, cap_s=1.0, max_attempts=4, name="gcs_read")
 # runtime_env packages): one retry only, so the worst case stays near the
 # pre-retry budget instead of quadrupling it.
 GCS_READ_BULK = RetryPolicy(base_s=0.25, cap_s=1.0, max_attempts=2, name="gcs_read_bulk")
+
+# Collective-group rendezvous polls against the GCS KV (cpu_group).
+# Latency-critical like POLL (every group member blocks on it at
+# formation and elastic re-formation), but capped a little higher since
+# a straggler rank may be a whole actor restart away.  The deadline
+# budget comes from the caller (collective_rendezvous_timeout_s or the
+# init_collective_group timeout).
+RENDEZVOUS = RetryPolicy(base_s=0.02, cap_s=0.25, name="rendezvous")

@@ -113,6 +113,19 @@ class _Metrics:
             "objects are re-replicated off the draining node",
             boundaries=[0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0],
         )
+        self.train_resize_events = m.Counter(
+            "train_resize_events_total",
+            "elastic worker-group resizes, by direction (shrink, grow) and "
+            "trigger (drain, worker_death, capacity_return)",
+            tag_keys=("direction", "trigger"),
+        )
+        self.train_resize = m.Histogram(
+            "train_resize_seconds",
+            "wall time of one elastic resize: teardown of affected ranks, "
+            "generation-bumped re-rendezvous, session restart",
+            boundaries=[0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0],
+            tag_keys=("direction",),
+        )
 
 
 def _metrics() -> _Metrics:
@@ -224,6 +237,27 @@ def observe_train_step(rank: int, seconds: float) -> None:
 
 
 _drain_bound: dict = {}
+_resize_bound: dict = {}
+_resize_hist_bound: dict = {}
+
+
+def count_resize_event(direction: str, trigger: str) -> None:
+    if not enabled():
+        return
+    b = _resize_bound.get((direction, trigger)) or _bind(
+        _resize_bound, (direction, trigger), "train_resize_events",
+        {"direction": direction, "trigger": trigger},
+    )
+    b.inc(1.0)
+
+
+def observe_resize(direction: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _resize_hist_bound.get(direction) or _bind(
+        _resize_hist_bound, direction, "train_resize", {"direction": direction}
+    )
+    b.observe(max(0.0, seconds))
 
 
 def count_drain_event(reason: str) -> None:

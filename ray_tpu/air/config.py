@@ -20,6 +20,31 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     # TPU topology, e.g. "v5litepod-16": one worker per host in the slice.
     topology: Optional[str] = None
+    # Elastic training floor: when set (and < num_workers), the trainer
+    # treats world size as dynamic — a preempted/dead rank shrinks the
+    # group to the largest healthy size >= min_workers (checkpoint,
+    # re-rendezvous, resume; NOT charged to FailureConfig.max_failures),
+    # and the group grows back toward num_workers at the next epoch
+    # boundary once capacity returns.  None = fixed-size (the classic
+    # whole-group-restart recovery).
+    min_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_workers is not None:
+            if self.min_workers < 1:
+                raise ValueError(
+                    f"ScalingConfig.min_workers must be >= 1, got {self.min_workers}"
+                )
+            if self.min_workers > self.num_workers:
+                raise ValueError(
+                    f"ScalingConfig.min_workers ({self.min_workers}) cannot "
+                    f"exceed num_workers ({self.num_workers})"
+                )
+
+    @property
+    def elastic(self) -> bool:
+        """True when the group may run below num_workers (min_workers set)."""
+        return self.min_workers is not None and self.min_workers < self.num_workers
 
     def _worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -17,9 +17,77 @@ from ray_tpu.autoscaler.node_provider import (
     TAG_NODE_TYPE,
     NodeProvider,
 )
-from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+from ray_tpu.autoscaler.resource_demand_scheduler import _fits, get_nodes_to_launch
 
 logger = logging.getLogger(__name__)
+
+
+def pick_replacement_type(node_types: Dict[str, dict],
+                          lost_resources: Dict[str, float]) -> Optional[str]:
+    """Smallest node type that covers a preempted node's resources — the
+    capacity-return launch shape (shared by the v1 and v2 autoscalers).
+
+    Only resource kinds DECLARED by some node type participate in the
+    fit: a registered node's resources_total carries auto-detected extras
+    (``memory`` from sysconf, per-node markers) that no provider spec
+    ever declares — matching against the raw total would make every
+    lost node infeasible and the feature silently inert.  Types carrying
+    resource kinds the lost node did not have (e.g. a TPU slice covering
+    a plain-CPU loss) rank behind exact-kind covers."""
+    managed: set = set()
+    for spec in node_types.values():
+        managed |= set(spec.get("resources", {}))
+    lost_managed = {
+        k: v for k, v in lost_resources.items() if v > 0 and k in managed
+    }
+    if not lost_managed:
+        return None
+    lost = set(lost_managed)
+    candidates = [
+        (
+            len(set(spec.get("resources", {})) - lost),  # foreign kinds
+            sum(spec.get("resources", {}).values()),
+            name,
+        )
+        for name, spec in node_types.items()
+        if _fits(lost_managed, dict(spec.get("resources", {})))
+    ]
+    if not candidates:
+        return None
+    return min(candidates)[2]
+
+
+def replacement_launches(node_types: Dict[str, dict], lost_capacity,
+                         processed: set, budget: int) -> List[Tuple[str, str]]:
+    """Shared v1/v2 capacity-return decision: which node types to launch
+    for not-yet-processed preempted nodes, within `budget`.  Marks
+    entries processed (including infeasible ones — there is no type that
+    will ever cover them); entries skipped only for budget stay
+    unprocessed and retry next tick.  Returns [(lost_node_id, type)]."""
+    out: List[Tuple[str, str]] = []
+    # Full-feed id set BEFORE the loop: the budget break below exits the
+    # iteration early, and pruning `processed` against a partial prefix
+    # would forget already-replaced ids past the break point (→ duplicate
+    # launches once the budget frees up).
+    feed_ids = {entry.get("node_id") for entry in lost_capacity}
+    for entry in lost_capacity:
+        lost_id = entry.get("node_id")
+        if lost_id in processed:
+            continue
+        if budget - len(out) <= 0:
+            break
+        node_type = pick_replacement_type(
+            node_types, entry.get("resources_total", {})
+        )
+        processed.add(lost_id)
+        if node_type is None:
+            continue
+        out.append((lost_id, node_type))
+    # The consumed-once memory only needs to cover entries still in the
+    # feed (the GCS TTL-prunes it); dropping aged-out ids keeps the set
+    # bounded over a long-lived autoscaler on a churning fleet.
+    processed &= feed_ids
+    return out
 
 
 def request_node_drain(gcs_client, node_hex: Optional[str]) -> Optional[float]:
@@ -76,9 +144,13 @@ class StandardAutoscaler:
         # (or the node dies / the deadline passes).
         self._draining: Dict[str, float] = {}
         self._warned_no_mapping = False
+        # Preempted-node ids whose replacement launch was already issued
+        # (the GCS lost_capacity feed is a bounded log; process each once).
+        self._lost_processed: set = set()
         self.num_launches = 0
         self.num_terminations = 0
         self.num_drains = 0
+        self.num_capacity_returns = 0
 
     # -- one reconcile pass ---------------------------------------------
     def update(self, load_metrics: Optional[dict] = None):
@@ -131,6 +203,41 @@ class StandardAutoscaler:
             now = time.monotonic()
             self._booting.extend((node_type, now) for _ in range(count))
             self.num_launches += count
+
+        # Capacity return: a PREEMPTED node's resources are relaunched
+        # even with no pending demand — an elastic trainer that shrank
+        # through the preemption queues nothing, but wants its chips
+        # back.  The replacement's ALIVE registration is the grow signal
+        # train-side.  One launch per lost node, budget permitting.
+        for lost_id, node_type in replacement_launches(
+            self.node_types, load_metrics.get("lost_capacity", ()),
+            self._lost_processed, budget,
+        ):
+            budget -= 1
+            logger.info(
+                "autoscaler: relaunching 1 x %s to replace preempted node %s",
+                node_type, lost_id[:8],
+            )
+            try:
+                self.provider.create_node(
+                    self.node_types[node_type].get(
+                        "node_config",
+                        {"resources": self.node_types[node_type].get("resources", {})},
+                    ),
+                    {TAG_NODE_KIND: "worker", TAG_NODE_TYPE: node_type},
+                    1,
+                )
+            except Exception:
+                # Transient provider failure (the native weather of a
+                # preemptible fleet): unmark so the next tick retries
+                # instead of dropping the replacement forever.
+                logger.exception("capacity-return launch of %s failed", node_type)
+                self._lost_processed.discard(lost_id)
+                budget += 1
+                continue
+            self._booting.append((node_type, time.monotonic()))
+            self.num_launches += 1
+            self.num_capacity_returns += 1
 
         # finalize in-flight drains: terminate once the GCS reports the
         # migration complete (or the node died / the deadline passed)

@@ -16,7 +16,7 @@ import logging
 import time
 from typing import Dict, Optional
 
-from ray_tpu.autoscaler.autoscaler import request_node_drain
+from ray_tpu.autoscaler.autoscaler import replacement_launches, request_node_drain
 from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
 from ray_tpu.autoscaler.v2.instance_manager import InstanceManager
 from ray_tpu.autoscaler.v2.sdk import get_cluster_resource_constraints
@@ -43,6 +43,9 @@ class AutoscalerV2:
         # instance_id -> monotonic terminate-by time while the GCS drains
         # the node (graceful scale-down: drain, then queue_terminate).
         self._draining: Dict[str, float] = {}
+        # Preempted-node ids already replaced (lost_capacity is a log).
+        self._lost_processed: set = set()
+        self.num_capacity_returns = 0
 
     def update(self, load_metrics: Optional[dict] = None):
         if load_metrics is None:
@@ -90,6 +93,22 @@ class AutoscalerV2:
                 budget -= count
                 logger.info("autoscaler_v2: queueing %d x %s", count, node_type)
                 self.im.queue_launch(node_type, count)
+
+        # Capacity return: relaunch a PREEMPTED node's resources even with
+        # no pending demand (an elastic trainer that shrank through the
+        # preemption queues nothing — the replacement's ALIVE registration
+        # is its grow signal).  One queue_launch per lost node.
+        for lost_id, node_type in replacement_launches(
+            self.node_types, load_metrics.get("lost_capacity", ()),
+            self._lost_processed, budget,
+        ):
+            budget -= 1
+            logger.info(
+                "autoscaler_v2: relaunching 1 x %s to replace preempted %s",
+                node_type, lost_id[:8],
+            )
+            self.im.queue_launch(node_type, 1)
+            self.num_capacity_returns += 1
 
         # Finalize in-flight drains: queue the terminate once the GCS
         # reports migration complete (or the node died / deadline passed).

@@ -10,12 +10,54 @@ exposition text (HELP/TYPE + samples) and emits one timeseries panel
 per metric family — counters as rate() queries, gauges raw, histograms
 as p50/p95/p99 quantile queries over the _bucket series.  The
 datasource is the ``${datasource}`` template variable, so the JSON
-imports into any Grafana with a Prometheus source."""
+imports into any Grafana with a Prometheus source.
+
+The training/robustness panels are NOT purely derived: a curated
+builtin family list (train step time, drain events/migration, elastic
+resize events/duration, chaos injections) is merged in so those panels
+exist out of the box — a dashboard generated before the first drain or
+resize still has the panel the on-call will stare at during one."""
 
 from __future__ import annotations
 
 import json
 from typing import Dict, List, Optional, Tuple
+
+# Always-present panels (name, type, help).  A live exposition of the
+# same family wins (identical shape), but its ABSENCE — metrics only
+# exist after their first event — must not drop the panel.
+_BUILTIN_FAMILIES: List[Tuple[str, str, str]] = [
+    (
+        "train_step_seconds",
+        "histogram",
+        "wall time between consecutive train.report calls per rank",
+    ),
+    (
+        "train_resize_events_total",
+        "counter",
+        "elastic worker-group resizes, by direction (shrink, grow) and trigger",
+    ),
+    (
+        "train_resize_seconds",
+        "histogram",
+        "wall time of one elastic resize (teardown, re-rendezvous, session restart)",
+    ),
+    (
+        "drain_events_total",
+        "counter",
+        "node drains initiated, by reason (PREEMPTION, IDLE_TERMINATION)",
+    ),
+    (
+        "drain_migration_seconds",
+        "histogram",
+        "time from drain start until actors and sole-copy objects are off the node",
+    ),
+    (
+        "chaos_injections_total",
+        "counter",
+        "fault injections fired by the chaos plane",
+    ),
+]
 
 
 def _parse_families(metrics_text: str) -> List[Tuple[str, str, str]]:
@@ -84,6 +126,8 @@ def generate_grafana_dashboard(
 ) -> dict:
     """Exposition text → importable Grafana dashboard JSON model."""
     families = _parse_families(metrics_text)
+    seen = {name for name, _t, _h in families}
+    families += [f for f in _BUILTIN_FAMILIES if f[0] not in seen]
     panels = []
     for i, (name, mtype, help_) in enumerate(families):
         panels.append(

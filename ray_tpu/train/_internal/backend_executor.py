@@ -1,6 +1,18 @@
 """BackendExecutor: owns the worker group and the training lifecycle
 (reference: python/ray/train/_internal/backend_executor.py:68 — start
-:135, start_training :451, get_next_results :578)."""
+:135, start_training :451, get_next_results :578).
+
+Elastic mode (ScalingConfig.min_workers): the worker group is a dynamic
+quantity.  A drain notice or worker death shrinks the group to the
+largest healthy size >= min_workers — only the affected ranks are torn
+down, survivors keep their actors — and the group re-forms under a
+bumped **generation**: sessions restart with the new world size, the
+run's collective-group namespace is invalidated so old-generation
+stragglers get GroupInvalidatedError instead of hanging, and training
+resumes from the latest checkpoint.  When capacity returns (a node
+registers ALIVE), the next epoch boundary grows the group back toward
+num_workers the same way.
+"""
 
 from __future__ import annotations
 
@@ -43,17 +55,57 @@ class BackendExecutor:
         self._ranks_meta: List[dict] = []
         self.storage_dir = os.path.join(run_config.resolved_storage_path(), experiment_name)
         os.makedirs(self.storage_dir, exist_ok=True)
-        # Drain plane: set when any node hosting a rank enters DRAINING
-        # (preemption notice / scale-down).  The trainer reads
-        # drain_imminent() and restarts the group from a drain-triggered
-        # checkpoint instead of discovering the death mid-collective.
-        self._drain_event = threading.Event()
+        # Elastic resize epoch: 0 at formation, +1 per shrink/grow.  Also
+        # the rendezvous generation of the run's collective namespace.
+        self.generation = 0
+        self.elastic = bool(getattr(scaling_config, "elastic", False))
+        self.collective_group_name = f"train/{experiment_name}"
+        # Training state needed to restart sessions across resizes.
+        self._train_fn: Optional[Callable[[], None]] = None
+        self._dataset_shards_fn: Optional[Callable[[int], Optional[List[dict]]]] = None
+        # Drain plane: nodes that received a drain notice while hosting a
+        # rank (preemption / scale-down).  The trainer reads
+        # drain_imminent() and either shrinks (elastic) or restarts the
+        # group from a drain-triggered checkpoint.
         self._drained_nodes: set = set()
+        self._rank_nodes: set = set()
+        # Capacity-return plane: set when a node registers ALIVE while the
+        # group runs below num_workers; consumed by try_grow().
+        self._capacity_event = threading.Event()
+        self._next_grow_attempt = 0.0
+        # Consecutive failed grow attempts: each one stalls the report
+        # loop for the lease timeout, so the retry backoff escalates
+        # (reset by a FRESH ALIVE signal or a successful grow).
+        self._grow_failures = 0
         self._node_listener = None
 
     def start(self):
+        # A fresh executor over a namespace a PREVIOUS incarnation used
+        # (whole-group restart after a refused shrink, a re-run against
+        # the same cluster) must bump PAST that generation, not join it:
+        # the old generation's rendezvous keys still hold the dead
+        # incarnation's addresses, and stragglers of the old world should
+        # fail typed.  invalidate_collective_group also reaps the stale
+        # keys.  A virgin namespace (no marker) starts at generation 0.
+        try:
+            from ray_tpu.util import collective
+
+            cur = collective.get_collective_group_generation(
+                self.collective_group_name
+            )
+            if cur is not None:
+                # Auto-increment form: atomic under concurrent bumps
+                # (kv_put_max), never raises on a raced marker.
+                self.generation = collective.invalidate_collective_group(
+                    self.collective_group_name
+                )
+        except Exception:
+            pass
         pg = None
-        if self.scaling.num_workers > 1 or self.scaling.use_tpu:
+        # Elastic groups lease workers individually: a fixed-size
+        # placement group would couple every rank's fate to one atomic
+        # reservation, exactly what shrink-through-preemption must avoid.
+        if not self.elastic and (self.scaling.num_workers > 1 or self.scaling.use_tpu):
             pg = self.scaling.as_placement_group_factory()()
             if not pg.wait(timeout_seconds=120):
                 raise TimeoutError(
@@ -63,36 +115,69 @@ class BackendExecutor:
         self.worker_group = WorkerGroup(
             self.scaling.num_workers, self.scaling._worker_resources(), placement_group=pg
         )
-        self._ranks_meta = self.worker_group.metadata()
+        if self.elastic:
+            # Bounded formation (the PG path's 120 s equivalent): start at
+            # the largest healthy size — workers that can't lease within
+            # the window are dropped, provided min_workers still form.
+            alive = self.worker_group.alive_ranks(timeout=120.0)
+            if len(alive) < self.scaling.num_workers:
+                min_workers = self.scaling.min_workers or self.scaling.num_workers
+                if len(alive) < min_workers:
+                    raise TimeoutError(
+                        f"only {len(alive)}/{self.scaling.num_workers} elastic "
+                        f"training workers became ready after 120s "
+                        f"(min_workers={min_workers})"
+                    )
+                pending = [
+                    r for r in range(self.scaling.num_workers) if r not in alive
+                ]
+                logger.warning(
+                    "elastic formation: starting at %d/%d workers (%d lease(s) "
+                    "not granted in time)", len(alive),
+                    self.scaling.num_workers, len(pending),
+                )
+                self.worker_group.remove_ranks(pending)
+        self._refresh_meta()
         self.backend.on_start(self.worker_group, self.backend_config)
-        self._watch_drain_events()
+        self._watch_node_events()
 
-    def _watch_drain_events(self):
+    def _refresh_meta(self):
+        self._ranks_meta = self.worker_group.metadata()
+        self._rank_nodes = {m["node_id"] for m in self._ranks_meta}
+
+    def _watch_node_events(self):
         from ray_tpu._private.worker import get_global_worker
 
-        rank_nodes = {m["node_id"] for m in self._ranks_meta}
-        group = self.worker_group
-
         def on_node_event(state, node):
-            if state != "DRAINING":
-                return
             try:
                 node_hex = node["node_id"].hex() if isinstance(
                     node.get("node_id"), bytes
                 ) else str(node.get("node_id"))
             except Exception:
                 return
-            if node_hex not in rank_nodes or node_hex in self._drained_nodes:
+            if state == "ALIVE":
+                # Capacity returned: a new node registered.  Only relevant
+                # while an elastic group runs shrunken.  A fresh signal
+                # resets the grow backoff — this node was not part of the
+                # previous failed attempts.
+                if self.elastic and self.worker_group is not None and (
+                    len(self.worker_group.workers) < self.scaling.num_workers
+                ):
+                    self._grow_failures = 0
+                    self._capacity_event.set()
+                return
+            if state != "DRAINING":
+                return
+            if node_hex not in self._rank_nodes or node_hex in self._drained_nodes:
                 return
             self._drained_nodes.add(node_hex)
             logger.warning(
                 "drain notice covers rank node %s: requesting immediate "
                 "checkpoint from all ranks", node_hex[:8],
             )
-            self._drain_event.set()
             # Best-effort: ask every rank's session for a checkpoint at
             # the next step boundary (fire-and-forget actor calls).
-            for w in list(group.workers):
+            for w in list(self.worker_group.workers):
                 try:
                     w.notify_drain.remote()
                 except Exception:
@@ -105,8 +190,22 @@ class BackendExecutor:
             self._node_listener = None
 
     def drain_imminent(self) -> bool:
-        """True once any node hosting a rank received a drain notice."""
-        return self._drain_event.is_set()
+        """True while any node hosting a CURRENT rank is draining (the
+        set shrinks when a resize removes the affected ranks)."""
+        return bool(self._drained_nodes & self._rank_nodes)
+
+    def grow_pending(self) -> bool:
+        """True when the group runs below num_workers and a capacity
+        signal arrived (node registered ALIVE) with the grow backoff
+        elapsed — the trainer calls try_grow() at the next epoch
+        boundary."""
+        return (
+            self.elastic
+            and self.worker_group is not None
+            and len(self.worker_group.workers) < self.scaling.num_workers
+            and self._capacity_event.is_set()
+            and time.monotonic() >= self._next_grow_attempt
+        )
 
     def _rank_info(self) -> List[dict]:
         """world/local/node ranks per worker, grouped by node (reference:
@@ -129,9 +228,16 @@ class BackendExecutor:
         return out
 
     def start_training(self, train_fn: Callable[[], None], resume_checkpoint=None,
-                       dataset_shards: Optional[List[Dict[str, Any]]] = None):
+                       dataset_shards_fn: Optional[Callable[[int], Optional[List[dict]]]] = None):
+        self._train_fn = train_fn
+        self._dataset_shards_fn = dataset_shards_fn
         self.backend.on_training_start(self.worker_group, self.backend_config)
+        self._start_sessions(resume_checkpoint)
+
+    def _start_sessions(self, resume_checkpoint):
         infos = self._rank_info()
+        n = len(self.worker_group.workers)
+        dataset_shards = self._dataset_shards_fn(n) if self._dataset_shards_fn else None
         refs = []
         for rank, w in enumerate(self.worker_group.workers):
             info = infos[rank]
@@ -139,15 +245,164 @@ class BackendExecutor:
                 world_rank=info["world_rank"],
                 local_rank=info["local_rank"],
                 node_rank=info["node_rank"],
-                world_size=self.scaling.num_workers,
+                world_size=n,
                 local_world_size=info["local_world_size"],
                 experiment_name=self.experiment_name,
                 storage_dir=self.storage_dir,
                 resume_checkpoint=resume_checkpoint,
                 dataset_shards=(dataset_shards[rank] if dataset_shards else None),
+                generation=self.generation,
+                collective_group_name=self.collective_group_name,
             )
-            refs.append(w.start_session.remote(train_fn, session_kwargs))
+            refs.append(w.start_session.remote(self._train_fn, session_kwargs))
         ray_tpu.get(refs)
+
+    # ------------------------------------------------------------------
+    # elastic resize plane
+    # ------------------------------------------------------------------
+    def _reform(self, resume_checkpoint, direction: str, trigger: str,
+                from_size: int):
+        """Common tail of shrink/grow: bump the generation, invalidate
+        the run's collective namespace so old-generation stragglers raise
+        instead of hang, re-rendezvous the backend, restart sessions."""
+        from ray_tpu._private import telemetry
+        from ray_tpu.util import tracing
+
+        t0 = time.monotonic()
+        self.generation += 1
+        to_size = len(self.worker_group.workers)
+        with tracing.start_span(
+            "train.resize",
+            attributes={
+                "direction": direction,
+                "trigger": trigger,
+                "from_size": from_size,
+                "to_size": to_size,
+                "generation": self.generation,
+                "experiment": self.experiment_name,
+            },
+        ):
+            try:
+                from ray_tpu.util import collective
+
+                collective.invalidate_collective_group(
+                    self.collective_group_name, self.generation
+                )
+            except Exception:
+                # Group namespace never used / GCS hiccup: the resize must
+                # not die on the advisory invalidation.
+                logger.debug("collective generation bump failed", exc_info=True)
+            # Quiesce survivors FIRST: their old loop threads must unwind
+            # (bounded by one report interval) before the backend tears
+            # down / re-forms the collective runtime underneath them.
+            retire_refs = []
+            for w in self.worker_group.workers:
+                try:
+                    retire_refs.append(w.retire_session.remote())
+                except Exception:
+                    pass
+            for ref in retire_refs:
+                try:
+                    ray_tpu.get(ref, timeout=60)
+                except Exception:
+                    pass
+            self._refresh_meta()
+            self.backend.on_start(self.worker_group, self.backend_config)
+            self.backend.on_training_start(self.worker_group, self.backend_config)
+            self._start_sessions(resume_checkpoint)
+        elapsed = time.monotonic() - t0
+        telemetry.count_resize_event(direction, trigger)
+        telemetry.observe_resize(direction, elapsed)
+        logger.warning(
+            "elastic %s (%s): worker group %d -> %d (generation %d) in %.2fs",
+            direction, trigger, from_size, to_size, self.generation, elapsed,
+        )
+
+    def shrink(self, trigger: str, resume_checkpoint) -> bool:
+        """Tear down only the affected ranks (drained nodes + dead
+        actors) and re-form at the largest healthy size.  Returns False —
+        leaving the group untouched — when the survivor count would fall
+        below min_workers (the caller falls back to the whole-group
+        restart path) or when there is nothing to shrink."""
+        if not self.elastic or self.worker_group is None:
+            return False
+        from ray_tpu._private.config import CONFIG
+
+        group = self.worker_group
+        from_size = len(group.workers)
+        # Casualty classification, in order of authority: ranks on drained
+        # nodes, then actors the GCS reports DEAD (non-blocking, cannot
+        # misclassify a slow-but-healthy rank mid-step).  Liveness pings
+        # are only the FALLBACK for the window where a death raised
+        # channel-side before the GCS heartbeat caught up — there the
+        # dead actor fails its ping fast, and survivors get a generous
+        # shared budget (elastic_ping_timeout_s) since a busy actor only
+        # answers at its next report boundary.
+        drained = {
+            rank
+            for rank in range(from_size)
+            if rank < len(self._ranks_meta)
+            and self._ranks_meta[rank]["node_id"] in self._drained_nodes
+        }
+        casualties = sorted(drained | set(group.dead_ranks_per_gcs()))
+        if not casualties and trigger == "worker_death":
+            alive = set(group.alive_ranks(
+                timeout=float(CONFIG.elastic_ping_timeout_s)
+            ))
+            casualties = [r for r in range(from_size) if r not in alive]
+        if not casualties:
+            return False
+        survivors = from_size - len(casualties)
+        min_workers = self.scaling.min_workers or self.scaling.num_workers
+        if survivors < min_workers:
+            logger.warning(
+                "elastic shrink refused: %d survivor(s) < min_workers=%d "
+                "(falling back to whole-group restart)", survivors, min_workers,
+            )
+            return False
+        group.remove_ranks(casualties)
+        self._reform(resume_checkpoint, "shrink", trigger, from_size)
+        return True
+
+    def try_grow(self, resume_checkpoint) -> bool:
+        """Epoch-boundary grow: lease workers back toward num_workers.
+        Each candidate must answer a ping within the lease timeout —
+        capacity that did not actually return leaves the group unchanged
+        (and backs off before the next attempt)."""
+        from ray_tpu._private.config import CONFIG
+
+        if not self.grow_pending():
+            return False
+        group = self.worker_group
+        from_size = len(group.workers)
+        want = self.scaling.num_workers - from_size
+        added = group.add_workers(
+            want, ready_timeout=float(CONFIG.elastic_grow_lease_timeout_s)
+        )
+        if added == 0:
+            # The ALIVE signal did not translate into grantable leases yet
+            # (drain migration still occupying the node, resources not
+            # registered).  KEEP the event set — a node's ALIVE
+            # registration is a one-shot edge, so clearing here could
+            # strand the group shrunken forever — but ESCALATE the retry
+            # backoff: each attempt stalls the report loop for the lease
+            # timeout, and a signal that never converts must not throttle
+            # training forever (a fresh ALIVE resets the escalation).
+            self._grow_failures += 1
+            backoff = min(
+                float(CONFIG.elastic_grow_backoff_s) * (2 ** self._grow_failures),
+                300.0,
+            )
+            self._next_grow_attempt = time.monotonic() + backoff
+            return False
+        self._grow_failures = 0
+        if len(group.workers) >= self.scaling.num_workers:
+            self._capacity_event.clear()
+        self._next_grow_attempt = (
+            time.monotonic() + float(CONFIG.elastic_grow_backoff_s)
+        )
+        self._reform(resume_checkpoint, "grow", "capacity_return", from_size)
+        return True
 
     def get_next_results(self, timeout: Optional[float] = None) -> Optional[List[dict]]:
         """One report round from every worker; None when all finished.

@@ -19,6 +19,12 @@ FINISHED = "__finished__"
 ERRORED = "__errored__"
 
 
+class SessionInvalidatedError(RuntimeError):
+    """This session belongs to a superseded worker-group generation: an
+    elastic resize replaced it.  Raised inside the old train-loop thread
+    at its next report so it unwinds instead of racing the new loop."""
+
+
 class _TrainSession:
     def __init__(
         self,
@@ -32,6 +38,8 @@ class _TrainSession:
         storage_dir: str,
         resume_checkpoint: Optional[Checkpoint] = None,
         dataset_shards: Optional[Dict[str, Any]] = None,
+        generation: int = 0,
+        collective_group_name: Optional[str] = None,
     ):
         self.train_fn = train_fn
         self.world_rank = world_rank
@@ -43,6 +51,11 @@ class _TrainSession:
         self.storage_dir = storage_dir
         self.resume_checkpoint = resume_checkpoint
         self.dataset_shards = dataset_shards or {}
+        # Elastic resize epoch: bumped by the backend executor on every
+        # shrink/grow; the rendezvous generation for any out-of-band
+        # collective group this session's loop joins.
+        self.generation = generation
+        self.collective_group_name = collective_group_name
         # maxsize=1 gives natural lockstep with the driver's polling.
         self._queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._thread: Optional[threading.Thread] = None
@@ -55,6 +68,9 @@ class _TrainSession:
         # the next step boundary — the proactive path that avoids losing
         # progress to the mid-collective death.
         self._drain_requested = threading.Event()
+        # Elastic plane: set when this session was superseded by a resize;
+        # the old loop thread unwinds at its next report.
+        self._stopped = threading.Event()
 
     def request_drain_checkpoint(self):
         """A drain notice covers this worker group: ask the user loop for
@@ -64,20 +80,66 @@ class _TrainSession:
     def drain_requested(self) -> bool:
         return self._drain_requested.is_set()
 
+    def shutdown(self):
+        """Retire this session (elastic resize replaced it): the loop
+        thread raises SessionInvalidatedError at its next report, and any
+        put() it is currently blocked in is released by draining the
+        queue.  Idempotent."""
+        self._stopped.set()
+        # Release a loop thread blocked in _queue.put (maxsize=1) waiting
+        # for a driver poll that will never come.  Drain ONLY — refilling
+        # the slot (e.g. with a sentinel) could win the race against the
+        # woken putter and leave it blocked forever.  Driver polls are
+        # serialized with this call by the actor executor, so no poller
+        # can be concurrently blocked on this queue.
+        try:
+            self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        # Tear down this run's collective group so ranks blocked in a
+        # TCP recv against OUR sockets cascade-unwind (their error maps
+        # to GroupInvalidatedError once the generation marker advances).
+        if self.collective_group_name:
+            try:
+                from ray_tpu.util.collective import collective as _coll
+
+                _coll._manager.destroy(self.collective_group_name)
+            except Exception:
+                pass
+
     def start(self):
         def runner():
             _set_session(self)
             try:
                 self.train_fn()
-                self._queue.put((FINISHED, None, None))
+                if not self._stopped.is_set():
+                    self._queue.put((FINISHED, None, None))
+            except SessionInvalidatedError:
+                pass  # superseded by a resize: nobody is listening
             except BaseException as e:  # noqa: BLE001
                 self.error = e
-                self._queue.put((ERRORED, {"traceback": traceback.format_exc()}, e))
+                # Close this rank's collective sockets so peers blocked in
+                # a recv against us unwind instead of hanging (their error
+                # surfaces as GroupInvalidatedError once the generation
+                # marker advances).
+                if self.collective_group_name:
+                    try:
+                        from ray_tpu.util.collective import collective as _coll
+
+                        _coll._manager.destroy(self.collective_group_name)
+                    except Exception:
+                        pass
+                if not self._stopped.is_set():
+                    self._queue.put((ERRORED, {"traceback": traceback.format_exc()}, e))
 
         self._thread = threading.Thread(target=runner, daemon=True, name="train-loop")
         self._thread.start()
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        if self._stopped.is_set():
+            raise SessionInvalidatedError(
+                "this training session was superseded by an elastic resize"
+            )
         # Per-train-step wall time (report-to-report) feeds the
         # train_step_seconds histogram — the pod-scale "where does step
         # time go" signal (flight recorder, docs/observability.md).
@@ -91,9 +153,19 @@ class _TrainSession:
         if checkpoint is not None:
             # Persist into the run's storage dir; rank-tagged (reference:
             # StorageContext.persist_current_checkpoint, storage.py:514).
+            # Generation-scoped name: _report_idx restarts with every
+            # elastic resize, so without the generation a new session's
+            # first checkpoint would OVERWRITE the very directory the
+            # resize handed out as the resume checkpoint — a worker that
+            # reads it late resumes one step ahead and desynchronizes the
+            # report rounds.  (Generation 0 keeps the classic name.)
+            prefix = (
+                f"checkpoint_g{self.generation:03d}_" if self.generation
+                else "checkpoint_"
+            )
             dest = os.path.join(
                 self.storage_dir,
-                f"checkpoint_{self._report_idx:06d}_rank{self.world_rank}",
+                f"{prefix}{self._report_idx:06d}_rank{self.world_rank}",
             )
             if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
                 os.makedirs(os.path.dirname(dest), exist_ok=True)
@@ -101,6 +173,12 @@ class _TrainSession:
             persisted = Checkpoint(dest)
         self._report_idx += 1
         self._queue.put(("report", dict(metrics), persisted))
+        if self._stopped.is_set():
+            # Retired while blocked in put(): unwind now, the new session
+            # owns the actor.
+            raise SessionInvalidatedError(
+                "this training session was superseded by an elastic resize"
+            )
 
     def next_report(self, timeout: Optional[float] = None):
         """Blocking fetch of the next report; driver calls via actor rpc."""

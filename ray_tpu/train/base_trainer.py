@@ -143,16 +143,20 @@ class BaseTrainer:
 
 
 def _latest_checkpoint(path: str) -> Optional[str]:
-    """Newest checkpoint_NNNNNN_rank0 dir under the experiment dir."""
+    """Newest checkpoint dir under the experiment dir.  Elastic resizes
+    write generation-scoped names (checkpoint_gGGG_NNNNNN_rank0); newest
+    is by (generation, report index)."""
     import os
     import re
 
-    best, best_idx = None, -1
+    best, best_key = None, (-1, -1)
     for entry in os.listdir(path):
-        m = re.match(r"checkpoint_(\d+)_rank0$", entry)
-        if m and int(m.group(1)) > best_idx:
-            best_idx = int(m.group(1))
-            best = os.path.join(path, entry)
+        m = re.match(r"checkpoint_(?:g(\d+)_)?(\d+)_rank0$", entry)
+        if m:
+            key = (int(m.group(1) or 0), int(m.group(2)))
+            if key > best_key:
+                best_key = key
+                best = os.path.join(path, entry)
     return best
 
 
@@ -195,10 +199,14 @@ class DataParallelTrainer(BaseTrainer):
             return lambda: fn(config)
         return fn
 
-    def _dataset_shards_per_rank(self) -> Optional[List[Dict[str, Any]]]:
+    def _dataset_shards_per_rank(self, n: Optional[int] = None) -> Optional[List[Dict[str, Any]]]:
+        """Shard the datasets across `n` ranks (default: the configured
+        num_workers).  Under elastic training this is re-invoked at every
+        resize with the NEW world size, so data re-shards to match."""
         if not self.datasets:
             return None
-        n = self.scaling_config.num_workers
+        if n is None:
+            n = self.scaling_config.num_workers
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):
@@ -274,6 +282,7 @@ class DataParallelTrainer(BaseTrainer):
         drain_restarts = 0
         latest_checkpoint: Optional[Checkpoint] = self.resume_from_checkpoint
         last_error: Optional[BaseException] = None
+        elastic = bool(getattr(self.scaling_config, "elastic", False))
 
         while True:
             executor = BackendExecutor(
@@ -286,12 +295,24 @@ class DataParallelTrainer(BaseTrainer):
                 executor.start_training(
                     self._wrapped_train_fn(),
                     resume_checkpoint=latest_checkpoint,
-                    dataset_shards=self._dataset_shards_per_rank(),
+                    dataset_shards_fn=self._dataset_shards_per_rank,
                 )
                 metrics_history: List[Dict[str, Any]] = []
                 best_checkpoints = []
                 while True:
-                    round_results = executor.get_next_results()
+                    try:
+                        round_results = executor.get_next_results()
+                    except ray_tpu.exceptions.RayActorError as e:
+                        # A worker PROCESS died mid-round (preemption that
+                        # outran its notice, OOM, SIGKILL).  Elastic
+                        # groups shrink and continue from the latest
+                        # checkpoint — capacity loss is not a failure, so
+                        # nothing is charged to max_failures.  (A user
+                        # exception raises TrainingWorkerError instead and
+                        # is always charged.)
+                        if elastic and executor.shrink("worker_death", latest_checkpoint):
+                            continue
+                        raise e
                     if round_results is None:
                         break
                     reports = [r for r in round_results if r["kind"] == "report"]
@@ -306,17 +327,29 @@ class DataParallelTrainer(BaseTrainer):
                             round_ckpt = True
                     if reports and reports[0].get("checkpoint"):
                         best_checkpoints.append((reports[0]["checkpoint"], metrics))
-                    if (
-                        drain_restarts == 0
-                        and round_ckpt
-                        and executor.drain_imminent()
-                    ):
+                    if round_ckpt and executor.drain_imminent():
                         # A drain notice covers the group and a checkpoint
                         # landed after it (the report round is the
-                        # barrier: every rank reached this step).  Restart
-                        # NOW, off the doomed node, from that checkpoint.
-                        proactive = True
-                        break
+                        # barrier: every rank reached this step).
+                        if elastic and executor.shrink("drain", latest_checkpoint):
+                            # Shrunk past the doomed ranks: survivors keep
+                            # their actors and resume from the checkpoint.
+                            # Not charged to max_failures.
+                            continue
+                        if drain_restarts == 0:
+                            # Fixed-size (or shrink refused below
+                            # min_workers): the PR 3 whole-group restart,
+                            # off the doomed node, from this checkpoint.
+                            proactive = True
+                            break
+                    if executor.grow_pending():
+                        # Epoch boundary + capacity returned: grow back
+                        # toward num_workers.  Growing re-enters the loop
+                        # from the latest checkpoint, so only attempt it
+                        # once one exists (never trade real progress for
+                        # idle chips).
+                        if latest_checkpoint is not None:
+                            executor.try_grow(latest_checkpoint)
                 if proactive:
                     drain_restarts += 1
                     executor.shutdown()

@@ -25,6 +25,10 @@ def _set_session(session):
 
 class TrainContext:
     def get_world_size(self) -> int:
+        """Current world size.  DYNAMIC under elastic training
+        (ScalingConfig.min_workers): each resize re-enters
+        train_loop_per_worker with the new size, so loops must size
+        per-step work off this call, not off a captured constant."""
         return _get_session().world_size
 
     def get_world_rank(self) -> int:
@@ -54,6 +58,22 @@ class TrainContext:
         report a checkpoint at the next step boundary resume from that
         step instead of the last periodic checkpoint."""
         return _get_session().drain_requested()
+
+    def get_generation(self) -> int:
+        """Elastic resize epoch of this worker group: 0 for the initial
+        formation, +1 per shrink/grow.  Also the rendezvous generation
+        for the group's collective namespace (see
+        get_collective_group_name)."""
+        return getattr(_get_session(), "generation", 0)
+
+    def get_collective_group_name(self) -> Optional[str]:
+        """Group name reserved for this training run's out-of-band
+        collectives.  Loops that init a util.collective group under this
+        name MUST pass generation=ctx.get_generation(): the backend
+        executor bumps the generation marker on every resize, so
+        stragglers of a torn-down world get GroupInvalidatedError instead
+        of hanging in a mesh that will never complete."""
+        return getattr(_get_session(), "collective_group_name", None)
 
 
 def get_context() -> TrainContext:

@@ -78,6 +78,14 @@ class _JaxBackend(Backend):
             distributed = n > 1
         if not distributed:
             return
+        # Elastic re-rendezvous: surviving workers may already hold a
+        # jax.distributed runtime from the previous generation — tear it
+        # down first so initialize() forms the new, resized world (no-op
+        # on fresh processes).
+        try:
+            worker_group.execute(_shutdown_jax_distributed)
+        except Exception:
+            pass
         coordinator = worker_group.execute_single(0, _get_coordinator)
         logger.info("jax.distributed coordinator at %s (%d processes)", coordinator, n)
         refs = [

@@ -11,15 +11,19 @@ through the internal KV).  TPU-era backends:
 """
 
 from ray_tpu.util.collective.collective import (
+    GroupInvalidatedError,
+    RendezvousTimeoutError,
     allgather,
     allreduce,
     barrier,
     broadcast,
     create_collective_group,
     destroy_collective_group,
+    get_collective_group_generation,
     get_rank,
     get_collective_group_size,
     init_collective_group,
+    invalidate_collective_group,
     recv,
     reduce,
     reducescatter,
@@ -30,6 +34,10 @@ __all__ = [
     "init_collective_group",
     "create_collective_group",
     "destroy_collective_group",
+    "invalidate_collective_group",
+    "get_collective_group_generation",
+    "GroupInvalidatedError",
+    "RendezvousTimeoutError",
     "allreduce",
     "allgather",
     "reducescatter",

@@ -5,6 +5,15 @@ init_collective_group, :258 allreduce ...).
 Backends: "cpu" (TCP, ray_tpu.util.collective.cpu_group) and "xla"
 (device arrays: host-staged through the cpu group; the in-program ICI
 path is jax.lax.psum under jit — see ray_tpu.parallel).
+
+Elastic re-rendezvous: groups are **generation-tagged**.  Re-forming a
+group after membership changes (a preempted rank, an elastic resize) is
+``destroy + recreate under a generation bump``: the new generation
+rendezvouses under fresh GCS-KV keys, and members still blocked in the
+old mesh get a clean ``GroupInvalidatedError`` instead of hanging.  The
+driver-side bump is ``invalidate_collective_group(name)`` (advances the
+KV marker without being a member); members re-join with
+``init_collective_group(..., generation=G)``.
 """
 
 from __future__ import annotations
@@ -14,7 +23,12 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ray_tpu.util.collective.cpu_group import CPUCollectiveGroup
+from ray_tpu.util.collective.cpu_group import (
+    KV_NS,
+    CPUCollectiveGroup,
+    GroupInvalidatedError,
+    RendezvousTimeoutError,
+)
 
 
 class _XLAGroup(CPUCollectiveGroup):
@@ -57,25 +71,47 @@ class _XLAGroup(CPUCollectiveGroup):
 _BACKENDS = {"cpu": CPUCollectiveGroup, "gloo": CPUCollectiveGroup, "xla": _XLAGroup}
 
 
+def _gcs_kv():
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+
+    def kv(method, payload):
+        return worker.gcs_client.call(method, payload)
+
+    return kv
+
+
 class GroupManager:
     def __init__(self):
         self._groups: Dict[str, CPUCollectiveGroup] = {}
         self._lock = threading.Lock()
 
-    def create(self, world_size: int, rank: int, backend: str, group_name: str):
-        from ray_tpu._private.worker import get_global_worker
-
+    def create(self, world_size: int, rank: int, backend: str, group_name: str,
+               generation: int = 0):
         if backend not in _BACKENDS:
             raise ValueError(f"unknown collective backend '{backend}' (have {list(_BACKENDS)})")
-        worker = get_global_worker()
-
-        def kv(method, payload):
-            return worker.gcs_client.call(method, payload)
+        kv = _gcs_kv()
 
         with self._lock:
-            if group_name in self._groups:
-                raise ValueError(f"collective group '{group_name}' already initialized")
-            group = _BACKENDS[backend](world_size, rank, group_name, kv)
+            existing = self._groups.get(group_name)
+            if existing is not None:
+                if existing.generation >= generation:
+                    raise ValueError(
+                        f"collective group '{group_name}' already initialized at "
+                        f"generation {existing.generation} (requested {generation}); "
+                        "re-joining requires a strictly higher generation"
+                    )
+                # Atomic destroy+recreate under the generation bump: the
+                # old mesh is torn down before the new rendezvous begins,
+                # so a collective on the old handle can only raise, never
+                # cross-connect with the new generation.
+                self._groups.pop(group_name, None)
+                existing._invalidated = True
+                existing.destroy()
+            group = _BACKENDS[backend](
+                world_size, rank, group_name, kv, generation=generation
+            )
             self._groups[group_name] = group
             return group
 
@@ -99,31 +135,91 @@ _manager = GroupManager()
 
 
 def init_collective_group(world_size: int, rank: int, backend: str = "cpu",
-                          group_name: str = "default"):
-    """Called by every member (inside its actor/task)."""
-    _manager.create(world_size, rank, backend, group_name)
+                          group_name: str = "default", generation: int = 0):
+    """Called by every member (inside its actor/task).  ``generation``
+    tags the rendezvous epoch: re-forming a group after membership change
+    requires a strictly higher generation (elastic resize)."""
+    _manager.create(world_size, rank, backend, group_name, generation=generation)
     return True
 
 
 def create_collective_group(actors: List[Any], world_size: int, ranks: List[int],
-                            backend: str = "cpu", group_name: str = "default"):
+                            backend: str = "cpu", group_name: str = "default",
+                            generation: int = 0):
     """Declarative setup from the driver: tells each actor to join."""
     import ray_tpu
 
     refs = [
-        actor.__ray_call__.remote(_join_group, world_size, rank, backend, group_name)
+        actor.__ray_call__.remote(
+            _join_group, world_size, rank, backend, group_name, generation
+        )
         for actor, rank in zip(actors, ranks)
     ]
     ray_tpu.get(refs)
     return True
 
 
-def _join_group(self, world_size, rank, backend, group_name):
-    return init_collective_group(world_size, rank, backend, group_name)
+def _join_group(self, world_size, rank, backend, group_name, generation=0):
+    return init_collective_group(
+        world_size, rank, backend, group_name, generation=generation
+    )
 
 
 def destroy_collective_group(group_name: str = "default"):
     _manager.destroy(group_name)
+
+
+def get_collective_group_generation(group_name: str = "default") -> Optional[int]:
+    """Latest generation recorded for the group in the GCS KV (readable
+    from any connected process, member or not); None when the group has
+    no marker yet."""
+    blob = _gcs_kv()("kv_get", (KV_NS, f"{group_name}/gen".encode()))
+    if blob is None:
+        return None
+    try:
+        return int(blob.decode())
+    except (ValueError, AttributeError):
+        return None
+
+
+def invalidate_collective_group(group_name: str = "default",
+                                new_generation: Optional[int] = None) -> int:
+    """Driver-side generation bump: advance the group's KV marker so
+    every member of an older generation fails its next collective (or
+    in-flight rendezvous) with GroupInvalidatedError instead of hanging.
+    Also destroys any local member handle.  Returns the new generation.
+
+    This is the atomic half of elastic ``destroy+recreate``: bump first,
+    then tell the surviving members to re-join at the returned
+    generation."""
+    kv = _gcs_kv()
+    cur = get_collective_group_generation(group_name)
+    if new_generation is None:
+        new_generation = (cur if cur is not None else -1) + 1
+    elif cur is not None and new_generation <= cur:
+        raise ValueError(
+            f"collective group '{group_name}' is already at generation {cur}; "
+            f"cannot invalidate to {new_generation}"
+        )
+    # Atomic max-write: a concurrent (higher) bump wins and is adopted.
+    stored = kv("kv_put_max", (KV_NS, f"{group_name}/gen".encode(),
+                               int(new_generation)))
+    if stored is not None:
+        new_generation = max(new_generation, int(stored))
+    # Reap superseded rendezvous keys (bounded: only the generations we
+    # can enumerate by prefix) so the KV doesn't grow one entry per
+    # (group, generation, rank) forever.
+    try:
+        stale = kv("kv_keys", (KV_NS, f"{group_name}/gen".encode()))
+        for key in stale or ():
+            if not key.endswith(b"/gen") and not key.startswith(
+                f"{group_name}/gen{new_generation}/".encode()
+            ):
+                kv("kv_del", (KV_NS, key))
+    except Exception:
+        pass
+    _manager.destroy(group_name)
+    return new_generation
 
 
 def get_rank(group_name: str = "default") -> int:

@@ -5,6 +5,17 @@ python/ray/util/collective/collective_group/gloo_collective_group.py) —
 each member runs a listener; addresses rendezvous through the GCS KV;
 peers connect lazily.  Reductions use a ring for large arrays
 (reduce-scatter + allgather) and a star through rank 0 for small ones.
+
+Elasticity: every group carries a **generation** — a monotonically
+increasing epoch baked into its rendezvous keys
+(``<group>/gen<G>/<rank>``) and recorded in a per-group marker key
+(``<group>/gen``).  Tearing a group down and re-forming it at a new size
+is atomic under a generation bump: members of the new generation
+rendezvous under fresh keys and can never cross-connect with the old
+mesh, while stragglers still blocked in the old mesh surface a clean
+``GroupInvalidatedError`` (instead of hanging in TCP receives that will
+never complete) the moment a peer socket dies or a rendezvous poll sees
+the marker advance.
 """
 
 from __future__ import annotations
@@ -30,6 +41,41 @@ REDUCE_OPS = {
 }
 
 
+class RendezvousTimeoutError(TimeoutError):
+    """Rendezvous deadline expired before every member published its
+    address.  Names the ranks that never showed up so the operator can
+    tell a dead member from a slow one."""
+
+    def __init__(self, group_name: str, generation: int, missing_ranks: List[int],
+                 timeout_s: float):
+        self.group_name = group_name
+        self.generation = generation
+        self.missing_ranks = list(missing_ranks)
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"collective group '{group_name}' (generation {generation}): "
+            f"rank(s) {self.missing_ranks} never joined within {timeout_s:.1f}s"
+        )
+
+
+class GroupInvalidatedError(RuntimeError):
+    """This member belongs to a superseded generation of the group: the
+    group was destroyed and re-formed (elastic resize) while this rank
+    was still using the old mesh.  Re-join at the current generation."""
+
+    def __init__(self, group_name: str, generation: int,
+                 current_generation: Optional[int] = None):
+        self.group_name = group_name
+        self.generation = generation
+        self.current_generation = current_generation
+        cur = (f" (current generation is {current_generation})"
+               if current_generation is not None else "")
+        super().__init__(
+            f"collective group '{group_name}' generation {generation} was "
+            f"invalidated{cur}; re-join the group at the current generation"
+        )
+
+
 def _send_msg(sock: socket.socket, obj: Any):
     data = pickle.dumps(obj, protocol=5)
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -52,10 +98,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class CPUCollectiveGroup:
-    def __init__(self, world_size: int, rank: int, group_name: str, kv):
+    def __init__(self, world_size: int, rank: int, group_name: str, kv,
+                 generation: int = 0, rendezvous_timeout_s: Optional[float] = None):
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        self.generation = generation
         self._kv = kv  # callable kv interface: put(key, val), get(key)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -68,34 +116,101 @@ class CPUCollectiveGroup:
         self._accepted: Dict[int, socket.socket] = {}
         self._accept_cond = threading.Condition()
         self._closed = False
+        self._invalidated = False
         self._accept_thread.start()
-        self._rendezvous()
+        self._rendezvous(rendezvous_timeout_s)
 
     # -- rendezvous through GCS KV ----------------------------------------
     def _key(self, rank: int) -> bytes:
-        return f"{self.group_name}/{rank}".encode()
+        return f"{self.group_name}/gen{self.generation}/{rank}".encode()
 
-    def _rendezvous(self, timeout: float = 60.0):
+    def _gen_key(self) -> bytes:
+        return f"{self.group_name}/gen".encode()
+
+    def current_generation(self) -> Optional[int]:
+        """Latest generation recorded for this group name in the GCS KV
+        (None when no marker exists — a pre-elastic group)."""
+        try:
+            blob = self._kv_get(self._gen_key())
+        except Exception:
+            return None
+        if blob is None:
+            return None
+        try:
+            return int(blob.decode())
+        except (ValueError, AttributeError):
+            return None
+
+    def _rendezvous(self, timeout: Optional[float] = None):
+        """Publish this rank's address and collect every peer's, under a
+        deadline budget with the unified backoff policy (no fixed-interval
+        polling).  Raises RendezvousTimeoutError naming ALL missing ranks,
+        or GroupInvalidatedError if the group's generation marker advances
+        past ours while we wait (the group was re-formed without us)."""
+        from ray_tpu._private import retry
+        from ray_tpu._private.config import CONFIG
+
+        if timeout is None:
+            timeout = float(CONFIG.collective_rendezvous_timeout_s)
+        # Advance the generation marker ATOMICALLY (kv_put_max: the GCS
+        # stores max(current, ours) in one handler).  A read-then-write
+        # here would let a stale gen-0 joiner overwrite a concurrent
+        # generation bump and regress the marker.  Every member writes it
+        # so a fresh joiner can detect staleness even when the re-forming
+        # coordinator died mid-bump.
+        cur = self._kv("kv_put_max", (KV_NS, self._gen_key(), self.generation))
+        if cur is not None and int(cur) > self.generation:
+            self._closed = True
+            self._listener.close()
+            raise GroupInvalidatedError(self.group_name, self.generation, int(cur))
         self._kv_put(self._key(self.rank), pickle.dumps(self._addr))
-        deadline = time.monotonic() + timeout
-        self._peer_addrs = {}
-        for r in range(self.world_size):
-            if r == self.rank:
-                continue
-            while True:
+        self._peer_addrs: Dict[int, Any] = {}
+        missing = [r for r in range(self.world_size) if r != self.rank]
+        bo = retry.RENDEZVOUS.start(deadline_s=timeout)
+        while missing:
+            still_missing = []
+            for r in missing:
                 blob = self._kv_get(self._key(r))
                 if blob is not None:
                     self._peer_addrs[r] = pickle.loads(blob)
-                    break
-                if time.monotonic() > deadline:
-                    raise TimeoutError(f"rank {r} never joined group {self.group_name}")
-                time.sleep(0.02)
+                else:
+                    still_missing.append(r)
+            missing = still_missing
+            if not missing:
+                break
+            cur = self.current_generation()
+            if cur is not None and cur > self.generation:
+                self._closed = True
+                self._listener.close()
+                raise GroupInvalidatedError(self.group_name, self.generation, cur)
+            delay = bo.next_delay()
+            if delay is None:
+                self._closed = True
+                self._listener.close()
+                raise RendezvousTimeoutError(
+                    self.group_name, self.generation, missing, timeout
+                )
+            time.sleep(delay)
 
     def _kv_put(self, key: bytes, val: bytes):
         self._kv("kv_put", (KV_NS, key, val, True))
 
     def _kv_get(self, key: bytes) -> Optional[bytes]:
         return self._kv("kv_get", (KV_NS, key))
+
+    def _check_invalidated(self, cause: BaseException):
+        """A transport error inside a collective op: if the group's
+        generation has moved on (elastic re-form), surface that as the
+        typed invalidation instead of a raw socket error."""
+        if self._invalidated:
+            raise GroupInvalidatedError(
+                self.group_name, self.generation, self.current_generation()
+            ) from cause
+        cur = self.current_generation()
+        if cur is not None and cur > self.generation:
+            self._invalidated = True
+            raise GroupInvalidatedError(self.group_name, self.generation, cur) from cause
+        raise cause
 
     # -- connections -------------------------------------------------------
     def _accept_loop(self):
@@ -122,6 +237,8 @@ class CPUCollectiveGroup:
         else:
             with self._accept_cond:
                 while rank not in self._accepted:
+                    if self._closed:
+                        raise ConnectionError("collective group destroyed")
                     if not self._accept_cond.wait(timeout=30):
                         raise TimeoutError(f"rank {rank} never connected")
                 s = self._accepted.pop(rank)
@@ -131,13 +248,19 @@ class CPUCollectiveGroup:
 
     # -- point to point ----------------------------------------------------
     def send(self, tensor, dst_rank: int):
-        s = self._peer(dst_rank)
-        with self._peer_locks[dst_rank]:
-            _send_msg(s, np.asarray(tensor))
+        try:
+            s = self._peer(dst_rank)
+            with self._peer_locks[dst_rank]:
+                _send_msg(s, np.asarray(tensor))
+        except (ConnectionError, TimeoutError, OSError) as e:
+            self._check_invalidated(e)
 
     def recv(self, shape, dtype, src_rank: int):
-        s = self._peer(src_rank)
-        return _recv_msg(s)
+        try:
+            s = self._peer(src_rank)
+            return _recv_msg(s)
+        except (ConnectionError, TimeoutError, OSError, EOFError) as e:
+            self._check_invalidated(e)
 
     # -- collectives -------------------------------------------------------
     def broadcast(self, tensor, src_rank: int = 0):
@@ -216,11 +339,22 @@ class CPUCollectiveGroup:
         self.allreduce(np.zeros(1, np.float32))
 
     def destroy(self):
+        # Rendezvous-key cleanup is NOT done here: reaping superseded
+        # generations belongs to invalidate_collective_group (the
+        # generation bump), which can enumerate them via kv_keys.
         self._closed = True
         try:
             self._listener.close()
         except OSError:
             pass
+        with self._accept_cond:
+            for s in self._accepted.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._accepted.clear()
+            self._accept_cond.notify_all()
         for s in self._peers.values():
             try:
                 s.close()

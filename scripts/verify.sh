@@ -34,4 +34,16 @@ if [ "${RAY_TPU_SKIP_DRAIN_SMOKE:-0}" != "1" ]; then
     [ "$rc" -eq 0 ] && rc=1
   fi
 fi
+
+# Elastic smoke (resize-on-preemption end-to-end): 2-node local cluster,
+# elastic JaxTrainer (min_workers=1), preempt one rank's node mid-run,
+# assert shrink -> resume -> completion with zero failure charges and
+# resize events/spans recorded.  Skippable via RAY_TPU_SKIP_ELASTIC_SMOKE=1.
+if [ "${RAY_TPU_SKIP_ELASTIC_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/elastic_smoke.py; then
+    echo "elastic smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
 exit $rc

@@ -202,3 +202,138 @@ def test_autoscaler_scales_tpu_slice_up_and_down(ray_cluster):
         monitor.stop()
         for nid in provider.non_terminated_nodes({}):
             provider.terminate_node(nid)
+
+
+# ==========================================================================
+# Capacity return (ISSUE 4): preempted-node resources are relaunched even
+# with no pending demand (an elastic trainer that shrank queues nothing).
+# ==========================================================================
+
+
+class _RecordingProvider:
+    """Minimal provider for unit-driving the reconcile loop."""
+
+    def __init__(self):
+        self.created = []  # (tags, count)
+        self._nodes = {}
+        self._next = 0
+
+    def create_node(self, node_config, tags, count):
+        self.created.append((dict(tags), count))
+        ids = []
+        for _ in range(count):
+            nid = f"n{self._next}"
+            self._nodes[nid] = dict(tags)
+            self._next += 1
+            ids.append(nid)
+        return ids
+
+    def is_running(self, node_id):
+        return node_id in self._nodes
+
+    def non_terminated_nodes(self, tag_filters):
+        return [
+            nid for nid, tags in self._nodes.items()
+            if all(tags.get(k) == v for k, v in tag_filters.items())
+        ]
+
+    def terminate_node(self, node_id):
+        self._nodes.pop(node_id, None)
+
+    def raylet_address(self, node_id):
+        return None
+
+
+def test_autoscaler_v1_capacity_return_relaunches_preempted():
+    provider = _RecordingProvider()
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}},
+                    "big_worker": {"resources": {"CPU": 16}}},
+        max_workers=4,
+    )
+    lost = {
+        "pending_demands": [],
+        "nodes": {},
+        "lost_capacity": [
+            {"node_id": "deadbeef01", "resources_total": {"CPU": 2},
+             "reason": "PREEMPTION", "time": 0.0}
+        ],
+    }
+    autoscaler.update(load_metrics=lost)
+    # Smallest covering type relaunched, once, with zero pending demand.
+    assert autoscaler.num_capacity_returns == 1
+    assert len(provider.created) == 1
+    assert provider.created[0][1] == 1
+    assert "cpu_worker" in provider.created[0][0].values()
+    # The log entry is processed exactly once: a second tick with the
+    # same feed (the GCS keeps a bounded log) launches nothing new.
+    autoscaler.update(load_metrics=lost)
+    assert autoscaler.num_capacity_returns == 1
+    assert len(provider.created) == 1
+
+
+def test_autoscaler_v2_capacity_return_queues_replacement():
+    from ray_tpu.autoscaler.v2.autoscaler import AutoscalerV2
+
+    provider = _RecordingProvider()
+    autoscaler = AutoscalerV2(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=4,
+    )
+    lost = {
+        "pending_demands": [],
+        "nodes": {},
+        "lost_capacity": [
+            {"node_id": "deadbeef02", "resources_total": {"CPU": 2},
+             "reason": "PREEMPTION", "time": 0.0}
+        ],
+    }
+    autoscaler.update(load_metrics=lost)
+    assert autoscaler.num_capacity_returns == 1
+    assert len(provider.created) == 1  # reconcile drove the queued launch
+    autoscaler.update(load_metrics=lost)
+    assert autoscaler.num_capacity_returns == 1
+
+
+def test_pick_replacement_type_smallest_cover():
+    from ray_tpu.autoscaler.autoscaler import pick_replacement_type
+
+    types = {
+        "small": {"resources": {"CPU": 2}},
+        "big": {"resources": {"CPU": 16}},
+        "tpu": {"resources": {"TPU": 4, "CPU": 8}},
+    }
+    assert pick_replacement_type(types, {"CPU": 2}) == "small"
+    assert pick_replacement_type(types, {"CPU": 8}) == "big"
+    assert pick_replacement_type(types, {"TPU": 4}) == "tpu"
+    assert pick_replacement_type(types, {"GPU": 1}) is None
+    # Auto-detected extras on a REGISTERED node (memory from sysconf,
+    # per-node markers) must not defeat the fit — only resource kinds
+    # some node type declares participate.
+    assert pick_replacement_type(
+        types, {"CPU": 2, "memory": 8 * 1024**3, "node:10.0.0.4": 1}
+    ) == "small"
+    assert pick_replacement_type(types, {"memory": 8 * 1024**3}) is None
+
+
+def test_replacement_launches_prune_survives_budget_break():
+    """The consumed-once prune must be computed against the FULL feed: a
+    budget break mid-iteration must not forget already-replaced ids past
+    the break point (that would double-launch them next tick)."""
+    from ray_tpu.autoscaler.autoscaler import replacement_launches
+
+    types = {"w": {"resources": {"CPU": 2}}}
+    feed = [
+        {"node_id": "A", "resources_total": {"CPU": 2}},
+        {"node_id": "B", "resources_total": {"CPU": 2}},
+    ]
+    processed = {"B"}  # B already replaced; A pending (its launch failed)
+    assert replacement_launches(types, feed, processed, budget=0) == []
+    assert "B" in processed  # remembered despite the budget break at A
+    out = replacement_launches(types, feed, processed, budget=2)
+    assert [o[0] for o in out] == ["A"]  # A launches once, B never again
+    # Aged-out entries DO get pruned once the GCS TTL drops them.
+    assert replacement_launches(types, [], processed, budget=2) == []
+    assert processed == set()

@@ -107,6 +107,41 @@ def test_grafana_factory_query_shapes():
     assert by_title["reqs"]["description"] == "total requests"
 
 
+def test_grafana_factory_training_robustness_panels_out_of_the_box():
+    """ROADMAP follow-up (PR 2) + ISSUE 4: train_step_seconds and the
+    drain_*/train_resize_* metrics get panels even when the exposition
+    text predates their first event — and a live exposition of the same
+    family does not duplicate the panel."""
+    from ray_tpu.dashboard.grafana_dashboard_factory import generate_grafana_dashboard
+
+    model = generate_grafana_dashboard("")  # nothing exported yet
+    titles = {p["title"] for p in model["panels"]}
+    for metric in (
+        "train_step_seconds",
+        "train_resize_events_total",
+        "train_resize_seconds",
+        "drain_events_total",
+        "drain_migration_seconds",
+        "chaos_injections_total",
+    ):
+        assert metric.replace("_", " ") in titles, metric
+    # Histogram builtins get quantile queries; counters get rate().
+    by_title = {p["title"]: p for p in model["panels"]}
+    resize_exprs = [t["expr"] for t in by_title["train resize seconds"]["targets"]]
+    assert any("histogram_quantile" in e for e in resize_exprs)
+    events_exprs = [t["expr"] for t in by_title["train resize events total"]["targets"]]
+    assert events_exprs == ["rate(train_resize_events_total[5m])"]
+
+    # Live exposition wins without duplication.
+    text = "# HELP train_step_seconds live\n# TYPE train_step_seconds histogram\n"
+    model2 = generate_grafana_dashboard(text)
+    step_panels = [
+        p for p in model2["panels"] if p["title"] == "train step seconds"
+    ]
+    assert len(step_panels) == 1
+    assert step_panels[0]["description"] == "live"
+
+
 def test_job_submission_lifecycle(dash, tmp_path):
     client = JobSubmissionClient(dash)
     out = tmp_path / "job_out.txt"

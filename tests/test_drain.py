@@ -255,6 +255,72 @@ def test_drain_node_migrates_actor_and_objects(drain_cluster):
     assert int(arr.sum()) == 11249925000
 
 
+def test_drain_reschedules_created_pg_before_kill(drain_cluster):
+    """ROADMAP follow-up (PR 3): a CREATED placement group with a bundle
+    on a DRAINING node moves ONLY that bundle to a live node AHEAD of
+    the kill — the unaffected bundle (and anything running in it) stays
+    exactly where it was, instead of the whole group bouncing at node
+    death."""
+    from ray_tpu.util.placement_group import placement_group
+
+    c, handles = drain_cluster(
+        head_args={"num_cpus": 1},
+        nodes=[{"num_cpus": 2}, {"num_cpus": 2}, {"num_cpus": 2}],
+    )
+    worker = ray_tpu._private.worker.get_global_worker()
+
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="SPREAD")
+    assert pg.wait(30)
+
+    def pg_info():
+        return worker.gcs_client.call("get_placement_group", pg.id.binary())
+
+    info = pg_info()
+    assert info["state"] == "CREATED"
+    home = info["bundles"][0]["node_id"].hex()
+    other = info["bundles"][1]["node_id"].hex()
+    assert home != other  # SPREAD onto two of the three nodes
+
+    reply = worker.gcs_client.call(
+        "drain_node",
+        {"node_id": bytes.fromhex(home), "reason": "PREEMPTION", "deadline_s": 30},
+    )
+    assert reply["accepted"]
+
+    # Bundle 0 lands on the free third node while the drained one is
+    # STILL DRAINING (proactive), back in CREATED state; bundle 1 has
+    # not moved.
+    def moved():
+        i = pg_info()
+        b0 = i["bundles"][0]["node_id"]
+        return (
+            i["state"] == "CREATED"
+            and b0 is not None
+            and b0.hex() != home
+            and _nodes_by_id().get(home, {}).get("state") == "DRAINING"
+        )
+
+    _wait(moved, 20, "PG bundle rescheduled off the draining node pre-kill")
+    assert pg_info()["bundles"][1]["node_id"].hex() == other, (
+        "unaffected bundle must keep its reservation"
+    )
+
+    # The node's eventual death must NOT bounce the group again.
+    victim = next(
+        h for h in handles
+        if h.raylet_address == _nodes_by_id()[home]["raylet_address"]
+    )
+    c.remove_node(victim)
+    _wait(
+        lambda: _nodes_by_id().get(home, {}).get("state") == "DEAD",
+        30, "DEAD after kill",
+    )
+    final = pg_info()
+    assert final["state"] == "CREATED"
+    assert final["bundles"][0]["node_id"].hex() != home
+    assert final["bundles"][1]["node_id"].hex() == other
+
+
 # ==========================================================================
 # 3. Drain-under-chaos matrix
 # ==========================================================================
