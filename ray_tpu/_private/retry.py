@@ -195,6 +195,23 @@ GCS_READ = RetryPolicy(base_s=0.1, cap_s=1.0, max_attempts=4, name="gcs_read")
 # pre-retry budget instead of quadrupling it.
 GCS_READ_BULK = RetryPolicy(base_s=0.25, cap_s=1.0, max_attempts=2, name="gcs_read_bulk")
 
+# Serve long-poll listener re-dials a controller that may be mid-restart
+# (or gone: serve.shutdown killed it).  Wall-clock budget, not attempt
+# count: failures against a dead handle return near-instantly, so an
+# attempt cap would shrink the restart grace window to whatever the
+# jitter draws.  8 s rides out a controller crash-restart; after that
+# the listener exits instead of retrying a dead host forever.
+SERVE_LONG_POLL = RetryPolicy(base_s=0.25, cap_s=2.0, deadline_s=8.0,
+                              name="serve_long_poll")
+
+# Streaming-executor idle backoff: nothing dispatchable and nothing in
+# flight, so the scheduler loop parks briefly.  Tight cap — this gates
+# pipeline latency the moment upstream produces — but jittered so many
+# concurrent executors don't tick in lockstep.  Unnamed on purpose: an
+# idle tick is not a retry, and counting it would turn the
+# retry_backoff_total "flapping dependency" signal into noise.
+DATA_IDLE = RetryPolicy(base_s=0.002, cap_s=0.02)
+
 # Collective-group rendezvous polls against the GCS KV (cpu_group).
 # Latency-critical like POLL (every group member blocks on it at
 # formation and elastic re-formation), but capped a little higher since

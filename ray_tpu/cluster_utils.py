@@ -81,9 +81,12 @@ class Cluster:
 
     def wait_for_nodes(self, timeout: float = 30.0) -> int:
         """Wait until every started node is ALIVE in the GCS."""
+        from ray_tpu._private import retry
+
         expected = 1 + len(self.workers)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        alive = 0
+        bo = retry.POLL.start(deadline_s=timeout)
+        while True:
             client = rpc.RpcClient(self.gcs_address)
             try:
                 info = client.call("get_cluster_info")
@@ -92,8 +95,12 @@ class Cluster:
                     return alive
             finally:
                 client.close()
-            time.sleep(0.05)
-        raise TimeoutError(f"only {alive} of {expected} nodes alive after {timeout}s")
+            delay = bo.next_delay()
+            if delay is None:
+                raise TimeoutError(
+                    f"only {alive} of {expected} nodes alive after {timeout}s"
+                )
+            time.sleep(delay)
 
     def shutdown(self):
         for handle in list(self.workers):

@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private import retry
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, split_block
 from ray_tpu.data.context import DataContext
 
@@ -778,6 +779,7 @@ def execute_streaming(
             consumers[id(inp)].append((op, idx))
 
     done_notified: set = set()
+    idle_bo = None  # jittered idle backoff; reset on any progress
     try:
         while True:
             progressed = False
@@ -854,7 +856,13 @@ def execute_streaming(
                     while sink.has_next():
                         yield sink.get_next()
                     break
-                time.sleep(0.01)
+                # Nothing in flight and nothing dispatchable: park with
+                # the jittered idle policy; any progress resets the
+                # backoff so latency stays at the base after a burst.
+                idle_bo = idle_bo or retry.DATA_IDLE.start()
+                time.sleep(idle_bo.next_delay())
+            if progressed:
+                idle_bo = None
     finally:
         for op in topo.ops:
             op.shutdown()

@@ -376,14 +376,20 @@ class Dataset:
 
         def make_factory(idx: int):
             def factory():
+                from ray_tpu._private import retry
+
                 # Epochs after the first are a barrier: every split must
                 # finish epoch k before epoch k+1 starts (otherwise one
                 # fast consumer would wipe the queues of the others).
+                bo = retry.POLL.start()
                 while True:
                     epoch = ray_tpu.get(coordinator.start_epoch.remote(idx))
                     if epoch is not None:
                         break
-                    time.sleep(0.05)
+                    # POLL carries no budget here: the barrier holds until
+                    # every other split finishes the epoch, however long
+                    # that takes — the jitter only de-syncs the pollers.
+                    time.sleep(bo.next_delay())
                 while True:
                     ref = ray_tpu.get(coordinator.get_next.remote(idx, epoch))
                     if ref is None:

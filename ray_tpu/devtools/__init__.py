@@ -1,0 +1,1 @@
+"""Developer tooling shipped with the repo (not part of the runtime API)."""

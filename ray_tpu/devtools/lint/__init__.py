@@ -1,0 +1,23 @@
+"""graftlint — AST-based concurrency & invariant analyzer for ray_tpu.
+
+Four PRs of robustness work accumulated distributed-systems invariants
+that only lived in reviewers' heads: every retry loop must ride a
+``_private/retry.py`` policy, no blocking sleeps on RPC dispatch or
+pubsub threads, spawned threads need a daemon flag or a join path, lock
+acquisition order must stay acyclic, the metrics catalog must match the
+instruments that actually exist, and rendezvous/checkpoint keys must go
+through the canonical generation-scoped helpers.  graftlint walks the
+whole ``ray_tpu/`` tree (stdlib ``ast`` only, no third-party deps) and
+enforces them on every PR, with a checked-in suppression baseline
+(``.graftlint.toml``) so accepted exceptions are explicit and diffable.
+
+Run it::
+
+    python -m ray_tpu.devtools.lint [paths ...]
+
+Checker catalog and suppression format: docs/static_analysis.md.
+"""
+
+from ray_tpu.devtools.lint.core import LintResult, Violation, run_lint
+
+__all__ = ["LintResult", "Violation", "run_lint"]

@@ -1,0 +1,23 @@
+"""Checker registry.  A checker is a module exposing ``name`` and either
+``check(module)`` (per-file) or ``check_project(project)`` (whole-tree).
+Add new checkers here and in docs/static_analysis.md."""
+
+from ray_tpu.devtools.lint.checkers import (
+    blocking_handler,
+    generation_key,
+    lock_order,
+    metrics_drift,
+    retry_gate,
+    thread_lifecycle,
+)
+
+ALL_CHECKERS = [
+    retry_gate,
+    lock_order,
+    thread_lifecycle,
+    blocking_handler,
+    metrics_drift,
+    generation_key,
+]
+
+CHECK_NAMES = [c.name for c in ALL_CHECKERS]

@@ -1,0 +1,178 @@
+"""blocking-in-handler: no blocking calls on RPC dispatch / pubsub threads.
+
+Two kinds of latency-critical entry points exist in this codebase
+(``_private/rpc.py``):
+
+- **server handlers** — ``async def rpc_<method>`` / ``push_<method>``
+  coroutines dispatched by RpcServer on the process's asyncio loop.  A
+  ``time.sleep`` (or blocking socket read) there freezes the *entire*
+  event loop: every other RPC this process serves stalls behind it.
+  (``await asyncio.sleep`` is fine.)
+- **client push/close callbacks** — functions wired via ``on_push=`` /
+  ``on_close=`` / ``on_reconnect=`` (GCS pubsub deliveries among them)
+  run on the RpcClient's reader thread.  Blocking there stalls every
+  in-flight reply on that connection — the PR 1 GCS-restart bug class
+  (blocking GCS pushes stalled stream consumption through outages).
+
+The checker collects those entry points per module, builds a
+module-local call graph (``self.method()`` and module-level ``func()``
+edges), and flags ``time.sleep`` / blocking ``recv`` reachable within
+the module.  Cross-module reachability is out of scope by design — a
+blocking call behind an import boundary needs its own local entry point
+to be flagged, which keeps the analysis fast and the findings precise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.core import Module, Violation, call_name
+
+name = "blocking-in-handler"
+
+_CALLBACK_KWARGS = ("on_push", "on_close", "on_reconnect", "on_disconnect")
+_MAX_DEPTH = 8
+
+
+def _blocking(node: ast.Call, in_async: bool) -> Optional[str]:
+    cn = call_name(node)
+    if cn in ("time.sleep", "_time.sleep"):
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value == 0:
+            return None
+        return "time.sleep"
+    if cn.endswith(".recv") or cn.endswith("_recv_exact") or cn.endswith("_recv_msg"):
+        return "blocking socket recv"
+    if cn.endswith(".accept") and "listener" in cn:
+        return "blocking socket accept"
+    return None
+
+
+def _fn_index(mod: Module) -> Dict[str, ast.AST]:
+    return {q: fn for q, fn in mod.iter_functions()}
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes in ``fn``'s own body, pruning nested function/lambda bodies —
+    a closure defined in a handler (e.g. a thread target) does not run on
+    the handler's thread, so its blocking calls are not the handler's."""
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        n = todo.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        todo.extend(ast.iter_child_nodes(n))
+
+
+def _entries(mod: Module, fns: Dict[str, ast.AST]) -> List[str]:
+    out: List[str] = []
+    for q, fn in fns.items():
+        base = q.split(".")[-1]
+        if isinstance(fn, ast.AsyncFunctionDef) and (
+            base.startswith("rpc_") or base.startswith("push_")
+        ):
+            out.append(q)
+    # Callbacks passed as on_push=self._x / on_close=self._x, as
+    # `client.on_push = self._x` assignments, or inside lambdas.
+    for node in ast.walk(mod.tree):
+        refs: List[ast.AST] = []
+        if isinstance(node, ast.Call):
+            refs = [kw.value for kw in node.keywords if kw.arg in _CALLBACK_KWARGS]
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and t.attr in _CALLBACK_KWARGS:
+                refs = [node.value]
+        for ref in refs:
+            for target in _callback_targets(ref):
+                # Resolve the attr name to any class method in this module.
+                for q in fns:
+                    if q.split(".")[-1] == target:
+                        out.append(q)
+    return sorted(set(out))
+
+
+def _callback_targets(ref: ast.AST) -> List[str]:
+    """Method names referenced by a callback expression: `self._x`,
+    `lambda ...: self._x(...)`, or a bare function name."""
+    if isinstance(ref, ast.Attribute):
+        return [ref.attr]
+    if isinstance(ref, ast.Name):
+        return [ref.id]
+    if isinstance(ref, ast.Lambda):
+        return [
+            call_name(c).split(".")[-1]
+            for c in ast.walk(ref.body)
+            if isinstance(c, ast.Call)
+        ]
+    return []
+
+
+def _callees(mod: Module, q: str, fn: ast.AST, fns: Dict[str, ast.AST]) -> Set[str]:
+    cls = q.split(".")[0] if "." in q else None
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        if cn.startswith("self.") and cls:
+            cand = f"{cls}.{cn[5:]}"
+            if cand in fns:
+                out.add(cand)
+        elif "." not in cn and cn in fns:
+            out.add(cn)
+    return out
+
+
+def check(mod: Module) -> Iterable[Violation]:
+    fns = _fn_index(mod)
+    if not fns:
+        return []
+    entries = _entries(mod, fns)
+    if not entries:
+        return []
+    out: List[Violation] = []
+    reported: Set[Tuple[str, int]] = set()
+    for entry in entries:
+        # BFS through the module-local call graph.
+        seen = {entry}
+        frontier: List[Tuple[str, Tuple[str, ...]]] = [(entry, (entry,))]
+        depth = 0
+        while frontier and depth < _MAX_DEPTH:
+            nxt: List[Tuple[str, Tuple[str, ...]]] = []
+            for q, trail in frontier:
+                fn = fns[q]
+                in_async = isinstance(fn, ast.AsyncFunctionDef)
+                for node in _own_nodes(fn):
+                    if isinstance(node, ast.Call):
+                        kind = _blocking(node, in_async)
+                        if kind and (q, node.lineno) not in reported:
+                            reported.add((q, node.lineno))
+                            via = (
+                                "" if len(trail) == 1
+                                else " via " + " -> ".join(trail[1:])
+                            )
+                            out.append(
+                                Violation(
+                                    check=name,
+                                    path=mod.relpath,
+                                    line=node.lineno,
+                                    symbol=q,
+                                    tag=f"{kind}@{entry}",
+                                    message=(
+                                        f"{kind} reachable from handler/pubsub "
+                                        f"entry point {entry}{via} — this blocks "
+                                        "the RPC dispatch loop / reader thread; "
+                                        "defer to a worker thread or use "
+                                        "asyncio.sleep in async handlers"
+                                    ),
+                                )
+                            )
+                for callee in _callees(mod, q, fn, fns):
+                    if callee not in seen:
+                        seen.add(callee)
+                        nxt.append((callee, trail + (callee,)))
+            frontier = nxt
+            depth += 1
+    return out

@@ -76,25 +76,28 @@ class LongPollClient:
         self._thread.start()
 
     def _loop(self):
-        failures = 0
+        import time
+
+        from ray_tpu._private import retry
+
+        bo = None
         while not self._stopped:
             try:
                 changed = self._ray.get(
                     self._host.listen_for_change.remote(dict(self._snapshot_ids)),
                     timeout=LISTEN_TIMEOUT_S + 30,
                 )
-                failures = 0
+                bo = None  # healthy again: next failure starts a fresh budget
             except Exception:
                 if self._stopped:
                     return
-                failures += 1
-                if failures >= 5:
+                bo = bo or retry.SERVE_LONG_POLL.start()
+                delay = bo.next_delay()
+                if delay is None:
                     # host is gone (serve.shutdown killed the
                     # controller): exit instead of retrying forever
                     return
-                import time
-
-                time.sleep(1.0)
+                time.sleep(delay)
                 continue
             for key, (snap_id, value) in (changed or {}).items():
                 self._snapshot_ids[key] = snap_id

@@ -98,12 +98,16 @@ class Router:
                 pass
 
     def pick(self, multiplexed_model_id: str = "") -> dict:
+        from ray_tpu._private import retry
+
         self._refresh()
-        deadline = time.monotonic() + 30
+        bo = None
         while not self._replicas:
-            if time.monotonic() > deadline:
+            bo = bo or retry.POLL.start(deadline_s=30)
+            delay = bo.next_delay()
+            if delay is None:
                 raise RuntimeError(f"no running replicas for deployment {self.deployment_name}")
-            time.sleep(0.1)
+            time.sleep(delay)
             self._refresh(force=True)
         if multiplexed_model_id:
             # soft affinity: among replicas that already hold the model,

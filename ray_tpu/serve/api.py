@@ -195,16 +195,18 @@ def run(
                        grpc_servicer_functions=grpc_servicer_functions)
     ingress_name = _deploy_graph(controller, app, route_prefix=route_prefix)
     handle = DeploymentHandle(ingress_name, controller)
-    # wait for at least one running replica of every deployment in the app
-    deadline = time.monotonic() + 60
+    # wait for at least one running replica of every deployment in the
+    # app — one shared 60 s budget, jittered polls (retry.POLL).
+    from ray_tpu._private import retry
+
+    bo = retry.POLL.start(deadline_s=60)
     for sub in walk_applications(app):
         name = sub.deployment._config.name
-        while time.monotonic() < deadline:
-            if ray_tpu.get(controller.get_replicas.remote(name)):
-                break
-            time.sleep(0.1)
-        else:
-            raise TimeoutError(f"deployment {name} failed to start replicas")
+        while not ray_tpu.get(controller.get_replicas.remote(name)):
+            delay = bo.next_delay()
+            if delay is None:
+                raise TimeoutError(f"deployment {name} failed to start replicas")
+            time.sleep(delay)
     return handle
 
 
@@ -283,20 +285,22 @@ def deploy_config(schema) -> Dict[str, list]:
         )
         _deploy_graph(controller, app, route_prefix=app_schema.route_prefix)
         names = [sub.deployment._config.name for sub in walk_applications(app)]
-        # wait for every deployment to reach its target
+        # wait for every deployment to reach its target — shared 60 s
+        # budget per application, jittered polls (retry.POLL)
         import time
 
-        deadline = time.monotonic() + 60
+        from ray_tpu._private import retry
+
+        bo = retry.POLL.start(deadline_s=60)
         for name in names:
-            while time.monotonic() < deadline:
-                if ray_tpu.get(controller.get_replicas.remote(name)):
-                    break
-                time.sleep(0.1)
-            else:
-                raise TimeoutError(
-                    f"application {app_schema.name!r}: deployment {name!r} "
-                    "failed to start any replica within 60s"
-                )
+            while not ray_tpu.get(controller.get_replicas.remote(name)):
+                delay = bo.next_delay()
+                if delay is None:
+                    raise TimeoutError(
+                        f"application {app_schema.name!r}: deployment "
+                        f"{name!r} failed to start any replica within 60s"
+                    )
+                time.sleep(delay)
         statuses[app_schema.name] = names
     return statuses
 

@@ -24,9 +24,11 @@ class PlacementGroup:
         return self
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
+        from ray_tpu._private import retry
+
         worker = get_global_worker()
-        deadline = time.monotonic() + timeout_seconds
-        while time.monotonic() < deadline:
+        bo = retry.POLL.start(deadline_s=timeout_seconds)
+        while True:
             info = worker.gcs_client.call("get_placement_group", self.id.binary())
             if info is None:
                 raise exceptions.PlacementGroupSchedulingError("placement group removed")
@@ -34,8 +36,10 @@ class PlacementGroup:
                 return True
             if info["state"] == "REMOVED":
                 raise exceptions.PlacementGroupSchedulingError("placement group removed")
-            time.sleep(0.02)
-        return False
+            delay = bo.next_delay()
+            if delay is None:
+                return False
+            time.sleep(delay)
 
     @property
     def bundle_specs(self) -> List[Dict[str, float]]:

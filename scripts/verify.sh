@@ -3,6 +3,16 @@
 # humans run this one script so the gate can't drift from the docs.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# graftlint (static analysis gate): the whole ray_tpu/ tree must carry
+# zero unsuppressed invariant violations against .graftlint.toml, with
+# no stale baseline entries (--strict), inside a 30 s budget.  Runs
+# first: it is the cheapest signal and failures are line-precise.
+if ! timeout -k 5 30 python -m ray_tpu.devtools.lint ray_tpu --strict; then
+  echo "graftlint gate failed (see docs/static_analysis.md)"
+  exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
   python -m pytest tests/ -q -m 'not slow' \

@@ -369,10 +369,22 @@ def test_chaos_matrix_progress(chaos_cluster, axis):
     def f(i):
         return i * 3
 
-    out = ray_tpu.get([f.remote(i) for i in range(12)], timeout=150)
+    # Condition-poll instead of one wall-clock gather: under load a
+    # worker-kill axis pays worker respawn + re-lease on top of the
+    # chaos delays, so assert *progress* against a generous deadline
+    # and only fail when completion genuinely stalls.
+    refs = [f.remote(i) for i in range(12)]
+    deadline = time.monotonic() + 300
+    pending = list(refs)
+    while pending and time.monotonic() < deadline:
+        done, pending = ray_tpu.wait(
+            pending, num_returns=len(pending), timeout=5
+        )
+    assert not pending, f"{len(pending)} tasks still pending at deadline"
+    out = ray_tpu.get(refs, timeout=60)
     assert out == [i * 3 for i in range(12)]
     ref = ray_tpu.put(np.arange(120_000))
-    assert int(ray_tpu.get(ref, timeout=90).sum()) == 7199940000
+    assert int(ray_tpu.get(ref, timeout=120).sum()) == 7199940000
 
 
 @pytest.mark.chaos

@@ -1,0 +1,576 @@
+"""graftlint tier-1 gate + per-checker fixture tests.
+
+Two layers:
+
+- **fixture tests** — for every checker, a known-bad snippet that must
+  be flagged and a known-good twin that must pass.  These pin the
+  checker semantics so a refactor of the analyzer can't silently stop
+  catching the bug class it was built for.
+- **the gate** — ``ray_tpu/`` itself must lint clean against the
+  checked-in ``.graftlint.toml`` baseline, under the <30 s budget, with
+  no stale baseline entries.  This is the tier-1 assertion that holds
+  the invariants for every future PR.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.lint import baseline as baseline_mod
+from ray_tpu.devtools.lint import core
+from ray_tpu.devtools.lint.cli import main as cli_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(tmp_path, source, select, filename="mod.py", docs=None):
+    """Write ``source`` into a scratch tree and run the selected checker.
+    Returns the violations for that checker only (bad-suppression rides
+    along when asked for explicitly)."""
+    f = tmp_path / filename
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    if docs is not None:
+        d = tmp_path / "docs" / "observability.md"
+        d.parent.mkdir(exist_ok=True)
+        d.write_text(textwrap.dedent(docs))
+    result = core.run_lint([str(f)], root=str(tmp_path), select=list(select))
+    assert not result.parse_errors, result.parse_errors
+    return result.violations
+
+
+# ---------------------------------------------------------------- retry-gate
+
+BAD_SLEEP_LOOP = """
+    import time
+
+    def wait_for_it(check):
+        while not check():
+            time.sleep(0.5)
+"""
+
+GOOD_POLICY_LOOP = """
+    import time
+    from ray_tpu._private import retry
+
+    def wait_for_it(check):
+        bo = retry.POLL.start(deadline_s=30)
+        while not check():
+            delay = bo.next_delay()
+            if delay is None:
+                raise TimeoutError
+            time.sleep(delay)
+"""
+
+BAD_HANDROLLED_RPC = """
+    def fetch(client):
+        while True:
+            try:
+                return client.call("get_thing")
+            except ConnectionError:
+                continue
+"""
+
+GOOD_IDEMPOTENT_RPC = """
+    from ray_tpu._private import rpc, retry
+
+    def fetch(client):
+        return rpc.call_idempotent(client, "get_thing", policy=retry.GCS_READ)
+"""
+
+
+def test_retry_gate_flags_fixed_sleep_loop(tmp_path):
+    v = lint_source(tmp_path, BAD_SLEEP_LOOP, ["retry-gate"])
+    assert [x.tag for x in v] == ["sleep=0.5"]
+    assert v[0].symbol == "wait_for_it"
+
+
+def test_retry_gate_passes_policy_loop(tmp_path):
+    assert lint_source(tmp_path, GOOD_POLICY_LOOP, ["retry-gate"]) == []
+
+
+def test_retry_gate_flags_handrolled_rpc_retry(tmp_path):
+    v = lint_source(tmp_path, BAD_HANDROLLED_RPC, ["retry-gate"])
+    assert [x.tag for x in v] == ["handrolled-rpc-retry"]
+
+
+def test_retry_gate_passes_idempotent_call(tmp_path):
+    assert lint_source(tmp_path, GOOD_IDEMPOTENT_RPC, ["retry-gate"]) == []
+
+
+def test_retry_gate_ignores_yield_sleep(tmp_path):
+    src = """
+        import time
+
+        def spin():
+            while True:
+                time.sleep(0)
+    """
+    assert lint_source(tmp_path, src, ["retry-gate"]) == []
+
+
+# ---------------------------------------------------------------- lock-order
+
+BAD_LOCK_CYCLE = """
+    import threading
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def one():
+        with a:
+            with b:
+                pass
+
+    def two():
+        with b:
+            with a:
+                pass
+"""
+
+GOOD_LOCK_ORDER = """
+    import threading
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def one():
+        with a:
+            with b:
+                pass
+
+    def two():
+        with a:
+            with b:
+                pass
+"""
+
+BAD_BLOCKING_UNDER_LOCK = """
+    import threading
+    import time
+
+    class Pool:
+        def __init__(self):
+            self._mu = threading.Lock()
+
+        def drain(self, client):
+            with self._mu:
+                client.call("flush")
+"""
+
+GOOD_BLOCKING_OUTSIDE_LOCK = """
+    import threading
+    import time
+
+    class Pool:
+        def __init__(self):
+            self._mu = threading.Lock()
+
+        def drain(self, client):
+            with self._mu:
+                todo = True
+            if todo:
+                client.call("flush")
+"""
+
+
+def test_lock_order_flags_cycle(tmp_path):
+    v = lint_source(tmp_path, BAD_LOCK_CYCLE, ["lock-order"])
+    cycles = [x for x in v if x.tag.startswith("cycle:")]
+    assert len(cycles) == 1
+    assert "potential deadlock" in cycles[0].message
+
+
+def test_lock_order_passes_consistent_order(tmp_path):
+    v = lint_source(tmp_path, GOOD_LOCK_ORDER, ["lock-order"])
+    assert [x for x in v if x.tag.startswith("cycle:")] == []
+
+
+def test_lock_order_flags_rpc_under_lock(tmp_path):
+    v = lint_source(tmp_path, BAD_BLOCKING_UNDER_LOCK, ["lock-order"])
+    assert len(v) == 1 and v[0].tag.startswith("blocking:rpc call@")
+    assert v[0].symbol == "Pool.drain"
+
+
+def test_lock_order_passes_rpc_outside_lock(tmp_path):
+    assert lint_source(tmp_path, GOOD_BLOCKING_OUTSIDE_LOCK, ["lock-order"]) == []
+
+
+def test_lock_order_closure_does_not_inherit_held_set(tmp_path):
+    # A function *defined* under a lock does not *run* under it.
+    src = """
+        import threading
+        import time
+
+        mu = threading.Lock()
+
+        def make_worker():
+            with mu:
+                def worker():
+                    time.sleep(1.0)
+                return worker
+    """
+    assert lint_source(tmp_path, src, ["lock-order"]) == []
+
+
+# ----------------------------------------------------------- thread-lifecycle
+
+BAD_ORPHAN_THREAD = """
+    import threading
+
+    class Loop:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            pass
+"""
+
+GOOD_JOINED_THREAD = BAD_ORPHAN_THREAD + """
+        def stop(self):
+            self._t.join()
+"""
+
+GOOD_DAEMON_THREAD = """
+    import threading
+
+    def fire_and_forget(fn):
+        threading.Thread(target=fn, daemon=True).start()
+"""
+
+
+def test_thread_lifecycle_flags_orphan(tmp_path):
+    v = lint_source(tmp_path, BAD_ORPHAN_THREAD, ["thread-lifecycle"])
+    assert len(v) == 1 and v[0].tag == "handle=self._t"
+
+
+def test_thread_lifecycle_passes_joined(tmp_path):
+    assert lint_source(tmp_path, GOOD_JOINED_THREAD, ["thread-lifecycle"]) == []
+
+
+def test_thread_lifecycle_passes_daemon(tmp_path):
+    assert lint_source(tmp_path, GOOD_DAEMON_THREAD, ["thread-lifecycle"]) == []
+
+
+# --------------------------------------------------------- blocking-in-handler
+
+BAD_SLEEP_IN_HANDLER = """
+    import time
+
+    class Server:
+        async def rpc_get_thing(self, req):
+            self._settle()
+            return {}
+
+        def _settle(self):
+            time.sleep(0.2)
+"""
+
+GOOD_ASYNC_SLEEP = """
+    import asyncio
+
+    class Server:
+        async def rpc_get_thing(self, req):
+            await asyncio.sleep(0.2)
+            return {}
+"""
+
+BAD_SLEEP_IN_PUSH_CALLBACK = """
+    import time
+
+    class Watcher:
+        def connect(self, make_client):
+            self._client = make_client(on_push=self._on_push)
+
+        def _on_push(self, msg):
+            time.sleep(1.0)
+"""
+
+
+def test_blocking_handler_flags_sleep_via_helper(tmp_path):
+    v = lint_source(tmp_path, BAD_SLEEP_IN_HANDLER, ["blocking-in-handler"])
+    assert len(v) == 1
+    assert v[0].symbol == "Server._settle"
+    assert "rpc_get_thing" in v[0].tag
+
+
+def test_blocking_handler_passes_async_sleep(tmp_path):
+    assert lint_source(tmp_path, GOOD_ASYNC_SLEEP, ["blocking-in-handler"]) == []
+
+
+def test_blocking_handler_flags_pubsub_callback(tmp_path):
+    v = lint_source(tmp_path, BAD_SLEEP_IN_PUSH_CALLBACK, ["blocking-in-handler"])
+    assert len(v) == 1 and v[0].symbol == "Watcher._on_push"
+
+
+def test_blocking_handler_exempts_thread_target_closure(tmp_path):
+    # The checker's own advice: defer blocking work to a worker thread.
+    # The closure's sleep runs on that thread, not the dispatch loop.
+    src = """
+        import threading
+        import time
+
+        class Server:
+            async def rpc_slow_op(self, req):
+                def bg():
+                    time.sleep(5.0)
+                threading.Thread(target=bg, daemon=True).start()
+                return {}
+    """
+    assert lint_source(tmp_path, src, ["blocking-in-handler"]) == []
+
+
+# -------------------------------------------------------------- metrics-drift
+
+CATALOG_ONLY_DOCUMENTED = """
+    # Observability
+
+    ## Metric catalog
+
+    | name | type | tags | meaning |
+    |---|---|---|---|
+    | `documented_total` | counter | — | is in code and catalog |
+"""
+
+BAD_UNDOCUMENTED_METRIC = """
+    from ray_tpu.util.metrics import Counter
+
+    documented = Counter("documented_total", description="fine")
+    rogue = Counter("rogue_total", description="not in the catalog")
+"""
+
+CATALOG_WITH_ORPHAN = CATALOG_ONLY_DOCUMENTED + """\
+    | `ghost_total` | counter | — | no code creates this |
+"""
+
+GOOD_IN_SYNC_METRIC = """
+    from ray_tpu.util.metrics import Counter
+
+    documented = Counter("documented_total", description="fine")
+"""
+
+BAD_CARDINALITY_TAG = GOOD_IN_SYNC_METRIC + """
+    def record(node_id):
+        documented.inc(1, tags={"node": f"{node_id}"})
+"""
+
+
+def test_metrics_drift_flags_undocumented_instrument(tmp_path):
+    v = lint_source(
+        tmp_path, BAD_UNDOCUMENTED_METRIC, ["metrics-drift"],
+        docs=CATALOG_ONLY_DOCUMENTED,
+    )
+    assert [x.tag for x in v] == ["undocumented:rogue_total"]
+
+
+def test_metrics_drift_flags_orphaned_catalog_row(tmp_path):
+    v = lint_source(
+        tmp_path, GOOD_IN_SYNC_METRIC, ["metrics-drift"],
+        docs=CATALOG_WITH_ORPHAN,
+    )
+    assert [x.tag for x in v] == ["orphaned:ghost_total"]
+    assert v[0].path == "docs/observability.md"
+
+
+def test_metrics_drift_passes_in_sync(tmp_path):
+    v = lint_source(
+        tmp_path, GOOD_IN_SYNC_METRIC, ["metrics-drift"],
+        docs=CATALOG_ONLY_DOCUMENTED,
+    )
+    assert v == []
+
+
+def test_metrics_drift_flags_unbounded_cardinality(tmp_path):
+    v = lint_source(
+        tmp_path, BAD_CARDINALITY_TAG, ["metrics-drift"],
+        docs=CATALOG_ONLY_DOCUMENTED,
+    )
+    assert [x.tag for x in v] == ["cardinality:node"]
+
+
+# ------------------------------------------------------------- generation-key
+
+BAD_HANDROLLED_GEN_KEY = """
+    def stash(kv, group, gen, rank, payload):
+        kv.put(f"{group}/gen{gen}/{rank}", payload)
+"""
+
+BAD_HANDROLLED_CKPT_DIR = """
+    def resume_dir(base, gen, step, rank):
+        return f"{base}/checkpoint_g{gen:03d}_{step:06d}_rank{rank}"
+"""
+
+GOOD_DESCRIBED_IN_DOCSTRING = '''
+    def helper():
+        """Keys look like <group>/gen<G>/<rank>; see cpu_group._key."""
+        return None
+'''
+
+
+def test_generation_key_flags_handrolled_rendezvous_key(tmp_path):
+    v = lint_source(tmp_path, BAD_HANDROLLED_GEN_KEY, ["generation-key"])
+    assert len(v) == 1 and v[0].tag.startswith("rendezvous key:")
+
+
+def test_generation_key_flags_handrolled_checkpoint_dir(tmp_path):
+    v = lint_source(tmp_path, BAD_HANDROLLED_CKPT_DIR, ["generation-key"])
+    assert len(v) == 1 and v[0].tag.startswith("checkpoint dir:")
+
+
+def test_generation_key_exempts_docstrings(tmp_path):
+    assert lint_source(tmp_path, GOOD_DESCRIBED_IN_DOCSTRING, ["generation-key"]) == []
+
+
+def test_generation_key_exempts_canonical_module(tmp_path):
+    # The same string inside the canonical helper module is the one
+    # place allowed to build the format.
+    v = lint_source(
+        tmp_path, BAD_HANDROLLED_GEN_KEY, ["generation-key"],
+        filename="ray_tpu/util/collective/cpu_group.py",
+    )
+    assert v == []
+
+
+# ------------------------------------------------- suppressions and baseline
+
+def test_inline_disable_with_reason_suppresses(tmp_path):
+    src = """
+        import time
+
+        def cadence_loop():
+            while True:
+                # graftlint: disable=retry-gate -- fixed-cadence ticker, not a retry
+                time.sleep(0.5)
+    """
+    v = lint_source(tmp_path, src, ["retry-gate"])
+    assert len(v) == 1 and v[0].suppressed_by == "inline"
+
+
+def test_inline_disable_without_reason_is_a_violation(tmp_path):
+    src = """
+        import time
+
+        def cadence_loop():
+            while True:
+                time.sleep(0.5)  # graftlint: disable=retry-gate
+    """
+    v = lint_source(tmp_path, src, ["retry-gate", "bad-suppression"])
+    checks = sorted(x.check for x in v if x.suppressed_by is None)
+    # The reasonless disable both fails to suppress and is itself flagged.
+    assert checks == ["bad-suppression", "retry-gate"]
+
+
+def test_baseline_round_trip(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(BAD_SLEEP_LOOP))
+    found = core.run_lint([str(f)], root=str(tmp_path), select=["retry-gate"])
+    assert len(found.unsuppressed) == 1
+
+    # write -> load -> apply: the same violation is now suppressed.
+    bl_path = tmp_path / ".graftlint.toml"
+    n = baseline_mod.write(str(bl_path), found.unsuppressed,
+                           reason="fixture: accepted for the round-trip test")
+    assert n == 1
+    bl = baseline_mod.load(str(bl_path))
+    again = core.run_lint([str(f)], root=str(tmp_path), baseline=bl,
+                          select=["retry-gate"])
+    assert again.unsuppressed == [] and len(again.suppressed) == 1
+    assert again.unused_baseline == []
+
+    # A baseline entry matching nothing is reported as stale.
+    bl2 = baseline_mod.load(str(bl_path))
+    bl2.entries[0].path = "nonexistent.py"
+    stale = core.run_lint([str(f)], root=str(tmp_path), baseline=bl2,
+                          select=["retry-gate"])
+    assert len(stale.unsuppressed) == 1 and len(stale.unused_baseline) == 1
+
+
+def test_inline_disable_star_suppresses_everything(tmp_path):
+    src = """
+        import time
+
+        def cadence_loop():
+            while True:
+                time.sleep(0.5)  # graftlint: disable=* -- fixture: blanket opt-out
+    """
+    v = lint_source(tmp_path, src, ["retry-gate"])
+    assert len(v) == 1 and v[0].suppressed_by == "inline"
+
+
+def test_repo_root_fallback_is_a_directory(tmp_path):
+    # No pyproject/.git/.graftlint.toml marker anywhere above tmp_path:
+    # the starting directory (not the file) must become the root, so
+    # violation relpaths stay real filenames and suppressions can match.
+    f = tmp_path / "markerless.py"
+    f.write_text(textwrap.dedent(BAD_SLEEP_LOOP))
+    root = core.repo_root_for(str(f))
+    if root == str(tmp_path):  # only meaningful when truly markerless
+        result = core.run_lint([str(f)], select=["retry-gate"])
+        assert [v.path for v in result.unsuppressed] == ["markerless.py"]
+
+
+def test_baseline_rejects_malformed_toml(tmp_path):
+    bl_path = tmp_path / ".graftlint.toml"
+    bl_path.write_text('version = 1\n\n[[suppress]]\ncheck = [unclosed\n')
+    with pytest.raises(baseline_mod.BaselineError):
+        baseline_mod.load(str(bl_path))
+
+
+def test_baseline_rejects_reasonless_entry(tmp_path):
+    bl_path = tmp_path / ".graftlint.toml"
+    bl_path.write_text(
+        'version = 1\n\n[[suppress]]\ncheck = "retry-gate"\npath = "x.py"\n'
+    )
+    with pytest.raises(baseline_mod.BaselineError, match="reason"):
+        baseline_mod.load(str(bl_path))
+
+
+# -------------------------------------------------------------- the real gate
+
+def test_graftlint_gate_repo_is_clean():
+    """THE tier-1 gate: ray_tpu/ lints clean against the checked-in
+    baseline, inside the budget, with no stale entries."""
+    bl = baseline_mod.load_default(REPO_ROOT)
+    assert bl is not None, ".graftlint.toml missing from the repo root"
+    for e in bl.entries:
+        assert e.reason.strip(), f"baseline entry without a reason: {e}"
+        assert not e.reason.lower().startswith("todo"), (
+            f"placeholder reason in checked-in baseline: {e}"
+        )
+    result = core.run_lint(
+        [os.path.join(REPO_ROOT, "ray_tpu")], root=REPO_ROOT, baseline=bl
+    )
+    assert result.parse_errors == []
+    assert result.unsuppressed == [], "\n".join(
+        v.format() for v in result.unsuppressed
+    )
+    assert result.unused_baseline == [], (
+        f"stale baseline entries: {result.unused_baseline}"
+    )
+    assert result.files_checked > 100  # the walk really covered the tree
+    assert result.elapsed_s < 30.0
+
+
+def test_graftlint_cli_entrypoint():
+    """`python -m ray_tpu.devtools.lint ray_tpu/` exits 0 (the exact
+    command verify.sh runs)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lint", "ray_tpu", "--strict"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_graftlint_cli_select_and_exit_code(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(textwrap.dedent(BAD_SLEEP_LOOP))
+    rc = cli_main([str(f), "--root", str(tmp_path), "--select", "retry-gate"])
+    assert rc == 1
+    rc = cli_main([str(f), "--root", str(tmp_path), "--select", "thread-lifecycle"])
+    assert rc == 0
+    assert cli_main(["--list-checks"]) == 0
+    assert cli_main([str(f), "--select", "not-a-check"]) == 2
