@@ -69,6 +69,8 @@ def init(
     ignore_reinit_error: bool = False,
     log_to_driver: bool = True,
     runtime_env: Optional[dict] = None,
+    tenant: Optional[str] = None,
+    priority: Optional[int] = None,
     _system_config: Optional[dict] = None,
     **kwargs,
 ):
@@ -91,6 +93,10 @@ def init(
             "resources": resources,
             "object_store_memory": object_store_memory,
             "_system_config": _system_config,
+            # Tenant identity binds at the client server's driver
+            # connection; a remote driver can't claim one yet.
+            "tenant": tenant,
+            "priority": priority,
         }
         bad = sorted(k for k, v in unsupported.items() if v is not None)
         bad += sorted(kwargs)  # unknown args, even explicit None
@@ -156,11 +162,29 @@ def init(
         from ray_tpu._private import runtime_env as _renv
 
         norm_env, _uploads = _renv.prepare(runtime_env)
+        # Multi-tenant job plane: every job carries a tenant (isolation/
+        # accounting domain) and a priority class.  The job-submission
+        # plane (dashboard job manager) passes them via env so submitted
+        # entrypoints inherit without code changes.
+        if tenant is None:
+            tenant = os.environ.get("RAY_TPU_TENANT") or None
+        if priority is None and os.environ.get("RAY_TPU_PRIORITY"):
+            try:
+                priority = int(os.environ["RAY_TPU_PRIORITY"])
+            except ValueError:
+                priority = None
         worker.connect_driver(
             gcs_address,
             raylet_address,
             namespace,
-            {"namespace": namespace or "", "runtime_env": norm_env or {}},
+            {
+                "namespace": namespace or "",
+                "runtime_env": norm_env or {},
+                "tenant": tenant or "default",
+                # None = unset: the GCS applies the tenant's registered
+                # default priority; an explicit value always wins.
+                "priority": int(priority) if priority is not None else None,
+            },
         )
         _renv.finish_uploads(worker.gcs_client, _uploads)
         worker.job_runtime_env = norm_env

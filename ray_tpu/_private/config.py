@@ -198,6 +198,28 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # the ping at its next report boundary — a too-small budget
     # misclassifies slow-but-alive ranks as casualties.
     "elastic_ping_timeout_s": 60.0,
+    # --- multi-tenant job plane (tenants.py; quotas + DRF fair share +
+    # priority preemption) ---
+    # Enforce registered per-tenant quotas at admission (GCS actors/PGs)
+    # and at raylet lease grants.  Off = tenants still get fair-share
+    # ordering and usage accounting, but no request is ever parked for
+    # quota.
+    "tenant_quota_enforcement": True,
+    # Backpressure bound: per-tenant cap on admissions parked for quota
+    # (actors waiting in the GCS quota queue).  Beyond it, registration
+    # fails fast with QuotaExceededError instead of queueing unboundedly.
+    "tenant_max_parked": 256,
+    # Cadence of the GCS "tenant_usage" publish (cluster-wide per-tenant
+    # usage + quotas + totals) that raylets use for DRF ordering.
+    "tenant_usage_publish_ms": 500,
+    # Priority preemption: how long higher-priority demand must sit
+    # starved (unplaceable) before the GCS preempts lower-priority /
+    # over-quota jobs through the drain+elastic path.
+    "preemption_grace_s": 5.0,
+    "preemption_check_period_ms": 500,
+    # Notice window a preempted job gets to checkpoint-and-shrink before
+    # the GCS escalates to graceful actor kill + restart-elsewhere.
+    "preemption_notice_deadline_s": 15.0,
     # --- logging ---
     "log_to_driver": True,
     # Worker-log tail period for the per-node log monitor.

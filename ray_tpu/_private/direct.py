@@ -198,6 +198,10 @@ class DirectTaskSubmitter:
                         "spilled": hops > 0,
                         "runtime_env": ks.runtime_env,
                         "token": token,
+                        # Tenant plane: the raylet's fair-share queue
+                        # orders and quota-gates by these.
+                        "tenant": self._worker.tenant,
+                        "priority": self._worker.tenant_priority,
                     },
                     timeout=CONFIG.worker_lease_timeout_ms / 1000,
                 )
@@ -340,6 +344,31 @@ class DirectTaskSubmitter:
             except Exception:
                 pass
             self._return_lease_to_raylet(lease.worker_id, lease.raylet)
+
+    def revoke(self, worker_id: bytes) -> None:
+        """Tenant-quota revocation from a raylet: stop feeding the named
+        lease and return it once its in-flight work drains (exactly the
+        draining-lease path — cooperative, never kills running tasks).
+        Replacement demand re-parks at the raylet under the quota gate,
+        so the queue keeps the pressure visible without re-acquiring."""
+        retire = None
+        with self._lock:
+            for ks in self._keys.values():
+                lease = ks.leases.get(worker_id)
+                if lease is None or lease.dead:
+                    continue
+                lease.draining = True
+                if not lease.inflight:
+                    ks.leases.pop(worker_id, None)
+                    lease.dead = True
+                    retire = lease
+                break
+        if retire is not None:
+            try:
+                retire.client.close()
+            except Exception:
+                pass
+            self._return_lease_to_raylet(retire.worker_id, retire.raylet)
 
     def _on_lease_lost(self, wid: bytes, ks: _KeyState) -> None:
         """The leased worker's connection dropped (worker crash, exit, or

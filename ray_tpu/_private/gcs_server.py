@@ -27,6 +27,7 @@ from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import rpc, telemetry
+from ray_tpu._private import tenants as tenants_mod
 from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import (
     ActorInfo,
@@ -80,6 +81,31 @@ class GcsServer:
         self.next_job_int = 1
         self.driver_conns: Dict[JobID, rpc.ClientConn] = {}
 
+        # --- multi-tenant job plane (tenants.py) ---
+        # Registered tenants (quota/weight/priority); persisted.
+        self.tenants: Dict[str, tenants_mod.TenantSpec] = {}
+        # Per-node per-tenant usage from raylet resource reports
+        # (ground truth: leases + actor workers + PG reservations).
+        self.tenant_usage_by_node: Dict[NodeID, Dict[str, dict]] = {}
+        # Parked lease demand per node, tenant/priority-tagged (the
+        # preemption monitor's direct-path starvation signal).
+        self.pending_tenant_demand: Dict[NodeID, list] = {}
+        # Optimistic admission ledger: (tenant, ResourceSet, time) for
+        # admissions granted since the last raylet reports landed —
+        # closes the report-lag window where two over-quota actors could
+        # both pass the usage check.  Entries decay after ~2 report
+        # periods.
+        self._tenant_admit_delta: List[Tuple[str, ResourceSet, float]] = []
+        # Actors parked at admission because their tenant is over quota
+        # (actor_id -> parked-since); subset of pending_actors.
+        self._quota_parked: Dict[ActorID, float] = {}
+        # First-seen time of each resource-starved pending actor (the
+        # preemption monitor's actor-path starvation signal).
+        self._pending_since: Dict[ActorID, float] = {}
+        # Priority preemption: per-victim-job notice time (escalation to
+        # graceful actor restart happens past the notice deadline).
+        self._preempt_notices: Dict[JobID, float] = {}
+
         # --- pubsub: channel -> set of conns ---
         self.subs: Dict[str, Set[rpc.ClientConn]] = defaultdict(set)
 
@@ -127,6 +153,8 @@ class GcsServer:
         metrics_mod.set_report_channel(self._local_report, b"__gcs__")
         await self.server.start()
         self._bg_tasks.append(self.loop.create_task(self._health_loop()))
+        self._bg_tasks.append(self.loop.create_task(self._tenant_usage_loop()))
+        self._bg_tasks.append(self.loop.create_task(self._preemption_loop()))
         if CONFIG.gcs_storage == "file":
             store = self._store()
             if store is not None:
@@ -206,6 +234,7 @@ class GcsServer:
             "kv": dict(self.kv),
             "jobs": self.jobs,
             "next_job_int": self.next_job_int,
+            "tenants": {n: s.to_dict() for n, s in self.tenants.items()},
         }
         store.save(pickle.dumps(state, protocol=5))
 
@@ -233,6 +262,10 @@ class GcsServer:
         self.kv = defaultdict(dict, state.get("kv", {}))
         self.jobs = state.get("jobs", {})
         self.next_job_int = state.get("next_job_int", 1)
+        self.tenants = {
+            n: tenants_mod.TenantSpec.from_dict(d)
+            for n, d in state.get("tenants", {}).items()
+        }
         grace = time.monotonic() + CONFIG.gcs_job_reattach_grace_s
         for job_id in self.jobs:
             self._job_reattach_deadline[job_id] = grace
@@ -392,6 +425,10 @@ class GcsServer:
         self.last_heartbeat[node_id] = time.monotonic()
         if node_id in self.nodes and self.nodes[node_id].state == "ALIVE":
             self.pending_shapes[node_id] = payload.get("pending_shapes", [])
+            self.tenant_usage_by_node[node_id] = payload.get("tenant_usage", {})
+            self.pending_tenant_demand[node_id] = payload.get(
+                "pending_tenant_demand", []
+            )
             self.available[node_id] = ResourceSet.of(payload["available"])
             if payload.get("total"):
                 self.nodes[node_id].resources_total = ResourceSet.of(payload["total"])
@@ -451,11 +488,16 @@ class GcsServer:
             return
         info.state = "DEAD"
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
-        if info.drain_reason == "PREEMPTION" and not info.is_head:
-            # Preempted capacity: the cluster WANTS this back.  Surface it
-            # to the autoscaler so a replacement launches even when no
-            # task demand is pending (an elastic trainer running shrunken
-            # queues nothing — it adapted instead of stalling).
+        # Capacity-return feed: preemption notices AND notice-less worker-
+        # node deaths (heartbeat-timeout DEAD) — both are capacity the
+        # cluster wants back.  Only planned idle scale-down
+        # (IDLE_TERMINATION) is excluded: that capacity left on purpose.
+        lost_reason = info.drain_reason or "NODE_DEATH"
+        if not info.is_head and lost_reason != "IDLE_TERMINATION":
+            # Surface it to the autoscaler so a replacement launches even
+            # when no task demand is pending (an elastic trainer running
+            # shrunken queues nothing — it adapted instead of stalling).
+            telemetry.count_lost_capacity(lost_reason)
             if len(self.lost_capacity) == self.lost_capacity.maxlen:
                 evicted = self.lost_capacity[0]
                 logger.warning(
@@ -468,12 +510,14 @@ class GcsServer:
                 {
                     "node_id": node_id.hex(),
                     "resources_total": dict(info.resources_total),
-                    "reason": info.drain_reason,
+                    "reason": lost_reason,
                     "time": time.time(),
                 }
             )
         self.available.pop(node_id, None)
         self.pending_shapes.pop(node_id, None)
+        self.tenant_usage_by_node.pop(node_id, None)
+        self.pending_tenant_demand.pop(node_id, None)
         client = self.node_clients.pop(node_id, None)
         if client:
             client.close()
@@ -683,13 +727,24 @@ class GcsServer:
     async def rpc_register_driver(self, payload, conn):
         job_id = JobID.from_int(self.next_job_int)
         self.next_job_int += 1
+        config = payload.get("config", {})
+        tenant = tenants_mod.normalize_tenant(config.get("tenant"))
+        # Priority resolution: an explicit per-job priority wins; a job
+        # that didn't set one inherits its tenant's registered default.
+        if config.get("priority") is not None:
+            priority = int(config["priority"])
+        else:
+            spec = self.tenants.get(tenant)
+            priority = spec.priority if spec is not None else 0
         self.jobs[job_id] = {
             "job_id": job_id.binary(),
             "state": "RUNNING",
             "start_time": time.time(),
             "namespace": payload.get("namespace") or f"anon_{job_id.hex()}",
             "entrypoint": payload.get("entrypoint", ""),
-            "config": payload.get("config", {}),
+            "config": config,
+            "tenant": tenant,
+            "priority": priority,
         }
         conn.meta["job_id"] = job_id
         self.driver_conns[job_id] = conn
@@ -699,6 +754,11 @@ class GcsServer:
             "job_id": job_id.binary(),
             "namespace": self.jobs[job_id]["namespace"],
             "session_info": self.session_info,
+            # Effective tenant identity (tenant-default priority applied)
+            # so the driver stamps the SAME priority on its lease requests
+            # that the GCS uses for preemption decisions.
+            "tenant": tenant,
+            "priority": priority,
         }
 
     async def rpc_reattach_driver(self, payload, conn):
@@ -721,6 +781,7 @@ class GcsServer:
         job["end_time"] = time.time()
         self.driver_conns.pop(job_id, None)
         self._job_reattach_deadline.pop(job_id, None)
+        self._preempt_notices.pop(job_id, None)
         self._dirty()
         self.publish("jobs", ("FINISHED", job_id.binary()))
         # Kill this job's non-detached actors.
@@ -754,10 +815,337 @@ class GcsServer:
 
     async def rpc_get_job_config(self, payload, conn):
         job = self.jobs.get(JobID(payload))
-        return job["config"] if job else {}
+        if not job:
+            return {}
+        # Overlay the EFFECTIVE tenant identity (tenant-default priority
+        # resolved at registration) so raylets that fetch the config for
+        # remote-node worker spawns stamp the same values the scheduler
+        # uses.
+        return dict(
+            job["config"],
+            tenant=job.get("tenant", "default"),
+            priority=job.get("priority", 0),
+        )
 
     async def rpc_list_jobs(self, payload, conn):
         return [dict(j, job_id=j["job_id"]) for j in self.jobs.values()]
+
+    # ------------------------------------------------------------------
+    # multi-tenant job plane: quota registry, usage aggregation, fair
+    # shares, priority preemption (tenants.py holds the pure math)
+    # ------------------------------------------------------------------
+    def _job_tenant_priority(self, job_id: Optional[JobID]) -> Tuple[str, int]:
+        job = self.jobs.get(job_id) if job_id is not None else None
+        if not job:
+            return tenants_mod.DEFAULT_TENANT, 0
+        return (
+            tenants_mod.normalize_tenant(job.get("tenant")),
+            int(job.get("priority", 0)),
+        )
+
+    def _cluster_totals(self) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        for info in self.nodes.values():
+            if info.state in ("ALIVE", "DRAINING"):
+                for k, v in info.resources_total.items():
+                    totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    def _aggregate_tenant_usage(self) -> Dict[str, Dict[str, float]]:
+        """Cluster-wide per-tenant usage: the sum of raylet-reported
+        usage over live nodes, plus the optimistic ledger of admissions
+        younger than one report period (closes the window where two
+        over-quota admissions could both pass the check against a stale
+        report)."""
+        usage: Dict[str, Dict[str, float]] = {}
+        for node_id, per_tenant in self.tenant_usage_by_node.items():
+            info = self.nodes.get(node_id)
+            if info is None or info.state not in ("ALIVE", "DRAINING"):
+                continue
+            for tenant, res in per_tenant.items():
+                tenants_mod.add_usage(usage, tenant, res)
+        now = time.monotonic()
+        self._tenant_admit_delta = [
+            (t, r, ts) for (t, r, ts) in self._tenant_admit_delta if now - ts < 1.0
+        ]
+        for tenant, res, _ts in self._tenant_admit_delta:
+            tenants_mod.add_usage(usage, tenant, res)
+        return usage
+
+    def _tenant_over_quota(
+        self, tenant: str, extra: Optional[dict], usage: Optional[dict] = None
+    ) -> bool:
+        """``usage`` lets per-tick loops aggregate once and pass it down
+        (aggregation walks every node's report; it's identical within a
+        tick)."""
+        if not CONFIG.tenant_quota_enforcement:
+            return False
+        spec = self.tenants.get(tenant)
+        if spec is None or not spec.quota:
+            return False
+        if usage is None:
+            usage = self._aggregate_tenant_usage()
+        return tenants_mod.over_quota(usage.get(tenant), extra, spec.quota)
+
+    def _note_admission(self, tenant: str, res: ResourceSet):
+        if res:
+            self._tenant_admit_delta.append((tenant, res.copy(), time.monotonic()))
+
+    async def rpc_tenant_set_quota(self, payload, conn):
+        """Register (or update) a tenant: quota resources, DRF weight,
+        default priority.  Idempotent; publishing the refreshed view
+        wakes parked admissions and raylet lease queues."""
+        name = tenants_mod.normalize_tenant(payload.get("tenant"))
+        spec = self.tenants.get(name) or tenants_mod.TenantSpec(name=name)
+        if payload.get("quota") is not None:
+            spec.quota = ResourceSet.of(payload["quota"])
+        if payload.get("weight") is not None:
+            spec.weight = float(payload["weight"]) or 1.0
+        if payload.get("priority") is not None:
+            spec.priority = int(payload["priority"])
+        self.tenants[name] = spec
+        self._dirty()
+        self._publish_tenant_usage()
+        self._kick_pending()
+        return spec.to_dict()
+
+    async def rpc_get_tenant(self, payload, conn):
+        name = tenants_mod.normalize_tenant(payload)
+        spec = self.tenants.get(name)
+        usage = self._aggregate_tenant_usage()
+        out = spec.to_dict() if spec else {
+            "name": name, "quota": {}, "weight": 1.0, "priority": 0,
+        }
+        out["usage"] = usage.get(name, {})
+        out["dominant_share"] = tenants_mod.dominant_share(
+            usage.get(name), self._cluster_totals(), out["weight"]
+        )
+        return out
+
+    async def rpc_list_tenants(self, payload, conn):
+        usage = self._aggregate_tenant_usage()
+        totals = self._cluster_totals()
+        names = set(self.tenants) | set(usage)
+        out = []
+        for name in sorted(names):
+            spec = self.tenants.get(name)
+            d = spec.to_dict() if spec else {
+                "name": name, "quota": {}, "weight": 1.0, "priority": 0,
+            }
+            d["usage"] = usage.get(name, {})
+            d["dominant_share"] = tenants_mod.dominant_share(
+                usage.get(name), totals, d["weight"]
+            )
+            d["parked"] = sum(
+                1
+                for aid in self._quota_parked
+                if aid in self.actors
+                and self._job_tenant_priority(aid.job_id())[0] == name
+            )
+            out.append(d)
+        return out
+
+    def _publish_tenant_usage(self):
+        """Broadcast the cluster-wide tenant view (usage + specs +
+        totals) so raylets converge on the same DRF ordering and quota
+        decisions; also exports the tenant gauges."""
+        usage = self._aggregate_tenant_usage()
+        totals = self._cluster_totals()
+        self.publish(
+            "tenant_usage",
+            {
+                "usage": usage,
+                "totals": totals,
+                "tenants": {n: s.to_dict() for n, s in self.tenants.items()},
+            },
+        )
+        # Aggregate per LABEL before setting the gauges: multiple
+        # unregistered tenants share the "other" label, and last-write-
+        # wins gauges would otherwise report one arbitrary tenant's
+        # value instead of their sum.
+        registered = set(self.tenants)
+        label_usage: Dict[str, Dict[str, float]] = {}
+        for tenant in set(usage) | registered:
+            label = tenants_mod.tenant_label(tenant, registered)
+            acc = label_usage.setdefault(label, {})
+            for r, v in (usage.get(tenant) or {}).items():
+                rl = tenants_mod.resource_label(r)
+                acc[rl] = acc.get(rl, 0.0) + v
+        for label, by_res in label_usage.items():
+            spec = self.tenants.get(label)
+            telemetry.set_tenant_dominant_share(
+                label,
+                tenants_mod.dominant_share(
+                    by_res, totals, spec.weight if spec else 1.0
+                ),
+            )
+            for rl, v in by_res.items():
+                telemetry.set_tenant_usage(label, rl, v)
+
+    async def _tenant_usage_loop(self):
+        period = CONFIG.tenant_usage_publish_ms / 1000
+        while True:
+            await asyncio.sleep(period)
+            try:
+                self._publish_tenant_usage()
+            except Exception:
+                logger.exception("tenant usage publish failed")
+
+    # ---- priority preemption ----------------------------------------
+    def _starved_demands(self) -> List[dict]:
+        """Demand that has sat unplaceable past the preemption grace:
+        resource-starved pending actors (not quota-parked — a tenant over
+        its own quota earned its wait) and tenant-tagged lease demand
+        reported by raylets."""
+        now = time.monotonic()
+        grace = float(CONFIG.preemption_grace_s)
+        out: List[dict] = []
+        usage = self._aggregate_tenant_usage()  # once per tick, passed down
+        for actor_id, since in self._pending_since.items():
+            if now - since < grace or actor_id in self._quota_parked:
+                continue
+            info = self.actors.get(actor_id)
+            if info is None or info.state not in ("PENDING_CREATION", "RESTARTING"):
+                continue
+            if info.node_id is not None:
+                # Placed, creation in flight (possibly a long __init__):
+                # not starved — only actors BETWEEN homes count.
+                continue
+            tenant, priority = self._job_tenant_priority(actor_id.job_id())
+            if self._tenant_over_quota(
+                tenant,
+                dict(info.creation_spec.resources) if info.creation_spec else None,
+                usage=usage,
+            ):
+                continue
+            out.append(
+                {"tenant": tenant, "priority": priority,
+                 "resources": dict(info.creation_spec.resources)
+                 if info.creation_spec else {}}
+            )
+        for node_id, demands in self.pending_tenant_demand.items():
+            info = self.nodes.get(node_id)
+            if info is None or info.state != "ALIVE":
+                continue
+            for d in demands:
+                if float(d.get("age_s", 0.0)) < grace:
+                    continue
+                tenant = tenants_mod.normalize_tenant(d.get("tenant"))
+                if self._tenant_over_quota(tenant, d.get("shape"), usage=usage):
+                    continue
+                out.append(
+                    {"tenant": tenant, "priority": int(d.get("priority", 0)),
+                     "resources": d.get("shape", {})}
+                )
+        return out
+
+    async def _preemption_loop(self):
+        period = CONFIG.preemption_check_period_ms / 1000
+        while True:
+            await asyncio.sleep(period)
+            try:
+                await self._preemption_tick()
+            except Exception:
+                logger.exception("preemption tick failed")
+
+    async def _preemption_tick(self):
+        starved = self._starved_demands()
+        if not starved:
+            # Episode over: clear notice state so the NEXT starvation
+            # starts with a fresh cooperative notice — a stale timestamp
+            # would make it skip straight to the actor-kill escalation.
+            if self._preempt_notices:
+                self._preempt_notices.clear()
+            return
+        top = max(s["priority"] for s in starved)
+        # Victims: RUNNING jobs whose priority is strictly below the
+        # starved demand's.  Over-quota tenants first, then highest
+        # dominant share, then lowest priority, then youngest job.
+        victims = [
+            dict(j, _job_id=jid)
+            for jid, j in self.jobs.items()
+            if j.get("state") == "RUNNING" and int(j.get("priority", 0)) < top
+        ]
+        if not victims:
+            return
+        usage = self._aggregate_tenant_usage()
+        totals = self._cluster_totals()
+        ordered = tenants_mod.preemption_victim_order(
+            victims, usage, totals, self.tenants
+        )
+        registered = set(self.tenants)
+        now = time.monotonic()
+        notice_deadline = float(CONFIG.preemption_notice_deadline_s)
+        for job in ordered:
+            job_id = job["_job_id"]
+            tenant = tenants_mod.normalize_tenant(job.get("tenant"))
+            label = tenants_mod.tenant_label(tenant, registered)
+            noticed = self._preempt_notices.get(job_id)
+            if noticed is None:
+                # Phase 1: cooperative notice.  An elastic trainer
+                # checkpoints and shrinks (releasing workers); anything
+                # else gets the escalation below after the deadline.
+                release = max(1, sum(1 for s in starved if s["priority"] == top))
+                conn = self.driver_conns.get(job_id)
+                if conn is not None and not conn.closed:
+                    try:
+                        conn.push(
+                            "preempt_job",
+                            {
+                                "reason": (
+                                    f"priority-{top} demand starved; this job "
+                                    f"(priority {job.get('priority', 0)}) is "
+                                    "being preempted"
+                                ),
+                                "deadline_s": notice_deadline,
+                                "release_workers": release,
+                                # Clamped against the registry HERE so the
+                                # driver-side shrink counter lands on the
+                                # same label as notice/actor_restart.
+                                "tenant_label": label,
+                            },
+                        )
+                    except Exception:
+                        pass
+                self._preempt_notices[job_id] = now
+                telemetry.count_tenant_preemption(label, "notice")
+                logger.warning(
+                    "preempting job %s (tenant %s, priority %s): notice "
+                    "pushed, escalation in %.0fs",
+                    job_id.hex()[:8], tenant, job.get("priority", 0),
+                    notice_deadline,
+                )
+                return  # one victim per tick: give the notice time to act
+            if now - noticed < notice_deadline:
+                return  # notice still pending; don't pile on
+            # Phase 2: escalation — graceful kill + restart-elsewhere of
+            # ONE restartable actor per tick (never a raw kill: the
+            # restart re-enters admission, where fair-share/quota decide
+            # where — and whether — it lands).
+            for actor in list(self.actors.values()):
+                if (
+                    actor.actor_id.job_id() == job_id
+                    and actor.state == "ALIVE"
+                    and (
+                        actor.max_restarts == -1
+                        or actor.num_restarts < actor.max_restarts
+                    )
+                ):
+                    telemetry.count_tenant_preemption(label, "actor_restart")
+                    logger.warning(
+                        "preemption escalation: restarting actor %s of job "
+                        "%s elsewhere", actor.actor_id.hex()[:8],
+                        job_id.hex()[:8],
+                    )
+                    self._preempt_notices[job_id] = now  # re-arm the pacing
+                    await self._kill_actor(
+                        actor,
+                        "preempted by higher-priority demand",
+                        no_restart=False,
+                    )
+                    return
+        # All victims noticed and nothing left to escalate: let notices
+        # expire naturally (demand may clear via other capacity).
 
     # ------------------------------------------------------------------
     # kv store (function table, runtime envs, user internal kv)
@@ -896,6 +1284,30 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def rpc_register_actor(self, payload, conn):
         spec: TaskSpec = payload["spec"]
+        # Tenant backpressure: an over-quota tenant's actors PARK (the
+        # quota queue) — but only up to tenant_max_parked of them.
+        # Beyond that the registration fails fast with a typed error
+        # instead of queueing unboundedly.
+        tenant, _prio = self._job_tenant_priority(spec.job_id)
+        if (
+            CONFIG.tenant_quota_enforcement
+            and spec.scheduling_strategy.kind != "PLACEMENT_GROUP"
+            and self._tenant_over_quota(tenant, dict(spec.resources))
+        ):
+            parked = sum(
+                1
+                for aid in self._quota_parked
+                if aid in self.actors
+                and self._job_tenant_priority(aid.job_id())[0] == tenant
+            )
+            if parked >= int(CONFIG.tenant_max_parked):
+                from ray_tpu import exceptions
+
+                raise exceptions.QuotaExceededError(
+                    f"tenant {tenant!r} is over quota with "
+                    f"{parked} admission(s) already parked "
+                    f"(tenant_max_parked={CONFIG.tenant_max_parked})"
+                )
         info = ActorInfo(
             actor_id=spec.actor_id,
             name=spec.actor_name,
@@ -947,10 +1359,48 @@ class GcsServer:
         candidates.sort(reverse=True)
         return candidates[0][2]
 
-    async def _schedule_actor(self, info: ActorInfo):
+    def _park_pending(self, info: ActorInfo):
+        """Park an actor between homes (resource- or quota-starved): it
+        waits in pending_actors for the next _kick_pending.  Clearing the
+        placement keeps a dead node's sweep (or a stale death report)
+        from failing it while it waits."""
+        info.node_id = None
+        info.raylet_address = None
+        if info.actor_id not in self.pending_actors:
+            self.pending_actors.append(info.actor_id)
+        self._pending_since.setdefault(info.actor_id, time.monotonic())
+
+    def _unpark_pending(self, info: ActorInfo):
+        self._pending_since.pop(info.actor_id, None)
+        self._quota_parked.pop(info.actor_id, None)
+
+    async def _schedule_actor(self, info: ActorInfo, usage: Optional[dict] = None):
         spec = info.creation_spec
         strategy = spec.scheduling_strategy
         resources = spec.resources
+        tenant, _prio = self._job_tenant_priority(info.actor_id.job_id())
+        # Quota admission (non-PG actors only: a PG-scheduled actor's
+        # resources were already charged to the tenant at bundle
+        # reservation — gating it again would double-count).  Over-quota
+        # actors PARK with backpressure; usage falling below quota (or a
+        # raised quota) re-schedules them via _kick_pending, which
+        # aggregates usage once per kick and passes it in.
+        if (
+            strategy.kind != "PLACEMENT_GROUP"
+            and self._tenant_over_quota(tenant, dict(resources), usage=usage)
+        ):
+            if info.actor_id not in self._quota_parked:
+                self._quota_parked[info.actor_id] = time.monotonic()
+                telemetry.count_tenant_parked(
+                    tenants_mod.tenant_label(tenant, self.tenants), "quota"
+                )
+                logger.info(
+                    "actor %s parked: tenant %r over quota",
+                    info.actor_id.hex()[:8], tenant,
+                )
+            self._park_pending(info)
+            return
+        self._quota_parked.pop(info.actor_id, None)
         if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group_id is not None:
             pg = self.placement_groups.get(strategy.placement_group_id)
             if pg is None:
@@ -979,13 +1429,7 @@ class GcsServer:
             node_id = self._pick_node(resources, strategy)
         if node_id is None:
             # No node fits now — queue and retry when resources change.
-            # The actor is between homes: clear its placement so a dead
-            # node's sweep (or a stale death report from the old host)
-            # can't fail/restart it again while it waits.
-            info.node_id = None
-            info.raylet_address = None
-            if info.actor_id not in self.pending_actors:
-                self.pending_actors.append(info.actor_id)
+            self._park_pending(info)
             return
         client = self.node_clients.get(node_id)
         if client is None:
@@ -996,17 +1440,30 @@ class GcsServer:
         info.state = "PENDING_CREATION"
         # Optimistically deduct from the GCS view so concurrent scheduling
         # decisions don't over-commit one node; the next resource report
-        # replaces the view with the raylet's ground truth.
+        # replaces the view with the raylet's ground truth.  The tenant
+        # admission ledger gets the same optimistic entry so a burst of
+        # admissions can't all pass the quota check against stale usage.
         avail = self.available.get(node_id)
         if avail is not None and spec.scheduling_strategy.kind != "PLACEMENT_GROUP":
             avail.subtract(resources)
+        if spec.scheduling_strategy.kind != "PLACEMENT_GROUP":
+            self._note_admission(tenant, resources)
+            if usage is not None:
+                # The kick batch shares this snapshot: later actors in
+                # the same batch must see this admission or a burst of
+                # one tenant's parked actors would all pass the quota
+                # check against the pre-batch usage.
+                tenants_mod.add_usage(usage, tenant, dict(resources))
         try:
             # Unbounded: actor __init__ may legitimately take a long time;
             # worker death is reported separately.
-            result = await client.call("create_actor", {"spec": spec}, timeout=None)
+            result = await client.call(
+                "create_actor", {"spec": spec, "tenant": tenant}, timeout=None
+            )
             info.pid = result.get("pid", 0)
             info.worker_address = result.get("worker_address")
             info.state = "ALIVE"
+            self._unpark_pending(info)
             self.publish("actors", self._actor_dict(info))
             self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
         except Exception as e:  # creation failed
@@ -1027,20 +1484,43 @@ class GcsServer:
                 # node).  Queue and retry when the view refreshes — the
                 # reference never fails an actor for transient resource
                 # shortage (gcs_actor_scheduler retries leases).
-                info.node_id = None
-                info.raylet_address = None
-                if info.actor_id not in self.pending_actors:
-                    self.pending_actors.append(info.actor_id)
+                self._park_pending(info)
                 self.loop.call_later(0.2, self._kick_pending)
                 return
             await self._on_actor_failure(info, f"creation failed: {e}")
 
     def _kick_pending(self):
         pending, self.pending_actors = self.pending_actors, []
+        # Fair-share scheduling order across tenants: ascending DRF
+        # dominant share first (weighted), then priority class within,
+        # then FIFO.  The tenant with the least of its fair share gets
+        # the freed resources — this is what makes shares converge
+        # cluster-wide instead of first-come-first-served.  Usage is
+        # aggregated ONCE here and passed down: _kick_pending fires on
+        # every resource report while actors are pending, and each
+        # _schedule_actor re-walking every node report would be
+        # O(actors x nodes) per tick.
+        usage = self._aggregate_tenant_usage() if pending else None
+        if len(pending) > 1:
+            totals = self._cluster_totals()
+            order = {aid: i for i, aid in enumerate(pending)}
+
+            def fair_key(actor_id):
+                tenant, priority = self._job_tenant_priority(actor_id.job_id())
+                spec = self.tenants.get(tenant)
+                return (
+                    tenants_mod.dominant_share(
+                        usage.get(tenant), totals, spec.weight if spec else 1.0
+                    ),
+                    -priority,
+                    order[actor_id],
+                )
+
+            pending.sort(key=fair_key)
         for actor_id in pending:
             info = self.actors.get(actor_id)
             if info and info.state in ("PENDING_CREATION", "RESTARTING"):
-                self.loop.create_task(self._schedule_actor(info))
+                self.loop.create_task(self._schedule_actor(info, usage=usage))
         for pg in self.placement_groups.values():
             if pg.state == "PENDING" and getattr(pg, "_queued", False):
                 pg._queued = False
@@ -1080,6 +1560,7 @@ class GcsServer:
     async def _fail_actor(self, info: ActorInfo, reason: str):
         info.state = "DEAD"
         info.death_cause = reason
+        self._unpark_pending(info)
         self.publish("actors", self._actor_dict(info))
         self.publish(f"actor:{info.actor_id.hex()}", self._actor_dict(info))
 
@@ -1228,6 +1709,29 @@ class GcsServer:
     async def _schedule_pg(self, pg: PlacementGroupInfo):
         if pg.state == "REMOVED":
             return  # removed while queued
+        # Quota admission: the whole group's reservation charges its
+        # creator's tenant.  Over quota -> the PG parks PENDING (the
+        # creating client polls/waits; placement_group.wait is the
+        # backpressure surface) and retries via _kick_pending when usage
+        # falls or the quota rises.
+        tenant, _prio = self._job_tenant_priority(pg.creator_job)
+        pg_total: Dict[str, float] = {}
+        for b in pg.bundles:
+            for k, v in b.resources.items():
+                pg_total[k] = pg_total.get(k, 0.0) + v
+        if self._tenant_over_quota(tenant, pg_total):
+            if not getattr(pg, "_quota_parked", False):
+                pg._quota_parked = True
+                telemetry.count_tenant_parked(
+                    tenants_mod.tenant_label(tenant, self.tenants), "quota"
+                )
+                logger.info(
+                    "PG %s parked: tenant %r over quota",
+                    pg.pg_id.hex()[:8], tenant,
+                )
+            pg._queued = True  # retried by _kick_pending
+            return
+        pg._quota_parked = False
         assignment = self._pg_node_assignment(pg)
         if assignment is None:
             pg._queued = True  # retried by _kick_pending
@@ -1243,7 +1747,9 @@ class GcsServer:
             try:
                 res = await client.call(
                     "prepare_bundle",
-                    {"pg_id": pg.pg_id.binary(), "bundle_index": idx, "resources": dict(pg.bundles[idx].resources)},
+                    {"pg_id": pg.pg_id.binary(), "bundle_index": idx,
+                     "resources": dict(pg.bundles[idx].resources),
+                     "tenant": tenant},
                 )
                 if not res:
                     ok = False
@@ -1257,6 +1763,7 @@ class GcsServer:
             if pg.state != "REMOVED":
                 pg._queued = True
             return
+        self._note_admission(tenant, ResourceSet.of(pg_total))
         # Phase 2: commit.  A failed/lost commit (node died, reply dropped)
         # must not leave the PG wedged in PENDING: roll every bundle back
         # and requeue the whole group (commit_bundle and return_bundle are
@@ -1337,7 +1844,8 @@ class GcsServer:
                     r = await client.call(
                         "prepare_bundle",
                         {"pg_id": pg.pg_id.binary(), "bundle_index": idx,
-                         "resources": dict(res)},
+                         "resources": dict(res),
+                         "tenant": self._job_tenant_priority(pg.creator_job)[0]},
                     )
                 except Exception:
                     continue

@@ -27,6 +27,7 @@ from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import retry, rpc, runtime_env as runtime_env_mod, serialization, telemetry
+from ray_tpu._private import tenants as tenants_mod
 from ray_tpu._private.chaos import CHAOS
 from ray_tpu._private.common import ResourceSet, TaskSpec
 from ray_tpu._private.config import CONFIG
@@ -45,7 +46,7 @@ class WorkerHandle:
         "worker_id", "pid", "proc", "conn", "job_id", "state", "actor_id",
         "running", "spawn_time", "idle_since", "resources_held", "bundle_key",
         "direct_address", "lease_owner", "lease_blocked", "reserved",
-        "env_hash", "log_path", "spawn_token",
+        "env_hash", "log_path", "spawn_token", "tenant", "detached",
     )
 
     def __init__(self, worker_id: WorkerID, proc, job_id: JobID):
@@ -82,6 +83,11 @@ class WorkerHandle:
         self.log_path: Optional[str] = None
         # held host-wide spawn-gate slot fd while STARTING (actors only)
         self.spawn_token: Optional[int] = None
+        # Tenant the resources this worker holds are charged to (the
+        # job's tenant; leases override with the lease request's).
+        self.tenant: str = tenants_mod.DEFAULT_TENANT
+        # Detached-actor worker: survives its creating job's teardown.
+        self.detached = False
 
 
 class Raylet:
@@ -171,10 +177,34 @@ class Raylet:
         # Objects being pulled: oid bytes -> future
         self.pulls: Dict[bytes, asyncio.Future] = {}
 
-        # Parked worker-lease requests: FIFO of (ResourceSet, future),
-        # granted as resources free up (reference: lease request queue in
-        # cluster_task_manager).
+        # Parked worker-lease requests (tenants.LeaseWaiter), granted as
+        # resources free up in weighted-DRF fair-share order: per tenant
+        # only the best (priority, FIFO) waiter is a candidate, tenants
+        # are served ascending dominant share, and a tenant over its
+        # registered quota is skipped until usage falls (reference: the
+        # lease request queue in cluster_task_manager, upgraded from
+        # pure FIFO for the multi-tenant job plane).
         self.lease_waiters: deque = deque()
+        self._lease_seq = 0
+        # Cluster-wide tenant view from the GCS "tenant_usage" publish:
+        # per-tenant usage, resource totals, registered tenant specs.
+        self.tenant_specs: Dict[str, tenants_mod.TenantSpec] = {}
+        self.cluster_tenant_usage: Dict[str, dict] = {}
+        self.cluster_resource_totals: Dict[str, float] = {}
+        # This node's contribution to the last usage report, replaced by
+        # live local truth when computing effective usage (so local
+        # grants are visible immediately, not one publish later).
+        self._published_tenant_usage: Dict[str, dict] = {}
+        # Leases already asked back by quota reconciliation (one revoke
+        # push per lease; cleared when the lease returns or dies).
+        self._revoked_leases: Set[WorkerID] = set()
+        self._reconcile_tick = 0
+        # In-flight lease grants per tenant: resources debited from the
+        # pool but not yet visible as a LEASED worker's resources_held
+        # (the grant awaits worker readiness in between).  Without this,
+        # a burst of concurrent requests all pass the quota check
+        # against the same pre-burst usage.
+        self._inflight_lease_usage: Dict[str, ResourceSet] = {}
 
         # Idempotency (at-least-once RPC discipline — see
         # docs/failure_semantics.md).  A duplicated submit_task must not
@@ -459,6 +489,7 @@ class Raylet:
         await client.call("register_node", self._register_payload())
         await client.call("subscribe", "resources")
         await client.call("subscribe", "nodes")
+        await client.call("subscribe", "tenant_usage")
         self.gcs = client
 
     def _on_gcs_lost(self):
@@ -530,6 +561,7 @@ class Raylet:
 
     def _kill_worker_proc(self, w: WorkerHandle):
         w.state = "DEAD"
+        self._revoked_leases.discard(w.worker_id)
         self._release_spawn_token(w)
         self._kick_spawn_gate()
         self.workers.pop(w.worker_id, None)
@@ -567,6 +599,18 @@ class Raylet:
                     # — drop it from the spill/spillback candidate view
                     # (objects are still pulled from it via GCS locations).
                     self.cluster_view.pop(nb, None)
+            elif channel == "tenant_usage":
+                # Cluster-wide tenant view: refresh and re-run the grant
+                # loop — usage falling (or a raised quota) elsewhere may
+                # unblock parked waiters here.
+                self.cluster_tenant_usage = msg.get("usage", {})
+                self.cluster_resource_totals = msg.get("totals", {})
+                self.tenant_specs = {
+                    n: tenants_mod.TenantSpec.from_dict(d)
+                    for n, d in msg.get("tenants", {}).items()
+                }
+                self._grant_lease_waiters()
+                self._schedule_dispatch()
         # NOTE: kill_actor/job_finished/store_free arrive via the GCS's
         # node client as push_* handlers below, not on this channel.
 
@@ -594,6 +638,20 @@ class Raylet:
                 for k, v in self._unmet_lease_demand.items()
                 if now - v[1] < 15.0  # retries refresh live demand
             }
+            # Per-node drain budget gauges (this process's report channel
+            # is keyed by node id at the GCS — no node label needed).
+            if self.draining:
+                telemetry.set_drain_budget(
+                    self.drain_deadline - time.time(),
+                    sum(len(w.running) for w in self.workers.values()),
+                )
+            self._reconcile_tick += 1
+            if self._reconcile_tick % 5 == 0:  # ~1 s cadence on 0.2 s ticks
+                try:
+                    self._reconcile_tenant_quotas()
+                except Exception:
+                    logger.exception("tenant quota reconciliation failed")
+            local_tenant_usage = self._local_tenant_usage()
             try:
                 await self.gcs.call(
                     "resource_report",
@@ -602,6 +660,22 @@ class Raylet:
                         "available": dict(self.resources_available),
                         "total": dict(self.resources_total),
                         "has_pending": bool(self.queue or self.infeasible),
+                        # Per-tenant resources held here (leases + actor
+                        # workers + PG reservations): the GCS aggregates
+                        # these into the cluster-wide fair-share view.
+                        "tenant_usage": local_tenant_usage,
+                        # Tenant/priority-tagged parked lease demand: the
+                        # preemption monitor's starvation signal for the
+                        # direct submission path.
+                        "pending_tenant_demand": [
+                            {
+                                "shape": dict(w.res),
+                                "tenant": w.tenant,
+                                "priority": w.priority,
+                                "age_s": now - w.enqueued,
+                            }
+                            for w in list(self.lease_waiters)[:32]
+                        ],
                         # resource shapes of queued/infeasible work — the
                         # autoscaler's demand signal (reference:
                         # resource_load_by_shape in ray_syncer reports)
@@ -617,10 +691,11 @@ class Raylet:
                             dict(shape)
                             for shape, _t in self._unmet_lease_demand.values()
                         ][:32]
-                        + [dict(res) for res, _f in list(self.lease_waiters)[:32]],
+                        + [dict(w.res) for w in list(self.lease_waiters)[:32]],
                     },
                     timeout=10,
                 )
+                self._published_tenant_usage = local_tenant_usage
             except rpc.RpcError:
                 pass
             # Periodically retry infeasible tasks (cluster membership or
@@ -716,6 +791,15 @@ class Raylet:
         # Unbuffered so user prints reach the log file (and the driver's
         # log stream) as they happen, not at process exit.
         env["PYTHONUNBUFFERED"] = "1"
+        # Tenant isolation: the worker inherits its job's tenant so work
+        # it submits (nested tasks, leases) is charged to the same
+        # tenant as the driver's.
+        job_tenant = (self.job_configs.get(job_id) or {}).get("tenant")
+        if job_tenant:
+            env["RAY_TPU_TENANT"] = str(job_tenant)
+            env["RAY_TPU_TENANT_PRIORITY"] = str(
+                (self.job_configs.get(job_id) or {}).get("priority") or 0
+            )
         if self.session_dir:
             env["RAY_TPU_SESSION_DIR"] = self.session_dir
         if runtime_env:
@@ -738,6 +822,7 @@ class Raylet:
         w.actor_id = actor_id
         w.env_hash = runtime_env_mod.env_hash(runtime_env)
         w.log_path = log_path
+        w.tenant = tenants_mod.normalize_tenant(job_tenant)
         self.workers[worker_id] = w
         return w
 
@@ -853,6 +938,7 @@ class Raylet:
 
     async def _on_worker_death(self, w: WorkerHandle):
         w.state = "DEAD"
+        self._revoked_leases.discard(w.worker_id)
         self.workers.pop(w.worker_id, None)
         for dq in self.idle_workers.values():
             if w in dq:
@@ -918,7 +1004,11 @@ class Raylet:
 
     def _on_job_finished(self, job_id: JobID):
         for w in list(self.workers.values()):
-            if w.job_id == job_id:
+            # Detached-actor workers outlive their creating job (their
+            # lifetime belongs to the namespace, not the driver; the GCS
+            # kills them only via an explicit ray.kill) — everything
+            # else of the job is reaped.
+            if w.job_id == job_id and not (w.actor_id is not None and w.detached):
                 self._kill_worker_proc(w)
         for key in [k for k in self.idle_workers if k[0] == job_id]:
             self.idle_workers.pop(key, None)
@@ -1246,6 +1336,27 @@ class Raylet:
         return None
 
     def _push_task_to_worker(self, w: WorkerHandle, spec: TaskSpec):
+        if spec.job_id != w.job_id:
+            # Tenant/job isolation invariant: a worker process only ever
+            # executes its own job's code (the idle pools are keyed by
+            # (job, env) so this cannot happen structurally — this guard
+            # keeps a future pooling bug from becoming a cross-tenant
+            # code-execution hole instead of an error).
+            from ray_tpu import exceptions
+
+            logger.error(
+                "isolation violation blocked: task %s of job %s routed to "
+                "worker %s of job %s", spec.name, spec.job_id.hex()[:8],
+                w.worker_id.hex()[:12], w.job_id.hex()[:8],
+            )
+            self._fail_spec_with_error(
+                spec,
+                exceptions.RaySystemError(
+                    f"scheduler isolation violation: task {spec.name} routed "
+                    "to a worker of another job"
+                ),
+            )
+            return
         w.state = "BUSY" if w.actor_id is None else "ACTOR"
         w.running[spec.task_id.binary()] = spec
         w.resources_held.add(self._task_resources(spec)) if w.actor_id is None else None
@@ -1275,6 +1386,148 @@ class Raylet:
             self.idle_workers[(w.job_id, w.env_hash)].append(w)
         self._schedule_dispatch()
         return True
+
+    # ------------------------------------------------------------------
+    # multi-tenant accounting (tenants.py holds the DRF/quota math)
+    # ------------------------------------------------------------------
+    def _local_tenant_usage(self) -> Dict[str, dict]:
+        """Resources held on this node per tenant: PG reservations (by
+        the reserving tenant) plus non-bundle worker holds (leases,
+        actor workers, dispatch-path tasks).  Bundle-hosted workers hold
+        bundle resources already counted by the reservation."""
+        usage: Dict[str, dict] = {}
+        for b in self.bundles.values():
+            tenants_mod.add_usage(
+                usage,
+                b.get("tenant", tenants_mod.DEFAULT_TENANT),
+                dict(b["reserved"]),
+            )
+        for w in self.workers.values():
+            if (
+                w.bundle_key is None
+                and w.resources_held
+                and not w.lease_blocked
+                and w.state != "DEAD"
+            ):
+                tenants_mod.add_usage(usage, w.tenant, dict(w.resources_held))
+        for tenant, res in self._inflight_lease_usage.items():
+            if res:
+                tenants_mod.add_usage(usage, tenant, dict(res))
+        return usage
+
+    def _charge_inflight_lease(self, tenant: str, res: ResourceSet):
+        self._inflight_lease_usage.setdefault(tenant, ResourceSet()).add(res)
+
+    def _release_inflight_lease(self, tenant: str, res: ResourceSet):
+        held = self._inflight_lease_usage.get(tenant)
+        if held is not None:
+            held.subtract(res)
+            if not any(v > 1e-9 for v in held.values()):
+                self._inflight_lease_usage.pop(tenant, None)
+
+    def _effective_tenant_usage(self) -> Dict[str, dict]:
+        """Cluster-wide per-tenant usage for fair-share/quota decisions:
+        the GCS-published aggregate with this node's (stale) contribution
+        replaced by live local truth, so a grant made here is visible to
+        the next decision immediately instead of one publish later."""
+        local = self._local_tenant_usage()
+        if not self.cluster_tenant_usage:
+            return local
+        eff = {t: dict(r) for t, r in self.cluster_tenant_usage.items()}
+        for t, r in self._published_tenant_usage.items():
+            acc = eff.setdefault(t, {})
+            for k, v in r.items():
+                acc[k] = acc.get(k, 0.0) - v
+        for t, r in local.items():
+            tenants_mod.add_usage(eff, t, r)
+        return eff
+
+    def _cluster_totals_view(self) -> Dict[str, float]:
+        """Fallback totals when no tenant_usage publish has arrived yet
+        (fresh cluster): this node + the resource-view peers."""
+        totals = dict(self.resources_total)
+        for view in self.cluster_view.values():
+            for k, v in (view.get("total") or {}).items():
+                totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    def _tenant_over_quota(self, tenant: str, res: ResourceSet) -> bool:
+        if not CONFIG.tenant_quota_enforcement:
+            return False
+        spec = self.tenant_specs.get(tenant)
+        if spec is None or not spec.quota:
+            return False
+        return tenants_mod.over_quota(
+            self._effective_tenant_usage().get(tenant), res, spec.quota
+        )
+
+    def _tenant_label(self, tenant: str) -> str:
+        return tenants_mod.tenant_label(tenant, self.tenant_specs)
+
+    def _reconcile_tenant_quotas(self):
+        """Self-correction for the distributed lease race: two raylets
+        granting from views a publish apart can transiently over-admit a
+        tenant, and a busy lease never idles out — so a tenant over its
+        quota gets cooperative revoke_lease pushes (newest lease first)
+        until the excess is covered.  The submitter drains the lease
+        (in-flight tasks finish) and returns it; replacement demand
+        re-parks under the quota gate."""
+        if not CONFIG.tenant_quota_enforcement or not self.tenant_specs:
+            return
+        # Phase-stagger across nodes: every raylet sees the SAME
+        # cluster-wide excess, so acting simultaneously would revoke it
+        # once per node.  A deterministic per-node phase over 3 reconcile
+        # ticks lets the first actor's revocation propagate (publish
+        # cadence < tick) before the others re-check — residual
+        # over-revocation is bounded to the nodes sharing a phase.
+        if (self._reconcile_tick // 5) % 3 != self.node_id.binary()[0] % 3:
+            return
+        usage = self._effective_tenant_usage()
+        for tenant, spec in self.tenant_specs.items():
+            if not spec.quota or not tenants_mod.over_quota(
+                usage.get(tenant), None, spec.quota
+            ):
+                continue
+            used = usage.get(tenant) or {}
+            over = {
+                r: used.get(r, 0.0) - cap
+                for r, cap in spec.quota.items()
+                if used.get(r, 0.0) > cap + 1e-9
+            }
+            leased = [
+                w
+                for w in self.workers.values()
+                if w.state == "LEASED"
+                and w.tenant == tenant
+                and w.worker_id not in self._revoked_leases
+                and w.lease_owner is not None
+                and not w.lease_owner.closed
+            ]
+            # Newest first: the most recently granted lease has the least
+            # sunk warmth to lose.  At most ONE revocation per tenant per
+            # tick: every raylet sees the same cluster-wide excess, so an
+            # uncoordinated "cover it all" would revoke it N times over —
+            # the 1/tick damper converges in a few ticks without the
+            # revoke/re-grant churn.
+            leased.sort(key=lambda w: -w.spawn_time)
+            for w in leased:
+                if not any(
+                    w.resources_held.get(r, 0.0) > 0 and v > 0
+                    for r, v in over.items()
+                ):
+                    continue
+                try:
+                    w.lease_owner.push(
+                        "revoke_lease", {"worker_id": w.worker_id.binary()}
+                    )
+                except Exception:
+                    continue
+                logger.info(
+                    "quota reconciliation: revoking lease %s of tenant %r",
+                    w.worker_id.hex()[:12], tenant,
+                )
+                self._revoked_leases.add(w.worker_id)
+                break
 
     # ------------------------------------------------------------------
     # worker leases — direct task submission (reference:
@@ -1319,6 +1572,8 @@ class Raylet:
     async def _request_worker_lease_inner(self, payload, conn):
         res = ResourceSet.of(payload["resources"])
         job_id = JobID(payload["job_id"])
+        tenant = tenants_mod.normalize_tenant(payload.get("tenant"))
+        priority = int(payload.get("priority") or 0)
         if self.draining:
             # A draining node grants no new leases (reference: raylet
             # lease rejection while draining): point the submitter at a
@@ -1345,22 +1600,50 @@ class Raylet:
         # call timeout, or the reply lands on a request the client already
         # abandoned and the LEASED worker leaks until its conn closes.
         deadline = time.monotonic() + CONFIG.worker_lease_timeout_ms / 1000 - 5
-        # FIFO fairness: an incoming request may not jump ahead of parked
-        # waiters even if it happens to fit right now — a stream of small
-        # requests would starve a parked large one forever otherwise.
-        if self.lease_waiters or not res.fits_in(self.resources_available):
-            if allow_spill and not res.fits_in(self.resources_available):
+        # Fairness: an incoming request may not jump ahead of parked
+        # waiters even if it happens to fit right now — the fair-share
+        # grant loop decides who goes next (weighted DRF across tenants,
+        # priority then FIFO within one).  A request whose tenant is
+        # over its registered quota parks too (backpressure: it waits
+        # for usage to fall, it doesn't fail), and never spills — the
+        # quota is cluster-wide, so another node can't grant it either.
+        over_quota = self._tenant_over_quota(tenant, res)
+        if self.lease_waiters or over_quota or not res.fits_in(self.resources_available):
+            if (
+                allow_spill
+                and not over_quota
+                and not res.fits_in(self.resources_available)
+            ):
                 target = self._spill_target(res)
                 if target is not None:
                     return {"spill": target}
-            # Park until resources free up (event-driven, FIFO).
+            # Park until resources free up (event-driven, fair-share).
             fut = self.loop.create_future()
-            self.lease_waiters.append((res, fut))
-            self._grant_lease_waiters()  # may grant immediately (empty queue ahead)
+            self._lease_seq += 1
+            waiter = tenants_mod.LeaseWaiter(
+                res=res, fut=fut, tenant=tenant, priority=priority,
+                seq=self._lease_seq,
+            )
+            self.lease_waiters.append(waiter)
+            telemetry.count_tenant_parked(
+                self._tenant_label(tenant),
+                "quota" if over_quota else "fair_share",
+            )
+            self._grant_lease_waiters()  # may grant immediately (first in line)
+            # A SPILLED request parks only briefly: it was sent here
+            # because capacity looked available — if that's gone, bounce
+            # it back to the submitter quickly so the demand re-enters
+            # the HOME raylet's fair queue instead of sitting in a
+            # remote queue for the whole client timeout (a tenant's
+            # entire in-flight demand parked remotely would otherwise
+            # starve it of capacity freeing up elsewhere).
+            park_budget = (
+                min(2.0, max(0.5, deadline - time.monotonic()))
+                if payload.get("spilled")
+                else max(1.0, deadline - time.monotonic())
+            )
             try:
-                verdict = await asyncio.wait_for(
-                    fut, max(1.0, deadline - time.monotonic())
-                )
+                verdict = await asyncio.wait_for(fut, park_budget)
                 if verdict is not True:
                     # Drain flush woke us without granting (no resources
                     # were debited): send the submitter elsewhere.
@@ -1371,12 +1654,13 @@ class Raylet:
                 # granted (a granted future makes wait_for return instead):
                 # no resources were debited for it; just drop the entry.
                 try:
-                    self.lease_waiters.remove((res, fut))
+                    self.lease_waiters.remove(waiter)
                 except ValueError:
                     pass  # already swept by _grant_lease_waiters' done-check
                 return None
         else:
             self.resources_available.subtract(res)
+            self._charge_inflight_lease(tenant, res)
         # Resources are debited from here on: ANY exit that doesn't grant
         # must re-credit them or the node's capacity leaks.
         granted = False
@@ -1410,11 +1694,16 @@ class Raylet:
                 return None
             w.state = "LEASED"
             w.resources_held = res.copy()
+            w.tenant = tenant
             w.lease_owner = conn
             w.lease_blocked = False
             granted = True
             return {"worker_id": w.worker_id.binary(), "address": w.direct_address}
         finally:
+            # The grant is no longer in flight: either it's now visible
+            # as the worker's resources_held (granted, set in the same
+            # event-loop tick) or the resources go back to the pool.
+            self._release_inflight_lease(tenant, res)
             if not granted:
                 self.resources_available.add(res)
                 self._grant_lease_waiters()
@@ -1475,21 +1764,51 @@ class Raylet:
         return True
 
     def _grant_lease_waiters(self):
+        """Serve parked lease requests in weighted-DRF fair-share order
+        (tenants.pick_next): per tenant only its best (priority, FIFO)
+        waiter is a candidate — no intra-tenant queue-jumping, so small
+        requests can't starve a parked large one — tenants go ascending
+        dominant share, over-quota tenants are skipped (their waiters
+        stay parked: backpressure, not failure), and an unfittable head
+        doesn't block OTHER tenants (work conservation)."""
         if self.draining:
             return  # push_drain flushes the queue; no new grants
+        if not self.lease_waiters:
+            return
+        # Sweep abandoned entries (timed-out requesters).
+        self.lease_waiters = deque(
+            w for w in self.lease_waiters if not w.fut.done()
+        )
+        usage = self._effective_tenant_usage()
+        totals = self.cluster_resource_totals or self._cluster_totals_view()
+        now = time.monotonic()
         while self.lease_waiters:
-            res, fut = self.lease_waiters[0]
-            if fut.done():
-                self.lease_waiters.popleft()
-                continue
-            if not res.fits_in(self.resources_available):
-                break  # FIFO: no queue-jumping
-            self.lease_waiters.popleft()
-            self.resources_available.subtract(res)
-            fut.set_result(True)
+            w = tenants_mod.pick_next(
+                self.lease_waiters,
+                self.resources_available,
+                usage,
+                totals,
+                self.tenant_specs,
+                enforce_quota=bool(CONFIG.tenant_quota_enforcement),
+            )
+            if w is None:
+                break
+            self.lease_waiters.remove(w)
+            self.resources_available.subtract(w.res)
+            # Count the grant as in-flight until the requester's worker
+            # is LEASED (or the grant unwinds) so concurrent quota
+            # checks see it; update the working view so a batch of
+            # grants in one pass stays fair too.
+            self._charge_inflight_lease(w.tenant, w.res)
+            tenants_mod.add_usage(usage, w.tenant, dict(w.res))
+            telemetry.observe_tenant_lease_wait(
+                self._tenant_label(w.tenant), now - w.enqueued
+            )
+            w.fut.set_result(True)
 
     async def push_return_worker_lease(self, payload, conn):
         w = self.workers.get(WorkerID(payload["worker_id"]))
+        self._revoked_leases.discard(WorkerID(payload["worker_id"]))
         if w is None or w.state != "LEASED":
             return
         w.lease_owner = None
@@ -1580,6 +1899,8 @@ class Raylet:
             raise
         w.spawn_token = spawn_token  # released when it leaves STARTING
         w.resources_held = res.copy()
+        w.tenant = tenants_mod.normalize_tenant(payload.get("tenant"))
+        w.detached = bool(spec.detached)
         w.bundle_key = bk
         self.actor_workers[spec.actor_id] = w
         # Wait for the worker to register.
@@ -1655,7 +1976,14 @@ class Raylet:
         if not res.fits_in(self.resources_available):
             return False
         self.resources_available.subtract(res)
-        self.bundles[key] = {"reserved": res, "available": res.copy(), "committed": False}
+        self.bundles[key] = {
+            "reserved": res,
+            "available": res.copy(),
+            "committed": False,
+            # Reservation charges the creating job's tenant (quota +
+            # fair-share accounting rides the tenant_usage report).
+            "tenant": tenants_mod.normalize_tenant(payload.get("tenant")),
+        }
         return True
 
     async def rpc_commit_bundle(self, payload, conn):
@@ -1855,9 +2183,9 @@ class Raylet:
         # them with a non-grant verdict so their submitters re-lease on
         # another node instead of waiting out the lease timeout.
         while self.lease_waiters:
-            _res, fut = self.lease_waiters.popleft()
-            if not fut.done():
-                fut.set_result("draining")
+            waiter = self.lease_waiters.popleft()
+            if not waiter.fut.done():
+                waiter.fut.set_result("draining")
         # Queued tasks re-run the spill decision (now drain-aware).
         self._schedule_dispatch()
 
@@ -2059,6 +2387,8 @@ class Raylet:
             "num_workers": len(self.workers),
             "queue_len": len(self.queue),
             "infeasible": len(self.infeasible),
+            "lease_waiters": len(self.lease_waiters),
+            "tenant_usage": self._local_tenant_usage(),
             "store": self.store.stats(),
             "num_tasks_dispatched": self.num_tasks_dispatched,
             "num_tasks_spilled": self.num_tasks_spilled,

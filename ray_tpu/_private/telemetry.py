@@ -126,6 +126,58 @@ class _Metrics:
             boundaries=[0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0],
             tag_keys=("direction",),
         )
+        # --- multi-tenant job plane (tenant label values are clamped to
+        # registered tenants + "default"/"other" via tenants.tenant_label
+        # so cardinality stays bounded) ---
+        self.tenant_usage = m.Gauge(
+            "tenant_usage",
+            "cluster-wide resources in use per tenant (GCS aggregation "
+            "over raylet reports)",
+            tag_keys=("tenant", "resource"),
+        )
+        self.tenant_dominant_share = m.Gauge(
+            "tenant_dominant_share",
+            "DRF dominant share per tenant: max over resources of "
+            "usage/cluster_total, divided by the tenant's weight",
+            tag_keys=("tenant",),
+        )
+        self.tenant_lease_wait = m.Histogram(
+            "tenant_lease_wait_seconds",
+            "time a worker-lease request spent parked in the raylet's "
+            "fair-share queue before its grant",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("tenant",),
+        )
+        self.tenant_parked = m.Counter(
+            "tenant_parked_total",
+            "admissions/leases parked by the tenant plane, by reason "
+            "(quota, fair_share)",
+            tag_keys=("tenant", "reason"),
+        )
+        self.tenant_preemptions = m.Counter(
+            "tenant_preemptions_total",
+            "priority preemptions by victim tenant and action (notice, "
+            "shrink, actor_restart)",
+            tag_keys=("tenant", "action"),
+        )
+        # --- per-node drain budget (no node label: each raylet reports
+        # through its own channel, keyed by node id at the GCS) ---
+        self.drain_deadline_remaining = m.Gauge(
+            "drain_deadline_remaining_seconds",
+            "seconds left in this node's drain notice window (0 when not "
+            "draining); reported per node via the raylet report channel",
+        )
+        self.drain_inflight_tasks = m.Gauge(
+            "drain_inflight_tasks",
+            "tasks still running on this draining node (racing the "
+            "deadline); 0 when not draining",
+        )
+        self.lost_capacity_records = m.Counter(
+            "lost_capacity_records_total",
+            "preempted/lost worker-node capacity records published to the "
+            "autoscaler replacement feed, by reason",
+            tag_keys=("reason",),
+        )
 
 
 def _metrics() -> _Metrics:
@@ -273,3 +325,76 @@ def observe_drain_migration(seconds: float) -> None:
     if not enabled():
         return
     _metrics().drain_migration.observe(max(0.0, seconds))
+
+
+# ----------------------------------------------------------------------
+# multi-tenant job plane.  Callers pass tenant labels ALREADY clamped via
+# tenants.tenant_label() (registered tenants + "default"/"other"), so
+# the bound caches below stay bounded.
+# ----------------------------------------------------------------------
+_tenant_wait_bound: dict = {}
+_tenant_parked_bound: dict = {}
+_tenant_preempt_bound: dict = {}
+_lost_capacity_bound: dict = {}
+
+
+def set_tenant_usage(tenant: str, resource: str, value: float) -> None:
+    if not enabled():
+        return
+    # Gauges are last-value-wins and set on a publish cadence, not per
+    # event — the unbound set() path is fine here.
+    _metrics().tenant_usage.set(value, tags={"tenant": tenant, "resource": resource})
+
+
+def set_tenant_dominant_share(tenant: str, share: float) -> None:
+    if not enabled():
+        return
+    _metrics().tenant_dominant_share.set(share, tags={"tenant": tenant})
+
+
+def observe_tenant_lease_wait(tenant: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _tenant_wait_bound.get(tenant) or _bind(
+        _tenant_wait_bound, tenant, "tenant_lease_wait", {"tenant": tenant}
+    )
+    b.observe(max(0.0, seconds))
+
+
+def count_tenant_parked(tenant: str, reason: str) -> None:
+    if not enabled():
+        return
+    b = _tenant_parked_bound.get((tenant, reason)) or _bind(
+        _tenant_parked_bound, (tenant, reason), "tenant_parked",
+        {"tenant": tenant, "reason": reason},
+    )
+    b.inc(1.0)
+
+
+def count_tenant_preemption(tenant: str, action: str) -> None:
+    if not enabled():
+        return
+    b = _tenant_preempt_bound.get((tenant, action)) or _bind(
+        _tenant_preempt_bound, (tenant, action), "tenant_preemptions",
+        {"tenant": tenant, "action": action},
+    )
+    b.inc(1.0)
+
+
+def count_lost_capacity(reason: str) -> None:
+    if not enabled():
+        return
+    b = _lost_capacity_bound.get(reason) or _bind(
+        _lost_capacity_bound, reason, "lost_capacity_records", {"reason": reason}
+    )
+    b.inc(1.0)
+
+
+def set_drain_budget(deadline_remaining_s: float, inflight_tasks: int) -> None:
+    """Per-node drain budget gauges, updated from the raylet report loop
+    while draining (and zeroed when not)."""
+    if not enabled():
+        return
+    m = _metrics()
+    m.drain_deadline_remaining.set(max(0.0, deadline_remaining_s))
+    m.drain_inflight_tasks.set(float(inflight_tasks))

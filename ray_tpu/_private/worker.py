@@ -336,6 +336,11 @@ class Worker:
         self.node_id: Optional[NodeID] = None
         self.namespace: str = "default"
         self.session_info: dict = {}
+        # Multi-tenant job plane: the tenant this process's job belongs
+        # to and its priority class — stamped on lease requests so
+        # raylets do fair-share/quota accounting per tenant.
+        self.tenant: str = "default"
+        self.tenant_priority: int = 0
         # Job-level default runtime env (normalized); merged under any
         # per-task/actor runtime_env at submit time.
         self.job_runtime_env: Optional[dict] = None
@@ -413,6 +418,12 @@ class Worker:
         # the train backend executor uses it to trigger a pre-preemption
         # checkpoint.
         self._node_listeners: list = []
+        # Job-preemption listeners (multi-tenant plane): callbacks
+        # invoked with the GCS "preempt_job" notice payload.  The train
+        # backend executor uses this to checkpoint-and-shrink instead of
+        # waiting for the escalation (graceful actor restart).
+        self._job_preempt_listeners: list = []
+        self.job_preempt_notice: Optional[dict] = None
         # Executor side: cancel requests for tasks queued/running here,
         # plus live execution registries so a cancel targets exactly the
         # right thread / asyncio task (a shared "current thread" would
@@ -440,6 +451,14 @@ class Worker:
         self.job_id = JobID(reply["job_id"])
         self.namespace = reply["namespace"]
         self.session_info = reply["session_info"]
+        # Effective identity from the GCS (tenant-default priority
+        # applied there), falling back to what we sent for older GCS.
+        self.tenant = reply.get("tenant") or job_config.get("tenant") or "default"
+        self.tenant_priority = int(
+            reply.get("priority")
+            if reply.get("priority") is not None
+            else (job_config.get("priority") or 0)
+        )
         self.gcs_client.call("subscribe", "actors")
         # Node lifecycle events: owners react to DRAINING targets by
         # re-leasing proactively instead of waiting for RPC failure.
@@ -452,7 +471,14 @@ class Worker:
         # Workers mirror the driver's import paths (driver_sys_path, set
         # above) so functions pickled by reference resolve there too; the
         # same config is stored in the GCS job table for other raylets.
-        job_config = dict(job_config, session_dir=self.session_info.get("session_dir"))
+        job_config = dict(
+            job_config,
+            session_dir=self.session_info.get("session_dir"),
+            # Effective identity (tenant-default priority resolved by the
+            # GCS) — worker spawns inherit it via the raylet's env stamp.
+            tenant=self.tenant,
+            priority=self.tenant_priority,
+        )
         r = self.raylet_client.call(
             "register_client",
             {"job_id": self.job_id.binary(), "job_config": job_config},
@@ -524,6 +550,21 @@ class Worker:
                 _sys.path.insert(0, p)
         self.namespace = job_config.get("namespace", "default")
         self.session_info = {"session_dir": job_config.get("session_dir")}
+        # Tenant inheritance: the raylet stamps the job's tenant into the
+        # spawn env (isolation: nested work is charged like the driver's).
+        self.tenant = (
+            os.environ.get("RAY_TPU_TENANT")
+            or job_config.get("tenant")
+            or "default"
+        )
+        try:
+            self.tenant_priority = int(
+                os.environ.get("RAY_TPU_TENANT_PRIORITY")
+                or job_config.get("priority")
+                or 0
+            )
+        except ValueError:
+            self.tenant_priority = 0
         # Nested tasks inherit THIS worker's env (already job-env-merged
         # by the parent submitter), not the bare job env — matching the
         # reference's parent-inheritance semantics.
@@ -658,6 +699,8 @@ class Worker:
         self._cancelled_tasks.clear()
         self._cancel_requested.clear()
         self._node_listeners.clear()
+        self._job_preempt_listeners.clear()
+        self.job_preempt_notice = None
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
         self.actor_cache = ActorStateCache(self)
@@ -668,6 +711,18 @@ class Worker:
     # pushes
     # ------------------------------------------------------------------
     def _on_gcs_push(self, method: str, payload):
+        if method == "preempt_job":
+            # Priority preemption notice (multi-tenant plane): this job
+            # should release capacity gracefully — an elastic trainer
+            # checkpoints and shrinks; past the notice deadline the GCS
+            # escalates to graceful actor restarts.  Listeners run off
+            # the RPC read thread (they issue actor calls).
+            self.job_preempt_notice = payload
+            threading.Thread(
+                target=self._on_job_preempt, args=(payload,),
+                daemon=True, name="job-preempt",
+            ).start()
+            return
         if method == "pubsub":
             channel, msg = payload
             if channel == "actors":
@@ -719,6 +774,29 @@ class Worker:
         except ValueError:
             pass
 
+    def _on_job_preempt(self, payload: dict):
+        logger.warning(
+            "job preemption notice: %s (deadline %.0fs, release %s worker(s))",
+            payload.get("reason"), float(payload.get("deadline_s") or 0),
+            payload.get("release_workers"),
+        )
+        for cb in list(self._job_preempt_listeners):
+            try:
+                cb(payload)
+            except Exception:
+                logger.exception("job preempt listener failed")
+
+    def add_job_preempt_listener(self, cb) -> None:
+        """Register cb(notice_dict) for GCS priority-preemption notices
+        targeting this driver's job."""
+        self._job_preempt_listeners.append(cb)
+
+    def remove_job_preempt_listener(self, cb) -> None:
+        try:
+            self._job_preempt_listeners.remove(cb)
+        except ValueError:
+            pass
+
     def _on_gcs_reconnected(self):
         """The GCS restarted: re-subscribe and re-bind this driver's job so
         disconnect-driven cleanup keeps working."""
@@ -749,6 +827,13 @@ class Worker:
             # why so the lease-lost handler raises OutOfMemoryError
             # instead of a generic crash (reference: memory_monitor.h).
             self._oom_worker_kills[payload["worker_id"]] = payload["message"]
+        elif method == "revoke_lease":
+            # Tenant-quota reconciliation: our tenant is over quota, the
+            # raylet asks for this lease back.  Cooperative — in-flight
+            # tasks finish, no new specs are assigned, then the worker is
+            # returned (same machinery as a drain).
+            if self._direct_submitter is not None:
+                self._direct_submitter.revoke(payload["worker_id"])
         elif method == "exit":
             self._intended_exit = True
             self._shutdown_event.set()
@@ -1569,7 +1654,10 @@ class Worker:
             if c is None or c.closed:
                 if address == self.raylet_client.address:
                     return self.raylet_client
-                c = rpc.RpcClient(address)
+                # Same push handler as the home raylet: spilled leases are
+                # owned through these connections, and their raylet must
+                # be able to reach us (oom_kill, revoke_lease).
+                c = rpc.RpcClient(address, on_push=self._on_raylet_push)
                 self._raylet_clients[address] = c
             return c
 

@@ -105,6 +105,12 @@ class _DashboardState:
     def jobs(self):
         return self.gcs.call("list_jobs", None)
 
+    def tenants(self):
+        return self.gcs.call("list_tenants", None)
+
+    def set_tenant(self, payload: dict):
+        return self.gcs.call("tenant_set_quota", payload)
+
     def workers(self):
         out = []
         for n in self.nodes():
@@ -328,6 +334,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(self.state.objects())
             if path == "/api/cluster_jobs":
                 return self._json(self.state.jobs())
+            if path == "/api/tenants":
+                return self._json(self.state.tenants())
             if path == "/api/jobs":
                 return self._json(self.jobs.list_jobs())
             if path.startswith("/api/jobs/"):
@@ -401,8 +409,16 @@ class _Handler(BaseHTTPRequestHandler):
                     submission_id=body.get("submission_id"),
                     runtime_env=body.get("runtime_env"),
                     metadata=body.get("metadata"),
+                    tenant=body.get("tenant"),
+                    priority=int(body.get("priority") or 0),
+                    quota=body.get("quota"),
                 )
                 return self._json({"submission_id": sid})
+            if path == "/api/tenants":
+                body = self._read_body()
+                if not body.get("tenant"):
+                    return self._error(400, "tenant is required")
+                return self._json(self.state.set_tenant(body))
             if path.endswith("/stop") and path.startswith("/api/jobs/"):
                 sid = path[len("/api/jobs/"): -len("/stop")]
                 if not self.jobs.stop_job(sid):

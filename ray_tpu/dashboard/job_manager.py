@@ -64,10 +64,22 @@ class JobManager:
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
         entrypoint_num_cpus: float = 0,
+        tenant: Optional[str] = None,
+        priority: int = 0,
+        quota: Optional[dict] = None,
     ) -> str:
+        """Submit an entrypoint.  ``tenant``/``priority`` ride into the
+        driver via env (ray_tpu.init picks them up), so the job's
+        actors/leases are charged to that tenant and scheduled in its
+        fair share; ``quota`` (resource dict) registers/updates the
+        tenant's quota in the GCS at submission time."""
         submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
         if self._get(submission_id) is not None:
             raise ValueError(f"job {submission_id!r} already exists")
+        if quota is not None and tenant:
+            self._gcs.call(
+                "tenant_set_quota", {"tenant": tenant, "quota": quota}
+            )
         info = {
             "submission_id": submission_id,
             "entrypoint": entrypoint,
@@ -75,6 +87,8 @@ class JobManager:
             "message": "queued",
             "runtime_env": runtime_env or {},
             "metadata": metadata or {},
+            "tenant": tenant or "default",
+            "priority": int(priority or 0),
             "start_time": time.time(),
             "end_time": None,
         }
@@ -90,6 +104,8 @@ class JobManager:
         env = dict(os.environ)
         env["RAY_TPU_ADDRESS"] = self._gcs_address
         env["RAY_TPU_JOB_SUBMISSION_ID"] = submission_id
+        env["RAY_TPU_TENANT"] = info.get("tenant") or "default"
+        env["RAY_TPU_PRIORITY"] = str(info.get("priority") or 0)
         if info.get("runtime_env"):
             env["RAY_TPU_JOB_RUNTIME_ENV"] = json.dumps(info["runtime_env"])
         log_path = self._log_path(submission_id)

@@ -42,7 +42,13 @@ class JobSubmissionClient:
         submission_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        priority: int = 0,
+        quota: Optional[dict] = None,
     ) -> str:
+        """``tenant``/``priority`` tag the job for the multi-tenant
+        scheduler; ``quota`` registers the tenant's resource quota (e.g.
+        ``{"CPU": 8}``) at submission time."""
         reply = self._call(
             "POST",
             "/api/jobs/",
@@ -51,9 +57,30 @@ class JobSubmissionClient:
                 "submission_id": submission_id,
                 "runtime_env": runtime_env,
                 "metadata": metadata,
+                "tenant": tenant,
+                "priority": priority,
+                "quota": quota,
             },
         )
         return reply["submission_id"]
+
+    def list_tenants(self) -> List[Dict[str, Any]]:
+        """Registered tenants with quota, live usage and dominant share."""
+        return self._call("GET", "/api/tenants")
+
+    def set_tenant_quota(
+        self,
+        tenant: str,
+        quota: Optional[dict] = None,
+        weight: Optional[float] = None,
+        priority: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        return self._call(
+            "POST",
+            "/api/tenants",
+            {"tenant": tenant, "quota": quota, "weight": weight,
+             "priority": priority},
+        )
 
     def get_job_status(self, submission_id: str) -> str:
         return self._call("GET", f"/api/jobs/{submission_id}")["status"]

@@ -5,6 +5,7 @@ Add new checkers here and in docs/static_analysis.md."""
 from ray_tpu.devtools.lint.checkers import (
     blocking_handler,
     generation_key,
+    import_cycle,
     lock_order,
     metrics_drift,
     retry_gate,
@@ -18,6 +19,7 @@ ALL_CHECKERS = [
     blocking_handler,
     metrics_drift,
     generation_key,
+    import_cycle,
 ]
 
 CHECK_NAMES = [c.name for c in ALL_CHECKERS]

@@ -66,14 +66,17 @@ def _instrument_calls(mod: Module) -> List[Tuple[str, int]]:
     return out
 
 
-def _catalog_names(docs_path: str) -> Dict[str, int]:
-    """Backticked instrument names from the '## Metric catalog' table."""
+def _catalog_names(docs_path: str) -> Tuple[Dict[str, int], List[str]]:
+    """Backticked instrument names from the '## Metric catalog' table,
+    plus wildcard family rows (``test_*``) as fnmatch patterns — an
+    instrument matching a documented family needs no literal row."""
     names: Dict[str, int] = {}
+    patterns: List[str] = []
     try:
         with open(docs_path, "r", encoding="utf-8") as fh:
             lines = fh.read().splitlines()
     except OSError:
-        return names
+        return names, patterns
     in_catalog = False
     for i, line in enumerate(lines, start=1):
         if line.startswith("## "):
@@ -85,9 +88,12 @@ def _catalog_names(docs_path: str) -> Dict[str, int]:
         if not cells or cells[0] in ("name", "") or set(cells[0]) <= {"-", " "}:
             continue
         m = re.match(r"`([A-Za-z0-9_*]+)`", cells[0])
-        if m and "*" not in m.group(1):
-            names[m.group(1)] = i
-    return names
+        if m:
+            if "*" in m.group(1):
+                patterns.append(m.group(1))
+            else:
+                names[m.group(1)] = i
+    return names, patterns
 
 
 def _suspicious_tag_value(v: ast.AST) -> bool:
@@ -145,11 +151,15 @@ def check_project(project: Project) -> Iterable[Violation]:
                             )
                         )
     docs_abs = os.path.join(project.root, DOCS_RELPATH)
-    catalog = _catalog_names(docs_abs)
+    catalog, family_patterns = _catalog_names(docs_abs)
     if not catalog and not os.path.exists(docs_abs):
         return out  # fixture trees without docs only get cardinality checks
 
+    from fnmatch import fnmatchcase
+
     for metric_name, (rel, line) in sorted(created.items()):
+        if any(fnmatchcase(metric_name, p) for p in family_patterns):
+            continue  # covered by a documented wildcard family row
         if metric_name not in catalog:
             out.append(
                 Violation(

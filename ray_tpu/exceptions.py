@@ -112,3 +112,11 @@ class OutOfMemoryError(RayError):
 
 class PlacementGroupSchedulingError(RayError):
     pass
+
+
+class QuotaExceededError(RayError):
+    """A tenant is over its registered resource quota AND its parked
+    admission queue is full (tenant_max_parked) — the backpressure
+    surface of the multi-tenant job plane.  Under the cap, over-quota
+    requests park instead of raising."""
+

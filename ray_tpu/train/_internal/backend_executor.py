@@ -69,6 +69,13 @@ class BackendExecutor:
         # group from a drain-triggered checkpoint.
         self._drained_nodes: set = set()
         self._rank_nodes: set = set()
+        # Priority-preemption plane (multi-tenant): a GCS preempt_job
+        # notice asks this job to release capacity.  The elastic path
+        # checkpoints at the next report boundary and shrinks by the
+        # requested worker count — cooperative, never a raw kill.
+        self._preempt_release = 0
+        self._preempt_listener = None
+        self._preempt_tenant_label = None
         # Capacity-return plane: set when a node registers ALIVE while the
         # group runs below num_workers; consumed by try_grow().
         self._capacity_event = threading.Event()
@@ -188,6 +195,44 @@ class BackendExecutor:
             get_global_worker().add_node_listener(on_node_event)
         except Exception:
             self._node_listener = None
+
+        def on_preempt(notice: dict):
+            if not self.elastic or self.worker_group is None:
+                return
+            release = max(1, int(notice.get("release_workers") or 1))
+            self._preempt_release = max(self._preempt_release, release)
+            # The GCS clamps the label against its tenant registry; the
+            # shrink counter must land on the SAME label as the
+            # notice/actor_restart counts for this preemption.
+            self._preempt_tenant_label = notice.get("tenant_label")
+            logger.warning(
+                "preemption notice: releasing %d worker(s) at the next "
+                "checkpoint boundary (%s)", release, notice.get("reason"),
+            )
+            # Same cooperative path as a drain notice: every rank's
+            # session checkpoints at its next step boundary.
+            for w in list(self.worker_group.workers):
+                try:
+                    w.notify_drain.remote()
+                except Exception:
+                    pass
+
+        self._preempt_listener = on_preempt
+        try:
+            get_global_worker().add_job_preempt_listener(on_preempt)
+        except Exception:
+            self._preempt_listener = None
+
+    def preempt_pending(self) -> bool:
+        """True while a preemption notice asks this (elastic) group to
+        release workers and the group still sits above min_workers."""
+        if not self.elastic or self.worker_group is None:
+            return False
+        min_workers = self.scaling.min_workers or self.scaling.num_workers
+        return (
+            self._preempt_release > 0
+            and len(self.worker_group.workers) > min_workers
+        )
 
     def drain_imminent(self) -> bool:
         """True while any node hosting a CURRENT rank is draining (the
@@ -330,6 +375,30 @@ class BackendExecutor:
 
         group = self.worker_group
         from_size = len(group.workers)
+        min_workers = self.scaling.min_workers or self.scaling.num_workers
+        if trigger == "preempt":
+            # Priority preemption: no rank is dead or doomed — release
+            # the REQUESTED count (clamped to what min_workers allows),
+            # shedding the highest ranks (cheapest re-shard: survivors
+            # keep contiguous ranks 0..n-1).  The freed actors' resources
+            # go to the starved higher-priority demand; telemetry charges
+            # the shrink to this job's tenant.
+            release = min(self._preempt_release, from_size - min_workers)
+            self._preempt_release = 0
+            if release <= 0:
+                return False
+            casualties = list(range(from_size - release, from_size))
+            from ray_tpu._private import telemetry
+
+            try:
+                telemetry.count_tenant_preemption(
+                    self._preempt_tenant_label or "other", "shrink"
+                )
+            except Exception:
+                pass
+            group.remove_ranks(casualties)
+            self._reform(resume_checkpoint, "shrink", trigger, from_size)
+            return True
         # Casualty classification, in order of authority: ranks on drained
         # nodes, then actors the GCS reports DEAD (non-blocking, cannot
         # misclassify a slow-but-healthy rank mid-step).  Liveness pings
@@ -426,6 +495,16 @@ class BackendExecutor:
             except Exception:
                 pass
             self._node_listener = None
+        if self._preempt_listener is not None:
+            from ray_tpu._private.worker import get_global_worker
+
+            try:
+                get_global_worker().remove_job_preempt_listener(
+                    self._preempt_listener
+                )
+            except Exception:
+                pass
+            self._preempt_listener = None
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group, self.backend_config)

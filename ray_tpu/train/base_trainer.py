@@ -327,6 +327,16 @@ class DataParallelTrainer(BaseTrainer):
                             round_ckpt = True
                     if reports and reports[0].get("checkpoint"):
                         best_checkpoints.append((reports[0]["checkpoint"], metrics))
+                    if round_ckpt and executor.preempt_pending():
+                        # Priority preemption notice (multi-tenant plane)
+                        # and a checkpoint landed after it: release the
+                        # requested workers via checkpoint-and-shrink.
+                        # Capacity yielded to a higher-priority job is
+                        # not a failure — nothing is charged to
+                        # max_failures, and no work is lost (survivors
+                        # resume from this round's checkpoint).
+                        if elastic and executor.shrink("preempt", latest_checkpoint):
+                            continue
                     if round_ckpt and executor.drain_imminent():
                         # A drain notice covers the group and a checkpoint
                         # landed after it (the report round is the

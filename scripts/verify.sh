@@ -4,11 +4,11 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-# graftlint (static analysis gate): the whole ray_tpu/ tree must carry
-# zero unsuppressed invariant violations against .graftlint.toml, with
-# no stale baseline entries (--strict), inside a 30 s budget.  Runs
+# graftlint (static analysis gate): the ray_tpu/ AND tests/ trees must
+# carry zero unsuppressed invariant violations against .graftlint.toml,
+# with no stale baseline entries (--strict), inside a 30 s budget.  Runs
 # first: it is the cheapest signal and failures are line-precise.
-if ! timeout -k 5 30 python -m ray_tpu.devtools.lint ray_tpu --strict; then
+if ! timeout -k 5 30 python -m ray_tpu.devtools.lint ray_tpu tests --strict; then
   echo "graftlint gate failed (see docs/static_analysis.md)"
   exit 1
 fi
@@ -41,6 +41,18 @@ if [ "${RAY_TPU_SKIP_DRAIN_SMOKE:-0}" != "1" ]; then
   if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
       python scripts/drain_smoke.py; then
     echo "drain smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
+# Tenant smoke (multi-tenant job plane end-to-end): two tenants with
+# unequal quotas under sustained task demand — usage converges on the
+# quota split within 10% and never exceeds a quota persistently.
+# Skippable via RAY_TPU_SKIP_TENANT_SMOKE=1.
+if [ "${RAY_TPU_SKIP_TENANT_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/tenant_smoke.py; then
+    echo "tenant smoke step failed"
     [ "$rc" -eq 0 ] && rc=1
   fi
 fi

@@ -451,13 +451,16 @@ def test_inline_disable_with_reason_suppresses(tmp_path):
 
 
 def test_inline_disable_without_reason_is_a_violation(tmp_path):
+    # The reasonless marker is assembled via replace() so THIS file's raw
+    # source doesn't itself scan as a reasonless disable (bad-suppression
+    # deliberately can't be suppressed or baselined — tests/ is linted).
     src = """
         import time
 
         def cadence_loop():
             while True:
-                time.sleep(0.5)  # graftlint: disable=retry-gate
-    """
+                time.sleep(0.5)  # graftlint: REASONLESS_DISABLE
+    """.replace("REASONLESS_DISABLE", "disable=retry-gate")
     v = lint_source(tmp_path, src, ["retry-gate", "bad-suppression"])
     checks = sorted(x.check for x in v if x.suppressed_by is None)
     # The reasonless disable both fails to suppress and is itself flagged.
@@ -531,9 +534,110 @@ def test_baseline_rejects_reasonless_entry(tmp_path):
 
 # -------------------------------------------------------------- the real gate
 
+# ------------------------------------------------------------ import-cycle
+
+
+def _lint_tree(tmp_path, files, select):
+    for rel, src in files.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src))
+    result = core.run_lint([str(tmp_path)], root=str(tmp_path), select=list(select))
+    assert not result.parse_errors, result.parse_errors
+    return result.violations
+
+
+def test_import_cycle_module_level_flagged(tmp_path):
+    v = _lint_tree(
+        tmp_path,
+        {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "import pkg.b\n",
+            "pkg/b.py": "from pkg import a\n",
+        },
+        ["import-cycle"],
+    )
+    assert len(v) == 1, [x.format() for x in v]
+    assert "pkg.a" in v[0].message and "pkg.b" in v[0].message
+    # Identity tag is the sorted member list: stable across line drift.
+    assert v[0].tag == "cycle:pkg.a>pkg.b"
+
+
+def test_import_cycle_function_local_is_clean(tmp_path):
+    """The house convention: breaking a cycle with a function-local
+    import must satisfy the checker (imports inside functions don't run
+    at import time)."""
+    v = _lint_tree(
+        tmp_path,
+        {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "import pkg.b\n",
+            "pkg/b.py": "def f():\n    from pkg import a\n    return a\n",
+        },
+        ["import-cycle"],
+    )
+    assert v == [], [x.format() for x in v]
+
+
+def test_import_cycle_type_checking_guard_is_clean(tmp_path):
+    v = _lint_tree(
+        tmp_path,
+        {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "import pkg.b\n",
+            "pkg/b.py": (
+                "from typing import TYPE_CHECKING\n"
+                "if TYPE_CHECKING:\n    import pkg.a\n"
+            ),
+        },
+        ["import-cycle"],
+    )
+    assert v == [], [x.format() for x in v]
+
+
+def test_import_cycle_three_module_loop_single_violation(tmp_path):
+    v = _lint_tree(
+        tmp_path,
+        {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "import pkg.b\n",
+            "pkg/b.py": "import pkg.c\n",
+            "pkg/c.py": "import pkg.a\n",
+        },
+        ["import-cycle"],
+    )
+    assert len(v) == 1
+    assert v[0].tag == "cycle:pkg.a>pkg.b>pkg.c"
+
+
+def test_metrics_drift_wildcard_family_row_covers_instruments(tmp_path):
+    """A catalog family row (test_*) covers literal instruments matching
+    it — no per-instrument row needed."""
+    docs = """
+        # obs
+
+        ## Metric catalog
+
+        | name | type | tags | meaning |
+        |---|---|---|---|
+        | `test_*` | any | any | test-only family |
+    """
+    v = lint_source(
+        tmp_path,
+        """
+        from ray_tpu.util import metrics as m
+
+        c = m.Counter("test_requests_total", "test counter")
+        """,
+        ["metrics-drift"],
+        docs=docs,
+    )
+    assert v == [], [x.format() for x in v]
+
+
 def test_graftlint_gate_repo_is_clean():
-    """THE tier-1 gate: ray_tpu/ lints clean against the checked-in
-    baseline, inside the budget, with no stale entries."""
+    """THE tier-1 gate: ray_tpu/ AND tests/ lint clean against the
+    checked-in baseline, inside the budget, with no stale entries."""
     bl = baseline_mod.load_default(REPO_ROOT)
     assert bl is not None, ".graftlint.toml missing from the repo root"
     for e in bl.entries:
@@ -542,7 +646,9 @@ def test_graftlint_gate_repo_is_clean():
             f"placeholder reason in checked-in baseline: {e}"
         )
     result = core.run_lint(
-        [os.path.join(REPO_ROOT, "ray_tpu")], root=REPO_ROOT, baseline=bl
+        [os.path.join(REPO_ROOT, "ray_tpu"), os.path.join(REPO_ROOT, "tests")],
+        root=REPO_ROOT,
+        baseline=bl,
     )
     assert result.parse_errors == []
     assert result.unsuppressed == [], "\n".join(
@@ -559,7 +665,8 @@ def test_graftlint_cli_entrypoint():
     """`python -m ray_tpu.devtools.lint ray_tpu/` exits 0 (the exact
     command verify.sh runs)."""
     proc = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.devtools.lint", "ray_tpu", "--strict"],
+        [sys.executable, "-m", "ray_tpu.devtools.lint", "ray_tpu", "tests",
+         "--strict"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
