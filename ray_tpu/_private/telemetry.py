@@ -178,6 +178,39 @@ class _Metrics:
             "autoscaler replacement feed, by reason",
             tag_keys=("reason",),
         )
+        # --- LLM serving plane (deployment label values are deployment
+        # names — operator-chosen and bounded) ---
+        self.serve_queue_depth = m.Gauge(
+            "serve_queue_depth",
+            "requests waiting in a replica's engine queue (not yet in a "
+            "decode lane) — the autoscaling signal",
+            tag_keys=("deployment",),
+        )
+        self.serve_tokens_per_s = m.Gauge(
+            "serve_tokens_per_s",
+            "tokens generated per second by a replica's engine (5 s "
+            "sliding window)",
+            tag_keys=("deployment",),
+        )
+        self.serve_ttft = m.Histogram(
+            "serve_ttft_seconds",
+            "time to first token: request admission -> first sampled "
+            "token (queue wait + prefill)",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("deployment",),
+        )
+        self.serve_kv_blocks = m.Gauge(
+            "serve_kv_blocks_in_use",
+            "KV cache blocks currently allocated to live sequences; must "
+            "return to 0 when the engine drains (leak signal)",
+            tag_keys=("deployment",),
+        )
+        self.serve_shed = m.Counter(
+            "serve_shed_total",
+            "requests shed by overload protection, by where (proxy = "
+            "per-deployment in-flight bound, engine = waiting-queue bound)",
+            tag_keys=("deployment", "where"),
+        )
 
 
 def _metrics() -> _Metrics:
@@ -388,6 +421,51 @@ def count_lost_capacity(reason: str) -> None:
         _lost_capacity_bound, reason, "lost_capacity_records", {"reason": reason}
     )
     b.inc(1.0)
+
+
+# ----------------------------------------------------------------------
+# LLM serving plane.  Deployment label values are deployment names
+# (operator-chosen, bounded cardinality).
+# ----------------------------------------------------------------------
+_serve_ttft_bound: dict = {}
+_serve_shed_bound: dict = {}
+
+
+def set_serve_queue_depth(deployment: str, depth: int) -> None:
+    if not enabled():
+        return
+    _metrics().serve_queue_depth.set(float(depth), tags={"deployment": deployment})
+
+
+def set_serve_tokens_per_s(deployment: str, rate: float) -> None:
+    if not enabled():
+        return
+    _metrics().serve_tokens_per_s.set(max(0.0, rate), tags={"deployment": deployment})
+
+
+def set_serve_kv_blocks(deployment: str, blocks: int) -> None:
+    if not enabled():
+        return
+    _metrics().serve_kv_blocks.set(float(blocks), tags={"deployment": deployment})
+
+
+def observe_serve_ttft(deployment: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _serve_ttft_bound.get(deployment) or _bind(
+        _serve_ttft_bound, deployment, "serve_ttft", {"deployment": deployment}
+    )
+    b.observe(max(0.0, seconds))
+
+
+def count_serve_shed(deployment: str, where: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    b = _serve_shed_bound.get((deployment, where)) or _bind(
+        _serve_shed_bound, (deployment, where), "serve_shed",
+        {"deployment": deployment, "where": where},
+    )
+    b.inc(float(n))
 
 
 def set_drain_budget(deadline_remaining_s: float, inflight_tasks: int) -> None:

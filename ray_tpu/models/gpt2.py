@@ -190,6 +190,154 @@ def make_sharded_train_step(cfg: GPT2Config, mesh, optimizer):
     return common.make_sharded_train_step(make_train_step(cfg, optimizer), mesh)
 
 
+# ----------------------------------------------------------------------
+# Inference plane: prefill / single-token decode with external KV cache.
+#
+# The serving engine (ray_tpu/serve/llm) owns WHERE keys/values live (a
+# paged block pool); these functions own the math.  They are pure-jnp
+# forwards over the same param tree the Flax module trains (names line
+# up 1:1 — wte/wpe/h_i/{ln_1,attn{qkv,attn_out},ln_2,mlp{...}}/ln_f/
+# lm_head), so served weights are exactly the trained ones.  Callers jit
+# them (the engine jits gather -> decode -> scatter as one step).
+# ----------------------------------------------------------------------
+
+_LN_EPS = 1e-6  # flax.linen.LayerNorm default, matches the training path
+
+
+def _ln(x, p, dtype):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) / jnp.sqrt(var + _LN_EPS)
+    return (out * p["scale"] + p["bias"]).astype(dtype)
+
+
+def _dense(x, p, dtype):
+    out = x @ p["kernel"].astype(dtype)
+    if "bias" in p:
+        out = out + p["bias"].astype(dtype)
+    return out
+
+
+def _split_heads(t, n_head):
+    *lead, d = t.shape
+    return t.reshape(*lead, n_head, d // n_head)
+
+
+def prefill_forward(params, cfg: GPT2Config, tokens, last_index=None):
+    """Full-prompt forward from position 0.
+
+    tokens [B, T] -> (logits_last [B, vocab], k [L, B, T, H, Dh],
+    v [L, B, T, H, Dh]).  Causal attention within the prompt; the
+    returned per-layer K/V are what the decode path attends back to.
+    ``last_index`` [B] selects which position's logits to return (for
+    right-padded prompts — pad K/V are discarded by the caller's
+    scatter); default is the final position.
+    """
+    dtype = cfg.dtype
+    B, T = tokens.shape
+    pos = jnp.arange(T)[None, :]
+    x = params["wte"]["embedding"].astype(dtype)[tokens]
+    x = x + params["wpe"]["embedding"].astype(dtype)[pos]
+    ks, vs = [], []
+    for i in range(cfg.n_layer):
+        blk = params[f"h_{i}"]
+        h = _ln(x, blk["ln_1"], dtype)
+        qkv = _dense(h, blk["attn"]["qkv"], dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))
+        from ray_tpu.ops.attention import reference_causal_attention
+
+        att = reference_causal_attention(q, k, v)
+        att = att.reshape(B, T, cfg.d_model)
+        x = x + _dense(att, blk["attn"]["attn_out"], dtype)
+        h2 = _ln(x, blk["ln_2"], dtype)
+        m = nn.gelu(_dense(h2, blk["mlp"]["mlp_up"], dtype))
+        x = x + _dense(m, blk["mlp"]["mlp_down"], dtype)
+        ks.append(k)
+        vs.append(v)
+    x = _ln(x, params["ln_f"], dtype)
+    if last_index is None:
+        x_last = x[:, -1, :]
+    else:
+        x_last = x[jnp.arange(B), last_index, :]
+    logits_last = _dense(x_last, params["lm_head"], dtype)
+    return logits_last, jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_forward(params, cfg: GPT2Config, tok, pos, k_ctx, v_ctx, ctx_mask):
+    """One decode step over an externally-gathered KV context.
+
+    tok [B] current token ids; pos [B] their positions;
+    k_ctx/v_ctx [L, B, C, H, Dh] the per-layer cached keys/values for
+    positions < pos (padded; ctx_mask [B, C] marks real entries).
+    Returns (logits [B, vocab], k_new [L, B, H, Dh], v_new [L, B, H, Dh])
+    — the caller scatters k_new/v_new into its cache at position pos.
+    """
+    dtype = cfg.dtype
+    d_head = cfg.d_model // cfg.n_head
+    scale = 1.0 / (d_head**0.5)
+    x = params["wte"]["embedding"].astype(dtype)[tok]
+    x = x + params["wpe"]["embedding"].astype(dtype)[pos]
+    k_news, v_news = [], []
+    neg = jnp.float32(-1e30)
+    for i in range(cfg.n_layer):
+        blk = params[f"h_{i}"]
+        h = _ln(x, blk["ln_1"], dtype)
+        qkv = _dense(h, blk["attn"]["qkv"], dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (_split_heads(t, cfg.n_head) for t in (q, k, v))  # [B, H, Dh]
+        # scores over the cached context plus the current token itself
+        s_ctx = jnp.einsum("bhd,bchd->bhc", q, k_ctx[i]).astype(jnp.float32) * scale
+        s_ctx = jnp.where(ctx_mask[:, None, :], s_ctx, neg)
+        s_self = (q * k).sum(-1).astype(jnp.float32)[..., None] * scale  # [B, H, 1]
+        probs = jax.nn.softmax(jnp.concatenate([s_ctx, s_self], axis=-1), axis=-1)
+        probs = probs.astype(dtype)
+        att = jnp.einsum("bhc,bchd->bhd", probs[..., :-1], v_ctx[i])
+        att = att + probs[..., -1:] * v
+        att = att.reshape(tok.shape[0], cfg.d_model)
+        x = x + _dense(att, blk["attn"]["attn_out"], dtype)
+        h2 = _ln(x, blk["ln_2"], dtype)
+        m = nn.gelu(_dense(h2, blk["mlp"]["mlp_up"], dtype))
+        x = x + _dense(m, blk["mlp"]["mlp_down"], dtype)
+        k_news.append(k)
+        v_news.append(v)
+    x = _ln(x, params["ln_f"], dtype)
+    logits = _dense(x, params["lm_head"], dtype)
+    return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+
+def sample_logits(logits, rng, temperature, top_k: int = 0):
+    """Per-sequence sampling: temperature <= 0 means greedy (argmax);
+    otherwise softmax sampling at that temperature, optionally truncated
+    to the top_k highest-probability tokens (static; 0 = off).
+
+    logits [B, V], temperature [B] -> token ids [B] (int32).
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def generate_greedy(params, cfg: GPT2Config, tokens, n_new: int):
+    """Reference full-forward greedy generation (no KV cache): re-runs
+    the Flax model over the growing sequence.  O(T^2) per token — test
+    oracle and tiny-scale baseline only."""
+    model = GPT2(cfg)
+    out = tokens
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, out)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(out.dtype)
+        out = jnp.concatenate([out, nxt[:, None]], axis=1)
+    return out[:, tokens.shape[1]:]
+
+
 def num_params(params) -> int:
     from ray_tpu.models.common import num_params as _n
 

@@ -22,6 +22,10 @@ class DeploymentConfig:
     name: str
     num_replicas: int = 1
     max_ongoing_requests: int = 100
+    # proxy-enforced load-shedding bound: requests in flight through a
+    # proxy beyond this are shed with 503 + Retry-After (-1 = unbounded;
+    # reference: serve/config.py max_queued_requests)
+    max_queued_requests: int = -1
     route_prefix: Optional[str] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)

@@ -26,6 +26,13 @@ def lp_replicas_key(deployment: str) -> str:
 
 
 class ServeController(LongPollHost):
+    # cadence of the per-replica stats poll (doubles as the RUNNING
+    # health check); consecutive failures before a replica is declared
+    # DEAD (one failure may be a transient under load)
+    STATS_INTERVAL_S = 1.0
+    STATS_FAILS_TO_DEAD = 2
+    STOP_GRACE_S = 2.0
+
     def __init__(self):
         import ray_tpu
 
@@ -38,6 +45,8 @@ class ServeController(LongPollHost):
         # replica-set snapshot per deployment, pushed to long-poll
         # listeners whenever membership changes
         self._last_pushed: Dict[str, Any] = {}
+        # replicas draining toward kill: [{replica, stop_ref, deadline}]
+        self._stopping: List[dict] = []
 
     async def _ensure_loop(self):
         if self._loop_task is None:
@@ -101,6 +110,23 @@ class ServeController(LongPollHost):
         self._stopped = True
         for name in list(self.deployments):
             await self.delete_deployment(name)
+        # the reconcile loop is stopping: give prepare_shutdown a short
+        # grace, then kill whatever is still draining
+        if self._stopping:
+            try:
+                self._ray.wait(
+                    [e["stop_ref"] for e in self._stopping],
+                    num_returns=len(self._stopping),
+                    timeout=self.STOP_GRACE_S,
+                )
+            except Exception:
+                pass
+            for entry in self._stopping:
+                try:
+                    self._ray.kill(entry["replica"]["actor"])
+                except Exception:
+                    pass
+            self._stopping.clear()
         return True
 
     # -- reconciliation --------------------------------------------------
@@ -154,6 +180,14 @@ class ServeController(LongPollHost):
                         r["state"] = "RUNNING"
                     except Exception:
                         r["state"] = "DEAD"
+            # poll RUNNING replica stats: the queue-depth autoscaling
+            # signal (ongoing + the deployment's internal queue) AND the
+            # liveness probe — a replica whose stats call keeps failing
+            # (e.g. chaos-killed) is declared DEAD and replaced above on
+            # the next tick, with the membership change long-polled to
+            # routers
+            self._poll_replica_stats(name, dep)
+        self._reap_stopping()
         # push replica-set changes to long-poll listeners (routers)
         for name, dep in self.deployments.items():
             snapshot = [
@@ -165,13 +199,81 @@ class ServeController(LongPollHost):
                 self._last_pushed[name] = snapshot
                 self.notify_changed(lp_replicas_key(name), snapshot)
 
+    def _poll_replica_stats(self, name: str, dep: dict):
+        now = time.monotonic()
+        loads: List[float] = []
+        for r in dep["replicas"]:
+            if r["state"] != "RUNNING":
+                continue
+            ref = r.get("stats_ref")
+            if ref is not None:
+                ready, _ = self._ray.wait([ref], num_returns=1, timeout=0)
+                if not ready:
+                    continue
+                r.pop("stats_ref")
+                try:
+                    stats = self._ray.get(ref)
+                    r["stats_fails"] = 0
+                    if stats.get("has_queue_hook"):
+                        r["load"] = float(stats.get("queued") or 0)
+                    else:
+                        r["load"] = float(stats.get("ongoing", 0))
+                except Exception:
+                    r["stats_fails"] = r.get("stats_fails", 0) + 1
+                    if r["stats_fails"] >= self.STATS_FAILS_TO_DEAD:
+                        logger.warning(
+                            "serve: replica %s failed %d stats probes — DEAD",
+                            r["replica_id"], r["stats_fails"],
+                        )
+                        r["state"] = "DEAD"
+                        continue
+            if "load" in r:
+                loads.append(r["load"])
+            if now - r.get("stats_t", 0.0) >= self.STATS_INTERVAL_S and \
+                    "stats_ref" not in r:
+                try:
+                    r["stats_ref"] = r["actor"].stats.remote()
+                    r["stats_t"] = now
+                except Exception:
+                    r["stats_fails"] = r.get("stats_fails", 0) + 1
+                    if r["stats_fails"] >= self.STATS_FAILS_TO_DEAD:
+                        r["state"] = "DEAD"
+        if loads:
+            self._load_history.setdefault(name, []).append(sum(loads) / len(loads))
+            self._load_history[name] = self._load_history[name][-60:]
+
+    def _reap_stopping(self):
+        """Kill gracefully-stopping replicas once prepare_shutdown
+        resolves (or the grace deadline passes)."""
+        now = time.monotonic()
+        for entry in list(self._stopping):
+            done = now >= entry["deadline"]
+            if not done:
+                ready, _ = self._ray.wait(
+                    [entry["stop_ref"]], num_returns=1, timeout=0
+                )
+                done = bool(ready)
+            if done:
+                try:
+                    self._ray.kill(entry["replica"]["actor"])
+                except Exception:
+                    pass
+                self._stopping.remove(entry)
+
     def _push_route_table(self):
         # route_prefix == "" means explicitly unrouted (internal
-        # deployments of a graph app — only the ingress is exposed)
+        # deployments of a graph app — only the ingress is exposed).
+        # Values carry per-deployment proxy config (load-shedding bound)
+        # alongside the name; the proxy normalizes either shape.
         self.notify_changed(
             LP_ROUTE_TABLE,
             {
-                (dep["config"].get("route_prefix") or f"/{name}"): name
+                (dep["config"].get("route_prefix") or f"/{name}"): {
+                    "name": name,
+                    "max_queued_requests": dep["config"].get(
+                        "max_queued_requests", -1
+                    ),
+                }
                 for name, dep in self.deployments.items()
                 if dep["config"].get("route_prefix") != ""
             },
@@ -186,7 +288,12 @@ class ServeController(LongPollHost):
         opts.setdefault("num_cpus", 0.1)
         opts["name"] = actor_name
         opts["namespace"] = "serve"
-        opts["max_concurrency"] = 1000
+        # streams hold an actor-concurrency slot for their whole life:
+        # a deployment sized for thousands of ongoing requests (the LLM
+        # plane) must not hit the actor cap before its own admission
+        opts["max_concurrency"] = max(
+            1000, 2 * int(cfg.get("max_ongoing_requests") or 0)
+        )
         actor = self._ray.remote(**opts)(Replica).remote(
             rid, name, init, cfg.get("user_config"), cfg.get("max_ongoing_requests", 100)
         )
@@ -200,10 +307,22 @@ class ServeController(LongPollHost):
         }
 
     def _stop_replica(self, r):
+        # two-phase: prepare_shutdown first (cancels @serve.batch worker
+        # tasks, stops the LLM engine's step loop and frees its KV
+        # blocks), the kill lands when it resolves or after STOP_GRACE_S
         try:
-            self._ray.kill(r["actor"])
+            self._stopping.append(
+                {
+                    "replica": r,
+                    "stop_ref": r["actor"].prepare_shutdown.remote(),
+                    "deadline": time.monotonic() + self.STOP_GRACE_S,
+                }
+            )
         except Exception:
-            pass
+            try:
+                self._ray.kill(r["actor"])
+            except Exception:
+                pass
         r["state"] = "DEAD"
         logger.info("serve: stopped replica %s", r["replica_id"])
 

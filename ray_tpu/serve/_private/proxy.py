@@ -18,6 +18,13 @@ class ProxyActor:
         self.host = host
         self._handles: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment name
+        # deployment -> proxy-enforced config (load-shedding bound)
+        self._route_cfg: Dict[str, dict] = {}
+        # deployment -> requests in flight through this proxy; past the
+        # deployment's max_queued_requests new work is SHED (503 +
+        # Retry-After) so overload degrades instead of queueing unboundedly
+        self._inflight: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
         self._started = False
         # Dedicated pool for routing: pick() can block up to 30s during a
         # cold start — on the shared default executor a burst of such
@@ -44,6 +51,7 @@ class ProxyActor:
         app = web.Application()
         app.router.add_route("*", "/-/routes", self._routes_endpoint)
         app.router.add_route("*", "/-/healthz", self._healthz)
+        app.router.add_route("*", "/-/stats", self._stats_endpoint)
         app.router.add_route("*", "/{tail:.*}", self._handle)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -58,18 +66,77 @@ class ProxyActor:
             self._started = True
         return True
 
+    def _apply_route_table(self, table):
+        """Normalize either route-table shape: legacy ``prefix -> name``
+        strings or ``prefix -> {name, max_queued_requests}`` dicts."""
+        routes: Dict[str, str] = {}
+        cfg: Dict[str, dict] = {}
+        for prefix, v in table.items():
+            if isinstance(v, dict):
+                routes[prefix] = v["name"]
+                cfg[v["name"]] = v
+            else:
+                routes[prefix] = v
+        self._routes = routes
+        self._route_cfg = cfg
+
     def _on_routes_pushed(self, table):
-        self._routes = dict(table)
+        self._apply_route_table(table)
 
     async def _refresh_routes(self):
         import ray_tpu
 
         deployments = await self._await_ref(self._controller.list_deployments.remote())
-        self._routes = {
-            (dep["config"].get("route_prefix") or f"/{name}"): name
-            for name, dep in deployments.items()
-            if dep["config"].get("route_prefix") != ""  # "" = unrouted
-        }
+        self._apply_route_table(
+            {
+                (dep["config"].get("route_prefix") or f"/{name}"): {
+                    "name": name,
+                    "max_queued_requests": dep["config"].get(
+                        "max_queued_requests", -1
+                    ),
+                }
+                for name, dep in deployments.items()
+                if dep["config"].get("route_prefix") != ""  # "" = unrouted
+            }
+        )
+
+    # -- load shedding ---------------------------------------------------
+    def _try_admit(self, name: str):
+        """Admit one request against the deployment's in-flight bound;
+        returns the 503 response when shed, else None (admitted — the
+        caller MUST balance with _release)."""
+        limit = int(self._route_cfg.get(name, {}).get("max_queued_requests", -1) or -1)
+        cur = self._inflight.get(name, 0)
+        if limit >= 0 and cur >= limit:
+            self._shed[name] = self._shed.get(name, 0) + 1
+            from ray_tpu._private import telemetry
+
+            telemetry.count_serve_shed(name, "proxy")
+            from aiohttp import web
+
+            return web.Response(
+                status=503,
+                headers={"Retry-After": "1"},
+                text=f"deployment {name} is at its queue bound ({limit}); retry",
+            )
+        self._inflight[name] = cur + 1
+        return None
+
+    def _release(self, name: str):
+        self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+
+    @staticmethod
+    def _shed_retry_after(e) -> str:
+        """Retry-After for a RequestShedError that may have crossed the
+        task boundary: the re-raised wrapper is a derived RayTaskError
+        that carries only the original as ``.cause``."""
+        v = getattr(e, "retry_after_s", None)
+        if v is None:
+            v = getattr(getattr(e, "cause", None), "retry_after_s", None)
+        try:
+            return str(max(1, int(v or 1)))
+        except (TypeError, ValueError):
+            return "1"
 
     async def _await_ref(self, ref):
         import ray_tpu
@@ -88,15 +155,36 @@ class ProxyActor:
 
         return web.Response(text="ok")
 
-    async def _handle_stream(self, request, handle, payload):
+    async def _stats_endpoint(self, request):
+        """Per-deployment proxy counters: in-flight and shed totals."""
+        from aiohttp import web
+
+        return web.json_response(
+            {"inflight": dict(self._inflight), "shed": dict(self._shed)}
+        )
+
+    async def _handle_stream(self, request, handle, payload, name: str):
         """Chunked response over a generator deployment: each yielded
         item becomes one chunk (json for dict/list, utf-8 text, raw
-        bytes pass through); reference: http_util.py Response streaming."""
+        bytes pass through); reference: http_util.py Response streaming.
+
+        Disconnect-cancel contract (docs/serving.md): dict payloads get
+        a ``__serve_stream_cancel__`` hint; a deployment that supports
+        server-side cancellation answers with a FIRST stream item
+        ``{"__serve_stream_meta__": {"request_id", "cancel_method"}}``
+        (consumed here, never forwarded).  If the HTTP client goes away
+        mid-stream, the proxy calls that method so the replica releases
+        the request's resources (the LLM engine frees its KV blocks)."""
         import json as _json
 
         from aiohttp import web
 
+        from ray_tpu.serve.exceptions import RequestShedError
+
         loop = asyncio.get_event_loop()
+        if isinstance(payload, dict):
+            payload = dict(payload)
+            payload["__serve_stream_cancel__"] = True
         stream_handle = handle.options(stream=True)
         try:
             gen = await loop.run_in_executor(
@@ -114,15 +202,26 @@ class ProxyActor:
                 return False, None
 
         # fetch the FIRST item before committing headers: an error
-        # before any yield still gets a clean 500
+        # before any yield still gets a clean 500/503
+        cancel_meta = None
         try:
             more, item = await loop.run_in_executor(None, next_item)
+            if more and isinstance(item, dict) and "__serve_stream_meta__" in item:
+                cancel_meta = item["__serve_stream_meta__"]
+                more, item = await loop.run_in_executor(None, next_item)
+        except RequestShedError as e:
+            return web.Response(
+                status=503,
+                headers={"Retry-After": self._shed_retry_after(e)},
+                text=str(e),
+            )
         except Exception as e:  # noqa: BLE001
             logger.exception("stream failed before first item")
             return web.Response(status=500, text=str(e))
         resp = web.StreamResponse()
         resp.enable_chunked_encoding()
         await resp.prepare(request)
+        disconnected = False
         try:
             while more:
                 if isinstance(item, (bytes, bytearray)):
@@ -133,12 +232,32 @@ class ProxyActor:
                     chunk = str(item).encode()
                 await resp.write(chunk)
                 more, item = await loop.run_in_executor(None, next_item)
+        except (ConnectionResetError, ConnectionError):
+            disconnected = True
         except Exception:  # noqa: BLE001 — mid-stream replica error:
             # headers are committed; terminate the chunked body cleanly
             # rather than tearing the connection down
             logger.exception("stream failed mid-body")
         finally:
-            await resp.write_eof()
+            if disconnected and cancel_meta:
+                try:
+                    # the cancel must reach the SAME replica serving this
+                    # stream — a load-balanced handle call would land on
+                    # a peer whose engine has no such request id
+                    gen.call_same_replica(
+                        cancel_meta.get("cancel_method", "cancel"),
+                        cancel_meta["request_id"],
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.exception("disconnect-cancel failed")
+            try:
+                gen.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                await resp.write_eof()
+            except (ConnectionResetError, ConnectionError):
+                pass
         return resp
 
     async def _handle(self, request):
@@ -174,32 +293,52 @@ class ProxyActor:
             payload = dict(request.query)
             # transport-level control key, never user data
             payload.pop("serve_stream", None)
+        from ray_tpu.serve.exceptions import RequestShedError
+
         loop = asyncio.get_event_loop()
-        # streaming opt-in (reference: StreamingResponse deployments):
-        # chunked transfer, one chunk per yielded item
-        if request.headers.get("x-serve-stream") == "1" or request.query.get(
-            "serve_stream"
-        ) == "1":
-            return await self._handle_stream(request, handle, payload)
+        shed = self._try_admit(name)
+        if shed is not None:
+            return shed
         try:
-            # Routing may block (cold start waits for a replica, refresh
-            # does a blocking get) — keep it off the proxy event loop so
-            # /-/healthz and other deployments stay responsive.
-            response = await loop.run_in_executor(self._route_pool, handle.remote, payload)
-        except Exception as e:  # noqa: BLE001
-            logger.exception("proxy routing failed")
-            return web.Response(status=500, text=str(e))
-        try:
-            result = await self._await_ref(response.object_ref)
-        except Exception as e:  # noqa: BLE001
-            logger.exception("proxy request failed")
-            return web.Response(status=500, text=str(e))
+            # streaming opt-in (reference: StreamingResponse deployments):
+            # chunked transfer, one chunk per yielded item
+            if request.headers.get("x-serve-stream") == "1" or request.query.get(
+                "serve_stream"
+            ) == "1":
+                return await self._handle_stream(request, handle, payload, name)
+            try:
+                # Routing may block (cold start waits for a replica,
+                # refresh does a blocking get) — keep it off the proxy
+                # event loop so /-/healthz and other deployments stay
+                # responsive.
+                response = await loop.run_in_executor(
+                    self._route_pool, handle.remote, payload
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.exception("proxy routing failed")
+                return web.Response(status=500, text=str(e))
+            try:
+                result = await self._await_ref(response.object_ref)
+            except RequestShedError as e:
+                # the engine shed it (typed, retryable): surface as 503,
+                # same contract as the proxy's own bound
+                return web.Response(
+                    status=503,
+                    headers={"Retry-After": self._shed_retry_after(e)},
+                    text=str(e),
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.exception("proxy request failed")
+                return web.Response(status=500, text=str(e))
+            finally:
+                # Always decrement the in-flight estimate — a failed
+                # request must not permanently bias pow-2 routing and
+                # autoscaling.
+                response._router.done(response._replica_id)
+            if isinstance(result, (dict, list)):
+                return web.json_response(result)
+            if isinstance(result, bytes):
+                return web.Response(body=result)
+            return web.Response(text=str(result))
         finally:
-            # Always decrement the in-flight estimate — a failed request
-            # must not permanently bias pow-2 routing and autoscaling.
-            response._router.done(response._replica_id)
-        if isinstance(result, (dict, list)):
-            return web.json_response(result)
-        if isinstance(result, bytes):
-            return web.Response(body=result)
-        return web.Response(text=str(result))
+            self._release(name)

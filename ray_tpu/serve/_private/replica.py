@@ -98,10 +98,57 @@ class Replica:
         return self._ongoing
 
     def stats(self) -> Dict[str, Any]:
-        return {"replica_id": self.replica_id, "ongoing": self._ongoing, "total": self._total}
+        """Replica load snapshot; doubles as the controller's health
+        check and autoscaling feed.  A deployment exposing
+        ``__serve_stats__`` contributes extra fields — ``queued`` (its
+        internal queue depth, e.g. the LLM engine's waiting+running) is
+        what queue-depth autoscaling keys on."""
+        out = {"replica_id": self.replica_id, "ongoing": self._ongoing,
+               "total": self._total, "queued": 0}
+        hook = getattr(self.callable, "__serve_stats__", None)
+        if callable(hook):
+            try:
+                extra = hook()
+                if isinstance(extra, dict):
+                    out.update(extra)
+                    # a deployment-reported queue REPLACES ongoing as the
+                    # load signal (an open stream sitting in a decode
+                    # lane is both — adding would double count)
+                    out["has_queue_hook"] = True
+            except Exception:  # noqa: BLE001 — stats must not fail health checks
+                pass
+        return out
 
     def ping(self) -> str:
         return "pong"
 
-    def prepare_shutdown(self):
+    async def prepare_shutdown(self):
+        """Graceful teardown: cancel @serve.batch worker tasks (they are
+        pending tasks on this loop and would leak past actor kill) and
+        run the deployment's async ``__serve_shutdown__`` hook (e.g. the
+        LLM engine stops its step loop and frees every KV block)."""
+        import inspect as _inspect
+
+        for name in dir(self.callable):
+            if name.startswith("__"):
+                continue
+            try:
+                attr = getattr(self.callable, name)
+            except Exception:  # noqa: BLE001
+                continue
+            queues = getattr(attr, "_serve_batch_queues", None)
+            if isinstance(queues, dict):
+                for q in queues.values():
+                    try:
+                        q.shutdown()
+                    except Exception:  # noqa: BLE001
+                        pass
+        hook = getattr(self.callable, "__serve_shutdown__", None)
+        if callable(hook):
+            try:
+                result = hook()
+                if _inspect.iscoroutine(result):
+                    await result
+            except Exception:  # noqa: BLE001
+                pass
         return True

@@ -166,6 +166,26 @@ class Router:
         with self._lock:
             self._queue_estimate[replica_id] = max(0, self._queue_estimate.get(replica_id, 1) - 1)
 
+    def get_replica_actor(self, replica_id: str):
+        """The actor handle for one replica, or None if it left the set
+        (used for replica-targeted calls like disconnect-cancel, which
+        must NOT be load-balanced to a peer)."""
+        with self._lock:
+            for r in self._replicas:
+                if r["replica_id"] == replica_id:
+                    return r["actor"]
+        return None
+
+    def evict(self, replica_id: str):
+        """Drop a replica observed dead (ActorDiedError surfaced through
+        a response) so the very next pick avoids it — one RTT faster
+        than waiting for the controller's health check + long-poll push."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r["replica_id"] != replica_id]
+            self._queue_estimate.pop(replica_id, None)
+            for rids in self._model_locations.values():
+                rids.discard(replica_id)
+
     def close(self):
         self._long_poll.stop()
 

@@ -64,6 +64,7 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: int = 1,
     max_ongoing_requests: int = 100,
+    max_queued_requests: int = -1,
     route_prefix: Optional[str] = None,
     autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
     ray_actor_options: Optional[dict] = None,
@@ -81,6 +82,7 @@ def deployment(
             name=name or target.__name__,
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             route_prefix=route_prefix,
             autoscaling_config=auto,
             ray_actor_options=ray_actor_options or {},

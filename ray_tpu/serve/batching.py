@@ -20,16 +20,34 @@ class _BatchQueue:
 
     def _ensure(self):
         if self.queue is None:
+            # bind to the loop the first call RUNS on — get_event_loop()
+            # returns the thread's (possibly different, possibly not yet
+            # running) loop and the worker task then never wakes
+            loop = asyncio.get_running_loop()
             self.queue = asyncio.Queue()
-            self._worker = asyncio.get_event_loop().create_task(self._loop())
+            self._worker = loop.create_task(self._loop())
+
+    def shutdown(self):
+        """Cancel the worker task (replica teardown) and fail pending
+        callers instead of leaving them awaiting forever."""
+        worker, self._worker = self._worker, None
+        if worker is not None and not worker.done():
+            worker.cancel()
+        if self.queue is not None:
+            while not self.queue.empty():
+                _, fut = self.queue.get_nowait()
+                if not fut.done():
+                    fut.cancel()
+            self.queue = None
 
     async def _loop(self):
+        loop = asyncio.get_running_loop()
         while True:
             first = await self.queue.get()
             batch = [first]
-            deadline = asyncio.get_event_loop().time() + self.timeout
+            deadline = loop.time() + self.timeout
             while len(batch) < self.max_batch_size:
-                remaining = deadline - asyncio.get_event_loop().time()
+                remaining = deadline - loop.time()
                 if remaining <= 0:
                     break
                 try:
@@ -55,7 +73,7 @@ class _BatchQueue:
 
     async def submit(self, arg) -> Any:
         self._ensure()
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         await self.queue.put((arg, fut))
         return await fut
 
@@ -83,6 +101,9 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
                 queues[None] = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
             return await queues[None].submit(item)
 
+        # teardown hook: Replica.prepare_shutdown cancels these workers
+        # so replica stop doesn't leak a pending task per batch method
+        wrapper._serve_batch_queues = queues
         return wrapper
 
     if _fn is not None:

@@ -1,0 +1,18 @@
+"""Typed serve-plane errors shared by the proxy and the LLM engine
+(import-light on purpose: the proxy catches these without pulling in
+jax/model code)."""
+
+from __future__ import annotations
+
+
+class RequestShedError(RuntimeError):
+    """The request was shed by an overload bound (engine waiting queue or
+    proxy per-deployment in-flight cap) — retryable after backoff; the
+    HTTP proxy maps it to 503 + Retry-After."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+    def __reduce__(self):
+        return (RequestShedError, (str(self), self.retry_after_s))

@@ -1,0 +1,90 @@
+"""LLM serving configuration (reference: vLLM EngineArgs / ray.serve.llm
+LLMConfig, scaled down to the knobs this engine actually has)."""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+def tokenize_prompt(prompt: Any, vocab_size: int) -> list:
+    """Token ids from a prompt: pass-through for int lists, byte-level
+    (mod vocab) for strings.  The placeholder tokenizer shared by the
+    continuous engine and the static-batch baseline — a real tokenizer
+    is a follow-up (docs/serving.md)."""
+    if isinstance(prompt, str):
+        return [b % vocab_size for b in prompt.encode("utf-8")] or [0]
+    if isinstance(prompt, (list, tuple)):
+        return [int(t) for t in prompt] or [0]
+    raise TypeError(f"prompt must be str or list[int], got {type(prompt)}")
+
+
+@dataclass
+class LLMConfig:
+    """Engine + cache sizing for one LLM deployment.
+
+    KV sizing: the block pool holds ``num_blocks * block_size`` token
+    slots (block 0 is a reserved scratch block, never allocated).  A
+    request reserves ``ceil((len(prompt) + max_tokens) / block_size)``
+    blocks at admission — conservative, so a request admitted once can
+    never die of cache exhaustion mid-decode.  ``max_batch_size`` is the
+    number of decode lanes: the continuous batcher keeps them full by
+    joining waiting requests at step boundaries.
+    """
+
+    # model
+    model: str = "tiny"  # GPT2Config preset: tiny | small | medium | large
+    seed: int = 0  # synthetic-weights init seed (no checkpoint loading yet)
+    dtype: str = "float32"  # serving compute dtype ("bfloat16" on TPU)
+
+    # batching / cache
+    max_batch_size: int = 8  # concurrent decode lanes
+    block_size: int = 16  # tokens per KV block
+    num_blocks: int = 256  # pool size incl. the reserved scratch block 0
+    max_model_len: int = 0  # 0 = the model's max_seq_len
+
+    # admission / generation defaults
+    max_queue: int = 256  # waiting requests beyond this are shed
+    default_max_tokens: int = 32
+    temperature: float = 0.0  # <= 0 means greedy
+    top_k: int = 0  # 0 = off (static engine-wide truncation)
+    eos_token: int = -1  # -1 = generate to max_tokens
+
+    # observability
+    name: str = "llm"  # metrics label (the deployment name, bounded)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def coerce(cls, value: Optional[Any]) -> "LLMConfig":
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(f"llm_config must be LLMConfig or dict, got {type(value)}")
+
+    def model_config(self):
+        """Resolve the GPT2Config preset with the serving dtype."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.gpt2 import GPT2Config
+
+        preset = getattr(GPT2Config, self.model, None)
+        if preset is None or self.model.startswith("_"):
+            raise ValueError(
+                f"unknown model preset {self.model!r} "
+                "(expected tiny | small | medium | large)"
+            )
+        dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}.get(self.dtype)
+        if dtype is None:
+            raise ValueError(f"unsupported serving dtype {self.dtype!r}")
+        return preset(dtype=dtype)
+
+    @property
+    def max_context(self) -> int:
+        cfg = self.model_config()
+        return min(self.max_model_len or cfg.max_seq_len, cfg.max_seq_len)

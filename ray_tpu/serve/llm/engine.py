@@ -1,0 +1,551 @@
+"""Token-granular LLM engine with continuous in-flight batching
+(reference: vLLM LLMEngine / Ray Serve llm deployment, scaled to this
+runtime; PAPERS.md "Fine-Tuning and Serving Gemma 4 31B on Google Cloud
+TPU" for the TPU-native decode shape).
+
+Execution model: one asyncio loop task per engine ("the step loop").
+Each iteration is a **step boundary**:
+
+1. cancelled sequences leave the batch and free their KV blocks;
+2. waiting requests join free decode lanes (admission reserved their
+   whole KV need up front, so a joined request can never die of pool
+   exhaustion) — each join runs a bucketed, jitted prefill that writes
+   the prompt's K/V straight into its pages and samples the first token
+   (TTFT is measured here);
+3. one jitted decode step advances EVERY active lane a token:
+   gather pages -> decode_forward -> scatter new K/V -> sample.
+
+Tokens stream to per-request asyncio queues; the serve replica's
+``handle_request_stream`` path turns them into stream items.  The jitted
+compute runs in the default executor so the replica's event loop (joins,
+stream consumption, stats) stays responsive during a step.
+
+Request spans (``serve.request`` -> ``serve.queue`` / ``serve.prefill``
+/ ``serve.decode``) are recorded per request so ``state.traces()``
+critical-path analysis attributes end-to-end latency to queue vs prefill
+vs decode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.serve.exceptions import RequestShedError
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.kv_cache import BlockManager
+
+logger = logging.getLogger(__name__)
+
+# end-of-stream sentinel pushed onto a request's output queue
+FINISHED = object()
+
+
+@dataclass
+class _Request:
+    request_id: str
+    prompt: List[int]
+    max_tokens: int
+    temperature: float
+    out: "asyncio.Queue"
+    t_submit: float
+    # span plumbing: (trace_id, root_span_id, parent_span_id or None)
+    trace: tuple = ()
+    slot: int = -1
+    generated: int = 0
+    finish_reason: str = ""
+    cancelled: bool = False
+    t_join: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+    join_step: int = -1
+    finish_step: int = -1
+    tokens: List[int] = field(default_factory=list)
+
+
+class LLMEngine:
+    """One engine per replica; owns the model params, the paged KV cache,
+    and the continuous-batching step loop."""
+
+    def __init__(self, config: Optional[Any] = None):
+        self.config = LLMConfig.coerce(config)
+        self.model_cfg = self.config.model_config()
+        self.max_ctx = self.config.max_context
+        self.bm = BlockManager(self.config.num_blocks, self.config.block_size)
+        # usable pool excludes the reserved scratch block 0: a max-length
+        # sequence must fit in the ALLOCATABLE blocks, or a max-size
+        # request would pass admission bounds yet park forever
+        if self.bm.blocks_needed(self.max_ctx) > self.config.num_blocks - 1:
+            raise ValueError(
+                "KV pool smaller than one max-length sequence: "
+                f"{self.config.num_blocks - 1} usable blocks < "
+                f"{self.bm.blocks_needed(self.max_ctx)} needed for "
+                f"max_context {self.max_ctx}"
+            )
+        self._build_model()
+        self.slots: List[Optional[_Request]] = [None] * self.config.max_batch_size
+        self.waiting: Deque[_Request] = collections.deque()
+        self._by_id: Dict[str, _Request] = {}
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._stopped = False
+        self.step_count = 0
+        self._rng_counter = 0
+        # (wall time, tokens emitted) per step, for the tokens/s gauge
+        self._tok_window: Deque[tuple] = collections.deque(maxlen=512)
+        self._total_tokens = 0
+        self._shed_total = 0
+        self._shed_unreported = 0
+        self._last_metrics_push = 0.0
+
+    # -- model / jit ----------------------------------------------------
+    def _build_model(self):
+        import jax
+
+        import jax.numpy as jnp
+
+        from ray_tpu.models import gpt2
+
+        cfg = self.model_cfg
+        self.params = gpt2.init_params(cfg, rng=jax.random.PRNGKey(self.config.seed))
+        L, H = cfg.n_layer, cfg.n_head
+        d_head = cfg.d_model // H
+        P = self.bm.num_slots
+        self.k_pages = jnp.zeros((L, P, H, d_head), cfg.dtype)
+        self.v_pages = jnp.zeros((L, P, H, d_head), cfg.dtype)
+        self._base_key = jax.random.PRNGKey(self.config.seed + 1)
+        top_k = self.config.top_k
+
+        def prefill_step(params, k_pages, v_pages, tokens, phys, last_idx, temp, rng):
+            # tokens [1, Tpad]; phys [Tpad] (scratch slot 0 at pads);
+            # logits taken at the last REAL position, not the pad tail.
+            logits, k, v = gpt2.prefill_forward(params, cfg, tokens, last_index=last_idx)
+            k_pages = k_pages.at[:, phys].set(k[:, 0])
+            v_pages = v_pages.at[:, phys].set(v[:, 0])
+            first = gpt2.sample_logits(logits, rng, temp, top_k)
+            return first[0], k_pages, v_pages
+
+        def decode_step(params, k_pages, v_pages, tok, pos, idx, mask, write_phys, temp, rng):
+            # gather each lane's context pages, advance one token, write
+            # the new K/V back at write_phys (inactive lanes hit slot 0)
+            k_ctx = k_pages[:, idx]  # [L, B, C, H, Dh]
+            v_ctx = v_pages[:, idx]
+            logits, k_new, v_new = gpt2.decode_forward(
+                params, cfg, tok, pos, k_ctx, v_ctx, mask
+            )
+            k_pages = k_pages.at[:, write_phys].set(k_new)
+            v_pages = v_pages.at[:, write_phys].set(v_new)
+            nxt = gpt2.sample_logits(logits, rng, temp, top_k)
+            return nxt, k_pages, v_pages
+
+        self._prefill_jit = jax.jit(prefill_step, donate_argnums=(1, 2))
+        self._decode_jit = jax.jit(decode_step, donate_argnums=(1, 2))
+
+    def _next_rng(self):
+        import jax
+
+        self._rng_counter += 1
+        return jax.random.fold_in(self._base_key, self._rng_counter)
+
+    @staticmethod
+    def _prefill_bucket(n: int, cap: int) -> int:
+        """Pad prompts to power-of-two buckets (min 8) so prefill
+        compiles once per bucket, not once per prompt length."""
+        b = 8
+        while b < n:
+            b *= 2
+        return min(b, cap)
+
+    # -- public API ------------------------------------------------------
+    def ensure_started(self):
+        """Start (or restart) the step loop on the current event loop."""
+        if self._loop_task is None or self._loop_task.done():
+            self._stopped = False
+            self._wake = self._wake or asyncio.Event()
+            self._loop_task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self):
+        self._stopped = True
+        if self._wake is not None:
+            self._wake.set()
+        task, self._loop_task = self._loop_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        # drain everything still queued/running so blocks balance to zero
+        self.slots = [None] * self.config.max_batch_size
+        self.waiting.clear()
+        for req in list(self._by_id.values()):
+            self._finish(req, "engine_stopped")
+
+    def tokenize(self, prompt: Any) -> List[int]:
+        """Token ids from a prompt (shared byte-level placeholder
+        tokenizer — docs/serving.md)."""
+        from ray_tpu.serve.llm.config import tokenize_prompt
+
+        return tokenize_prompt(prompt, self.model_cfg.vocab_size)
+
+    async def add_request(
+        self,
+        prompt: Any,
+        max_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        request_id: Optional[str] = None,
+    ) -> _Request:
+        """Admit one request; its ``.out`` queue streams token events
+        ending with the FINISHED sentinel.  Sheds (typed, retryable) when
+        the waiting queue is at its bound."""
+        self.ensure_started()
+        if len(self.waiting) >= self.config.max_queue:
+            self._shed_total += 1
+            self._shed_unreported += 1
+            self._push_metrics(force=True)
+            raise RequestShedError(
+                f"engine queue full ({len(self.waiting)} waiting, "
+                f"bound {self.config.max_queue})"
+            )
+        tokens = self.tokenize(prompt)
+        if len(tokens) >= self.max_ctx:
+            tokens = tokens[: self.max_ctx - 1]
+        mt = max_tokens if max_tokens is not None else self.config.default_max_tokens
+        mt = max(1, min(int(mt), self.max_ctx - len(tokens)))
+        temp = self.config.temperature if temperature is None else float(temperature)
+        rid = request_id or uuid.uuid4().hex[:16]
+        if rid in self._by_id:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = _Request(
+            request_id=rid,
+            prompt=tokens,
+            max_tokens=mt,
+            temperature=temp,
+            out=asyncio.Queue(),
+            t_submit=time.time(),
+            trace=self._mint_trace(),
+        )
+        self._by_id[rid] = req
+        self.waiting.append(req)
+        self._wake.set()
+        return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a request (client disconnect or explicit): frees its KV
+        blocks and emits the finish sentinel.  Idempotent."""
+        req = self._by_id.get(request_id)
+        if req is None:
+            return False
+        if req.slot < 0:
+            # still queued: release immediately (no blocks held yet)
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+            self._finish(req, "cancelled")
+            return True
+        # running: mark; the next step boundary frees the lane + blocks
+        req.cancelled = True
+        req.finish_reason = "cancelled"
+        if self._wake is not None:
+            self._wake.set()
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        running = sum(1 for r in self.slots if r is not None)
+        return {
+            "waiting": len(self.waiting),
+            "running": running,
+            "max_batch_size": self.config.max_batch_size,
+            "kv_blocks_in_use": self.bm.blocks_in_use,
+            "kv_blocks_total": self.bm.num_blocks - 1,
+            "kv_leak_report": self.bm.leak_report(),
+            "tokens_per_s": round(self._tokens_per_s(), 2),
+            "total_tokens": self._total_tokens,
+            "shed_total": self._shed_total,
+            "steps": self.step_count,
+        }
+
+    def queued_depth(self) -> int:
+        """Autoscaling signal: requests in the engine (waiting + lanes)."""
+        return len(self.waiting) + sum(1 for r in self.slots if r is not None)
+
+    # -- step loop -------------------------------------------------------
+    async def _run(self):
+        loop = asyncio.get_running_loop()
+        while not self._stopped:
+            try:
+                self._reap()
+                await self._join_waiters(loop)
+                if not any(r is not None for r in self.slots):
+                    self._push_metrics()
+                    if not self.waiting:
+                        self._wake.clear()
+                        try:
+                            await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                        except asyncio.TimeoutError:
+                            pass
+                    else:
+                        # waiting but nothing admissible: KV pool full —
+                        # yield until a completion frees blocks
+                        await asyncio.sleep(0.005)
+                    continue
+                await self._decode_once(loop)
+                self._push_metrics()
+                # step boundary: let pending add_request/cancel callbacks run
+                await asyncio.sleep(0)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad step must not stop serving
+                logger.exception("llm engine step failed; continuing")
+                await asyncio.sleep(0.05)
+
+    def _reap(self):
+        """Step-boundary cleanup: cancelled lanes leave, blocks freed."""
+        for i, req in enumerate(self.slots):
+            if req is not None and req.cancelled:
+                self.slots[i] = None
+                self._finish(req, "cancelled")
+
+    async def _join_waiters(self, loop) -> int:
+        """Admit waiting requests into free lanes — the continuous-batch
+        join point: new requests enter at a step boundary instead of
+        waiting for the running batch to drain."""
+        joined = 0
+        for i in range(len(self.slots)):
+            if self.slots[i] is not None:
+                continue
+            req = self._next_admissible()
+            if req is None:
+                break
+            req.slot = i
+            req.t_join = time.time()
+            req.join_step = self.step_count
+            self.slots[i] = req
+            try:
+                await self._prefill(loop, req)
+            except Exception as e:  # noqa: BLE001 — a bad prompt must not kill the loop
+                logger.exception("prefill failed for %s", req.request_id)
+                self.slots[i] = None
+                req.finish_reason = f"error: {type(e).__name__}"
+                self._finish(req, req.finish_reason)
+                continue
+            joined += 1
+        return joined
+
+    def _next_admissible(self) -> Optional[_Request]:
+        while self.waiting:
+            req = self.waiting.popleft()
+            if req.cancelled:
+                self._finish(req, "cancelled")
+                continue
+            need = len(req.prompt) + req.max_tokens
+            if not self.bm.can_allocate(need):
+                # head-of-line blocks until capacity frees: put it back
+                # and stop (FIFO fairness — no small-request overtaking)
+                self.waiting.appendleft(req)
+                return None
+            self.bm.allocate(req.request_id, need)
+            return req
+        return None
+
+    async def _prefill(self, loop, req: _Request):
+        n = len(req.prompt)
+        bucket = self._prefill_bucket(n, self.max_ctx)
+        toks = np.zeros((1, bucket), dtype=np.int32)
+        toks[0, :n] = req.prompt
+        self.bm.advance(req.request_id, n)
+        phys = self.bm.phys_indices(req.request_id, n, bucket)
+        last_idx = np.array([n - 1], dtype=np.int32)
+        temp = np.array([req.temperature], dtype=np.float32)
+        rng = self._next_rng()
+        first_tok, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None,
+            lambda: self._prefill_jit(
+                self.params, self.k_pages, self.v_pages,
+                toks, phys, last_idx, temp, rng,
+            ),
+        )
+        tok = int(first_tok)
+        self._emit(req, tok)
+        self._tok_window.append((time.time(), 1))
+        if req.cancelled or self._is_finished(req, tok):
+            self.slots[req.slot] = None
+            self._finish(req, req.finish_reason or "length")
+
+    async def _decode_once(self, loop):
+        B = self.config.max_batch_size
+        C = self.max_ctx
+        tok = np.zeros(B, dtype=np.int32)
+        pos = np.zeros(B, dtype=np.int32)
+        idx = np.zeros((B, C), dtype=np.int32)
+        mask = np.zeros((B, C), dtype=bool)
+        write_phys = np.zeros(B, dtype=np.int32)
+        temp = np.zeros(B, dtype=np.float32)
+        active_lanes = []
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            rid = req.request_id
+            cur_len = self.bm.seq_len(rid)  # positions already in cache
+            tok[i] = req.tokens[-1]
+            pos[i] = cur_len  # the fed token's position
+            idx[i] = self.bm.phys_indices(rid, cur_len, C)
+            mask[i, :cur_len] = True
+            self.bm.advance(rid, 1)
+            write_phys[i] = self.bm.phys_index(rid, cur_len)
+            temp[i] = req.temperature
+            active_lanes.append(i)
+        rng = self._next_rng()
+        nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None,
+            lambda: self._decode_jit(
+                self.params, self.k_pages, self.v_pages,
+                tok, pos, idx, mask, write_phys, temp, rng,
+            ),
+        )
+        nxt = np.asarray(nxt)
+        self.step_count += 1
+        now = time.time()
+        emitted = 0
+        for i in active_lanes:
+            req = self.slots[i]
+            if req is None:
+                continue
+            t = int(nxt[i])
+            self._emit(req, t, now=now)
+            emitted += 1
+            if req.cancelled or self._is_finished(req, t):
+                self.slots[i] = None
+                self._finish(req, req.finish_reason or "length")
+        if emitted:
+            self._tok_window.append((now, emitted))
+
+    # -- bookkeeping -----------------------------------------------------
+    def _emit(self, req: _Request, token: int, now: Optional[float] = None):
+        req.tokens.append(token)
+        req.generated += 1
+        self._total_tokens += 1
+        if req.t_first_token == 0.0:
+            req.t_first_token = now or time.time()
+        req.out.put_nowait(
+            {
+                "request_id": req.request_id,
+                "token": token,
+                "index": req.generated - 1,
+            }
+        )
+
+    def _is_finished(self, req: _Request, token: int) -> bool:
+        eos = self.config.eos_token
+        if eos >= 0 and token == eos:
+            req.finish_reason = "eos"
+            return True
+        if req.generated >= req.max_tokens:
+            req.finish_reason = "length"
+            return True
+        return False
+
+    def _finish(self, req: _Request, reason: str):
+        """Terminal bookkeeping — the ONLY place a request leaves the
+        engine: frees blocks, emits the sentinel, records spans/TTFT."""
+        if self._by_id.pop(req.request_id, None) is None:
+            return
+        self.bm.free(req.request_id)
+        req.finish_reason = req.finish_reason or reason
+        req.t_done = time.time()
+        req.finish_step = self.step_count
+        req.out.put_nowait(FINISHED)
+        self._record_spans(req)
+        self._observe_ttft(req)
+
+    # -- observability ---------------------------------------------------
+    def _mint_trace(self) -> tuple:
+        from ray_tpu.util import tracing
+
+        ctx = tracing.current_context()
+        trace_id = ctx[0] if ctx else uuid.uuid4().hex
+        parent = ctx[1] if ctx else None
+        return (trace_id, uuid.uuid4().hex[:16], parent)
+
+    def _record_spans(self, req: _Request):
+        """serve.request -> {serve.queue, serve.prefill, serve.decode}:
+        the per-request latency decomposition that critical-path analysis
+        surfaces (docs/serving.md)."""
+        try:
+            from ray_tpu.util import tracing
+
+            trace_id, root_id, parent = req.trace
+            end = req.t_done or time.time()
+            tracing.record_span(
+                "serve.request", req.t_submit, end,
+                {
+                    "request_id": req.request_id,
+                    "deployment": self.config.name,
+                    "tokens": req.generated,
+                    "finish_reason": req.finish_reason,
+                },
+                context=(trace_id, root_id, parent),
+            )
+            t_join = req.t_join or end
+            tracing.record_span(
+                "serve.queue", req.t_submit, t_join, None,
+                context=(trace_id, uuid.uuid4().hex[:16], root_id),
+            )
+            if req.t_join:
+                t_first = req.t_first_token or end
+                tracing.record_span(
+                    "serve.prefill", req.t_join, t_first, None,
+                    context=(trace_id, uuid.uuid4().hex[:16], root_id),
+                )
+                tracing.record_span(
+                    "serve.decode", t_first, end, {"tokens": req.generated},
+                    context=(trace_id, uuid.uuid4().hex[:16], root_id),
+                )
+        except Exception:  # noqa: BLE001 — observability must not fail serving
+            pass
+
+    def _observe_ttft(self, req: _Request):
+        if not req.t_first_token:
+            return
+        try:
+            from ray_tpu._private import telemetry
+
+            telemetry.observe_serve_ttft(
+                self.config.name, req.t_first_token - req.t_submit
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _tokens_per_s(self) -> float:
+        now = time.time()
+        window = [(t, n) for t, n in self._tok_window if now - t <= 5.0]
+        if not window:
+            return 0.0
+        span = max(now - window[0][0], 1e-3)
+        return sum(n for _, n in window) / span
+
+    def _push_metrics(self, force: bool = False):
+        now = time.time()
+        if not force and now - self._last_metrics_push < 1.0:
+            return
+        self._last_metrics_push = now
+        try:
+            from ray_tpu._private import telemetry
+
+            name = self.config.name
+            telemetry.set_serve_queue_depth(name, len(self.waiting))
+            telemetry.set_serve_kv_blocks(name, self.bm.blocks_in_use)
+            telemetry.set_serve_tokens_per_s(name, self._tokens_per_s())
+            if self._shed_unreported:
+                telemetry.count_serve_shed(name, "engine", self._shed_unreported)
+                self._shed_unreported = 0
+        except Exception:  # noqa: BLE001
+            pass

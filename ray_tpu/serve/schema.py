@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 _OVERRIDABLE = (
     "num_replicas",
     "max_ongoing_requests",
+    "max_queued_requests",
     "route_prefix",
     "autoscaling_config",
     "user_config",
@@ -45,6 +46,7 @@ class DeploymentSchema:
     name: str
     num_replicas: Optional[int] = None
     max_ongoing_requests: Optional[int] = None
+    max_queued_requests: Optional[int] = None
     route_prefix: Optional[str] = None
     autoscaling_config: Optional[dict] = None
     user_config: Any = None
@@ -166,6 +168,7 @@ def build_app_schema(import_path: str, *, name: str = "default",
                 name=cfg.name,
                 num_replicas=cfg.num_replicas,
                 max_ongoing_requests=cfg.max_ongoing_requests,
+                max_queued_requests=cfg.max_queued_requests,
                 route_prefix=cfg.route_prefix,
                 autoscaling_config=dataclasses.asdict(cfg.autoscaling_config)
                 if cfg.autoscaling_config

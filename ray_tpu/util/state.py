@@ -169,6 +169,91 @@ def traces(limit: int = 100_000) -> List[Dict[str, Any]]:
     return group_traces(spans(limit))
 
 
+_CP_OVERLAP_SLACK_S = 1e-6  # clock-jitter tolerance between siblings
+
+
+def critical_path(group: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The longest dependency chain through one trace's span tree.
+
+    Within each span, sequential (non-overlapping) children form a
+    dependency chain — the chain is walked backwards from the
+    last-finishing child, each link the latest-ending child that ends
+    before the next link starts.  Each link expands recursively, so the
+    result is the root-first flattening of the chain that bounds the
+    trace's end-to-end latency.  For a serve request that reads
+    ``serve.request -> serve.queue -> serve.prefill -> serve.decode``
+    and attributes wall time across the three phases; for a task tree it
+    names the slowest submit chain.
+
+    Entries: ``{name, span_id, duration_s, depth, segment}`` —
+    ``segment=True`` marks links whose time actually accrues to the path
+    (links further expanded by their own children contribute through
+    those children instead), so ``sum(duration_s where segment)`` is the
+    path's latency decomposition without double counting.  Pure: shared
+    by the state API and the dashboard.
+    """
+    by_id = {s["span_id"]: s for s in group if s.get("span_id")}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in group:
+        parent = s.get("parent_span_id")
+        if parent and parent in by_id and parent != s.get("span_id"):
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    if not roots:
+        return []
+
+    def dur(s) -> float:
+        return max(0.0, (s.get("end_time") or 0.0) - (s.get("start_time") or 0.0))
+
+    def entry(s, depth, segment) -> Dict[str, Any]:
+        return {
+            "name": s.get("name"),
+            "span_id": s.get("span_id"),
+            "duration_s": dur(s),
+            "depth": depth,
+            "segment": segment,
+        }
+
+    def sequential_chain(kids) -> List[Dict[str, Any]]:
+        """Backwards greedy: last-finishing child, then the latest-ending
+        child that ends before it starts, ... — returned in start order."""
+        chain: List[Dict[str, Any]] = []
+        remaining = sorted(kids, key=lambda s: s.get("end_time") or 0.0)
+        cursor = None
+        while remaining:
+            nxt = None
+            for k in reversed(remaining):
+                if cursor is None or (k.get("end_time") or 0.0) <= cursor + _CP_OVERLAP_SLACK_S:
+                    nxt = k
+                    break
+            if nxt is None:
+                break
+            chain.append(nxt)
+            remaining.remove(nxt)
+            cursor = nxt.get("start_time") or 0.0
+        chain.reverse()
+        return chain
+
+    def expand(s, depth, seen) -> List[Dict[str, Any]]:
+        sid = s.get("span_id")
+        kids = [k for k in children.get(sid, []) if k.get("span_id") not in seen]
+        if not kids:
+            return [entry(s, depth, True)]
+        seen = seen | {sid}
+        out = [entry(s, depth, False)]
+        for k in sequential_chain(kids):
+            out.extend(expand(k, depth + 1, seen))
+        return out
+
+    def total(path) -> float:
+        return sum(e["duration_s"] for e in path if e["segment"])
+
+    best = max((expand(r, 0, frozenset()) for r in roots), key=total)
+    return best
+
+
 def group_traces(span_records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Pure grouping of span records into per-trace summaries (shared by
     the state API and the dashboard, which has no connected worker)."""
@@ -182,6 +267,7 @@ def group_traces(span_records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         group.sort(key=lambda s: s.get("start_time", 0.0))
         start = min(s.get("start_time", 0.0) for s in group)
         end = max(s.get("end_time", 0.0) for s in group)
+        cpath = critical_path(group)
         out.append(
             {
                 "trace_id": tid,
@@ -190,6 +276,10 @@ def group_traces(span_records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                 "start_time": start,
                 "duration_s": max(0.0, end - start),
                 "root_names": [s.get("name") for s in group if not s.get("parent_span_id")],
+                "critical_path": cpath,
+                "critical_path_s": sum(
+                    e["duration_s"] for e in cpath if e["segment"]
+                ),
                 "spans": group,
             }
         )

@@ -57,6 +57,19 @@ if [ "${RAY_TPU_SKIP_TENANT_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Serve LLM smoke (inference serving plane end-to-end): tiny GPT-2
+# behind serve.run, 24 concurrent token streams + one mid-stream cancel,
+# assert all completions exact, KV block pool balanced to zero, and the
+# continuous batcher actually batched.  Skippable via
+# RAY_TPU_SKIP_SERVE_LLM_SMOKE=1.
+if [ "${RAY_TPU_SKIP_SERVE_LLM_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/serve_llm_smoke.py; then
+    echo "serve llm smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Elastic smoke (resize-on-preemption end-to-end): 2-node local cluster,
 # elastic JaxTrainer (min_workers=1), preempt one rank's node mid-run,
 # assert shrink -> resume -> completion with zero failure charges and
