@@ -98,6 +98,7 @@ from ray_tpu.models import gpt2
 from ray_tpu.parallel import create_mesh
 
 on_tpu = jax.default_backend() == "tpu"
+platform = jax.default_backend()
 n_dev = len(jax.devices())
 if on_tpu:
     cfg = gpt2.GPT2Config(max_seq_len=1024, remat=False)  # fits HBM at 124M/B16/T1024
@@ -127,7 +128,7 @@ tok_s_chip = B * T * steps / dt / n_dev
 _RAW_SNIPPET = f"""
 import json
 {_MEASURE_BODY}
-print("BENCH_RESULT " + json.dumps({{"tok_s_chip": tok_s_chip, "on_tpu": on_tpu}}))
+print("BENCH_RESULT " + json.dumps({{"tok_s_chip": tok_s_chip, "on_tpu": on_tpu, "platform": platform}}))
 """
 
 _FRAMEWORK_SNIPPET = f"""
@@ -141,7 +142,7 @@ _BODY = {_MEASURE_BODY!r}
 def train_loop(config):
     ns = {{}}
     exec(_BODY, ns)
-    train.report({{"tok_s_chip": ns["tok_s_chip"], "on_tpu": ns["on_tpu"]}})
+    train.report({{"tok_s_chip": ns["tok_s_chip"], "on_tpu": ns["on_tpu"], "platform": ns["platform"]}})
 
 ray_tpu.init(num_cpus=4)
 result = JaxTrainer(
@@ -149,6 +150,7 @@ result = JaxTrainer(
 ).fit()
 print("BENCH_RESULT " + json.dumps({{
     "tok_s_chip": result.metrics["tok_s_chip"], "on_tpu": result.metrics["on_tpu"],
+    "platform": result.metrics.get("platform", "unknown"),
 }}))
 ray_tpu.shutdown()
 """
@@ -336,7 +338,11 @@ def _record(fw: dict, raw: dict | None, extra: dict) -> dict:
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(per_chip / GPU_BASELINE_TOKENS_PER_SEC, 4),
+        # platform provenance first-class in the record header:
+        # bench_gate refuses cross-platform comparisons keyed on on_tpu
+        # (the r04/r05 "CPU number read as TPU regression" class)
         "on_tpu": fw["on_tpu"],
+        "platform": fw.get("platform", "unknown"),
     }
     if raw is not None and raw.get("tok_s_chip"):
         rec["raw_tokens_per_sec_per_chip"] = round(raw["tok_s_chip"], 1)
@@ -369,6 +375,7 @@ def main():
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
             "on_tpu": False,
+            "platform": "unknown",
             "error": str(exc),
             **prov,
         })

@@ -1,0 +1,35 @@
+"""Shared bench provenance: every bench record header must say what
+platform it was captured on, prominently, so bench_gate.py and human
+readers can never mistake a CPU capture for a TPU regression (the
+BENCH_r04/r05 confusion class — ROADMAP environment note).
+
+Usage in every bench*.py:
+
+    from bench_common import provenance
+    rec = {"metric": ..., "value": ..., **provenance()}
+
+``provenance()`` probes the live jax backend once (cached) and returns
+``{"on_tpu": bool, "platform": str}``; processes without jax report
+``platform="none"``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def provenance() -> dict:
+    try:
+        import jax
+
+        try:
+            jax.devices()
+        except RuntimeError:
+            # A pinned-but-dead accelerator plugin: fall back to whatever
+            # backend initializes (mirrors bench.py's probe fallback).
+            jax.config.update("jax_platforms", "")
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — bench boxes without jax still stamp
+        backend = "none"
+    return {"on_tpu": backend == "tpu", "platform": backend}

@@ -247,10 +247,15 @@ def main():
         with open("/proc/loadavg") as f:
             load1m = float(f.read().split()[0])
         value = fn()
+        from bench_common import provenance
+
         rec = {
             "metric": name,
             "value": round(value, 2),
             "unit": unit,
+            # platform provenance FIRST-CLASS in every record: bench_gate
+            # refuses cross-platform comparisons keyed on this
+            **provenance(),
             "loadavg_1m_at_capture": load1m,
         }
         if baseline:

@@ -81,11 +81,15 @@ def main():
     dt = time.perf_counter() - t0
 
     images_s_chip = B * steps / dt / n_dev
+    from bench_common import provenance
+
     rec = {
         "metric": "resnet50_cifar10_train_images_per_sec_per_chip",
         "value": round(images_s_chip, 1),
         "unit": "images/s/chip",
-        "on_tpu": on_tpu,
+        # platform provenance first-class: bench_gate refuses
+        # cross-platform comparisons keyed on on_tpu
+        **provenance(),
         "batch_size": B,
         "flops_per_step": flops_per_step,
     }

@@ -192,11 +192,14 @@ def main() -> dict:
         jax.devices()
     except RuntimeError:
         jax.config.update("jax_platforms", "")
-    on_tpu = jax.default_backend() == "tpu"
+    from bench_common import provenance
+
     out = {
         "metric": "ppo_env_steps_per_sec",
         "unit": "env_steps/s",
-        "on_tpu": on_tpu,
+        # platform provenance first-class (on_tpu + platform): bench_gate
+        # refuses cross-platform comparisons keyed on it
+        **provenance(),
         "cartpole": bench_config("cartpole", _make_cartpole_cfg()),
         "pong_scale": bench_config("pong_scale", _make_pong_cfg()),
     }

@@ -56,10 +56,15 @@ def _pct(sorted_vals, p):
 
 
 def record(out, metric, value, unit, **extra):
+    from bench_common import provenance
+
     rec = {
         "metric": metric,
         "value": round(value, 2) if isinstance(value, float) else value,
         "unit": unit,
+        # platform provenance first-class: bench_gate refuses
+        # cross-platform comparisons keyed on on_tpu
+        **provenance(),
         "loadavg_1m_at_capture": round(os.getloadavg()[0], 2),
         "note": NOTE,
     }

@@ -146,6 +146,28 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # (1.0 = keep everything).  Deterministic in the trace id, so every
     # process keeps or drops the SAME traces and trees stay whole.
     "span_sample_rate": 1.0,
+    # --- sampling profiler (profiling.py) ---
+    # Default sampling rate for on-demand profile sessions.  67 Hz keeps
+    # the attached overhead well inside the <5% telemetry budget while
+    # still resolving ~15 ms of exclusive time per second of capture.
+    "profile_default_hz": 67,
+    # Hard cap on one session's duration: a driver that dies after
+    # profile_start cannot leave a sampler running forever.
+    "profile_max_duration_s": 600.0,
+    # Frames kept per sampled stack (deepest are dropped).
+    "profile_max_stack_depth": 64,
+    # GCS profile-table depth (capture records shipped at end of
+    # capture).  Must comfortably exceed the process count of one
+    # cluster-wide capture or late arrivals evict earlier records and
+    # break died-mid-capture recovery.
+    "profile_table_size": 512,
+    # JAX/XLA introspection on instrumented jitted functions: compile
+    # timing, retrace counting, first-trace cost_analysis.  Off = the
+    # wrapper is a cache-size check per call.
+    "jax_introspection": True,
+    # Run lowered.cost_analysis() at a function's FIRST trace (one extra
+    # trace per instrumented function, never on the steady-state path).
+    "jax_cost_analysis": True,
     # --- drain / preemption (reference: gcs DrainNode + autoscaler drain
     # API; RLAX-style planned-interruption handling) ---
     # Fallback drain notice window when a drain_node call carries none.

@@ -117,6 +117,13 @@ class GcsServer:
         # flusher (util/tracing.flush); merged cluster-wide by
         # util.state.timeline() and the dashboard /api/timeline.
         self.spans: "deque" = deque(maxlen=int(CONFIG.span_buffer_size))
+        # Profile captures shipped by profiled processes at end of
+        # capture (profiling.py _ship_finished) — rides the same report
+        # path as spans, so a capture survives its driver AND its
+        # target process.  Depth must exceed one cluster-wide capture's
+        # process count (profile_table_size) or eviction breaks the
+        # died-mid-capture recovery path.
+        self.profiles: "deque" = deque(maxlen=int(CONFIG.profile_table_size))
         self.pending_shapes: Dict[NodeID, list] = {}  # autoscaler demand
         # Capacity-return signal: preempted nodes whose resources the
         # autoscaler should replace even when no task demand is pending
@@ -1539,6 +1546,9 @@ class GcsServer:
             "death_cause": info.death_cause,
             "pid": info.pid,
             "worker_address": info.worker_address,
+            # Tenant attribution for per-actor profiling/metrics views
+            # (merged cluster flamegraphs key on actor:<tenant>/<name>).
+            "tenant": self._job_tenant_priority(info.actor_id.job_id())[0],
             "max_task_retries": (
                 info.creation_spec.max_task_retries if info.creation_spec else 0
             ),
@@ -2133,6 +2143,10 @@ class GcsServer:
                 self.metrics[payload.get("worker_id", b"")] = payload.get("metrics", [])
             elif method == "span_report":
                 self.spans.extend(payload.get("spans", ()))
+            elif method == "profile_report":
+                rec = payload.get("profile")
+                if rec:
+                    self.profiles.append(rec)
 
         self.loop.call_soon_threadsafe(apply)
 
@@ -2141,6 +2155,39 @@ class GcsServer:
         (util/tracing.flush — the off-box half of the flight recorder)."""
         self.spans.extend(payload.get("spans", ()))
         return True
+
+    async def rpc_profile_report(self, payload, conn):
+        """A finished sampling-profiler capture shipped by the profiled
+        process (profiling.py) — recoverable by session_id even after
+        the process dies."""
+        rec = payload.get("profile")
+        if rec:
+            self.profiles.append(rec)
+        return True
+
+    async def rpc_list_profiles(self, payload, conn):
+        sid = (payload or {}).get("session_id")
+        out = [p for p in self.profiles if not sid or p.get("session_id") == sid]
+        return out
+
+    # Sampling-profiler surface for the GCS process itself (workers and
+    # raylets expose the same three methods — util.profiling attaches to
+    # any of them).  handle_* never block: start spawns a daemon sampler
+    # thread, stop/dump snapshot under a short lock.
+    async def rpc_profile_start(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_start(payload)
+
+    async def rpc_profile_stop(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_stop(payload)
+
+    async def rpc_profile_dump(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_dump(payload)
 
     async def rpc_list_spans(self, payload, conn):
         limit = (payload or {}).get("limit", 100_000)

@@ -1,0 +1,719 @@
+"""On-demand sampling profiler + JAX/XLA introspection: the cluster's
+bottleneck-attribution plane.
+
+The PR 2 flight recorder answers *where time went between processes*
+(spans, RPC/task-phase metrics).  This module answers the next two
+questions the perf arc needs (reference: `ray timeline` + per-worker
+py-spy/memray hooks; Podracer-style work diagnoses via per-step device
+and compile profiles, not RPC spans):
+
+- **What is a hot process doing?**  A stdlib-only wall/CPU sampling
+  profiler: a daemon thread walks ``sys._current_frames()`` at a
+  configurable Hz and folds stacks into counts.  Any live worker /
+  actor host / raylet / the GCS can be attached via the
+  ``profile_start`` / ``profile_stop`` / ``profile_dump`` RPC surface
+  (handlers delegate to ``handle_profile_*`` here — they never block
+  the dispatch loop).  Finished captures also ship to the GCS profile
+  table through the existing metrics/span report channel, so a capture
+  survives its driver.
+- **What is the device doing?**  ``instrument_jit`` wraps a jitted
+  callable with compile-time/retrace counters and first-trace
+  ``cost_analysis()`` FLOPs/bytes; ``report_device_memory`` publishes
+  ``live_buffers``/``memory_stats`` gauges where the backend supports
+  them (CPU-safe no-op otherwise).
+
+Exports: ``collapse`` (collapsed-stack / flamegraph lines),
+``speedscope`` (speedscope JSON), ``merge_records`` (fold per-process
+captures into one cluster profile keyed by actor/tenant label).
+``ray_tpu.util.profiling`` is the driver-side orchestration on top.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+
+
+class ProfilerError(Exception):
+    """Base error of the profiling plane."""
+
+
+class ProfilerConflictError(ProfilerError):
+    """A session is already running in this process.  One sampler per
+    process: two concurrent captures would double the overhead and
+    interleave their sample sets; the second attach gets this typed
+    error (carrying the live session id) instead of silently sharing."""
+
+    def __init__(self, message: str, session_id: str = ""):
+        super().__init__(message)
+        self.session_id = session_id
+
+    def __reduce__(self):
+        # Keep session_id across the RPC pickle boundary (default
+        # Exception reduction only replays args[0]).
+        return (type(self), (self.args[0], self.session_id))
+
+
+class ProfilerSessionNotFound(ProfilerError):
+    """stop/dump named a session this process doesn't have (already
+    reaped, or the caller's target restarted in between)."""
+
+
+# Fallback idle heuristic for CPU mode on platforms without per-thread
+# CPU accounting (/proc): leaf functions that mean "this thread is
+# parked, not computing".  The blocking call itself is C code (no
+# Python frame), so the heuristic keys on the Python caller
+# conventionally wrapping it.
+_IDLE_LEAF_NAMES = frozenset(
+    {
+        "wait",
+        "_wait_for_tstate_lock",
+        "select",
+        "poll",
+        "epoll",
+        "accept",
+        "recv",
+        "recv_into",
+        "readexactly",
+        "_recv_exact",
+        "read",
+        "readline",
+        "get",  # queue.Queue.get parks on a condition
+        "join",
+        "flush_loop",
+        "run_forever",
+        "sleep",
+    }
+)
+
+
+class _ThreadCpuClock:
+    """Per-thread CPU-time deltas from /proc/self/task/<tid>/stat
+    (Linux).  A thread whose utime+stime did not advance since the last
+    sample was parked (C-level sleep/select/recv included — which the
+    Python-frame leaf heuristic cannot see).  ``delta(py_tid)`` is
+    None when accounting is unavailable → caller falls back to the
+    leaf-name heuristic."""
+
+    def __init__(self):
+        self._available = os.path.isdir("/proc/self/task")
+        self._native: Dict[int, int] = {}  # python tid -> native tid
+        self._last: Dict[int, int] = {}  # native tid -> cpu jiffies
+
+    def _refresh_native_map(self) -> None:
+        for t in threading.enumerate():
+            nid = getattr(t, "native_id", None)
+            if nid is not None:
+                self._native[t.ident] = nid
+
+    def _cpu_jiffies(self, native_tid: int) -> Optional[int]:
+        try:
+            with open(f"/proc/self/task/{native_tid}/stat", "rb") as f:
+                data = f.read()
+            # utime, stime are fields 14, 15 (1-based), after the
+            # parenthesized comm which may itself contain spaces.
+            rest = data.rsplit(b")", 1)[1].split()
+            return int(rest[11]) + int(rest[12])
+        except (OSError, IndexError, ValueError):
+            return None
+
+    def delta(self, py_tid: int) -> Optional[int]:
+        """CPU jiffies this thread burned since its previous probe;
+        None = unknown (no accounting for this thread/platform).  Used
+        as the sample WEIGHT: when GIL contention stretches the tick
+        interval, a continuously-computing thread still accrues its
+        full CPU time while a housekeeping loop's 1-jiffy blip stays a
+        blip."""
+        if not self._available:
+            return None
+        nid = self._native.get(py_tid)
+        if nid is None:
+            self._refresh_native_map()
+            nid = self._native.get(py_tid)
+            if nid is None:
+                return None
+        cur = self._cpu_jiffies(nid)
+        if cur is None:
+            # Stale mapping: the thread behind this Python ident exited
+            # and a new thread reused the ident — re-resolve once so
+            # churned threads don't permanently fall back to the leaf
+            # heuristic (or read a recycled tid's clock).
+            self._native.pop(py_tid, None)
+            self._refresh_native_map()
+            nid = self._native.get(py_tid)
+            cur = self._cpu_jiffies(nid) if nid is not None else None
+            if cur is None:
+                return None
+        prev = self._last.get(nid)
+        self._last[nid] = cur
+        if prev is None:
+            return 0  # no baseline yet: treat the first probe as idle
+        return max(0, cur - prev)
+
+
+def _frame_label(code) -> str:
+    return f"{os.path.basename(code.co_filename)}:{code.co_name}"
+
+
+class SamplingProfiler:
+    """One capture: a daemon thread sampling every live thread's stack.
+
+    ``mode="wall"`` keeps every sample; ``mode="cpu"`` drops samples
+    whose leaf frame is a known parked-thread idiom (see
+    ``_IDLE_LEAF_NAMES``) — an approximation, but a useful one without
+    OS-level thread state (stdlib-only by design).
+    """
+
+    def __init__(
+        self,
+        session_id: str,
+        hz: float,
+        duration_s: float,
+        mode: str = "wall",
+        label: str = "",
+        on_finish=None,
+    ):
+        self.session_id = session_id
+        self.hz = max(1.0, min(float(hz), 1000.0))
+        self.duration_s = float(duration_s)
+        self.mode = mode if mode in ("wall", "cpu") else "wall"
+        self.label = label
+        self.started_at = time.time()
+        self.ended_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._samples: Dict[Tuple[str, ...], int] = {}
+        self._ticks = 0
+        self._sample_count = 0
+        self._idle_dropped = 0
+        self._threads_seen: set = set()
+        self._errors: List[str] = []
+        self._max_depth = int(CONFIG.profile_max_stack_depth)
+        self._on_finish = on_finish
+        self._cpu_clock = _ThreadCpuClock() if self.mode == "cpu" else None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"profile-sampler-{session_id[:8]}"
+        )
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        deadline = time.monotonic() + self.duration_s
+        own_tid = threading.get_ident()
+        try:
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                self._sample_once(own_tid)
+                # Absorb the sampling cost into the interval so the
+                # effective rate stays ~hz instead of hz + walk time.
+                self._stop.wait(max(0.0005, interval - (time.perf_counter() - t0)))
+        except Exception as e:  # noqa: BLE001 — a broken sampler must end cleanly
+            with self._lock:
+                self._errors.append(f"sampler died: {type(e).__name__}: {e}")
+        finally:
+            self.ended_at = time.time()
+            if self._on_finish is not None:
+                try:
+                    self._on_finish(self)
+                except Exception:  # noqa: BLE001 — best-effort ship
+                    pass
+
+    def _sample_once(self, own_tid: int) -> None:
+        # Phase 1 — walk every stack WITHOUT any GIL-releasing call in
+        # between: the frame objects in the snapshot stay live only
+        # while the sampled threads cannot run.  (The CPU-clock probes
+        # below do file I/O, which releases the GIL; probing first once
+        # produced truncated single-frame stacks of frames the thread
+        # had already popped.)
+        frames = sys._current_frames()
+        walked: List[Tuple[int, str, Tuple[str, ...]]] = []
+        for tid, top in frames.items():
+            if tid == own_tid:
+                continue
+            stack: List[str] = []
+            f = top
+            depth = 0
+            while f is not None and depth < self._max_depth:
+                stack.append(_frame_label(f.f_code))
+                f = f.f_back
+                depth += 1
+            stack.reverse()
+            walked.append((tid, top.f_code.co_name, tuple(stack)))
+        # Phase 2 — filter + fold (CPU-clock probes may release the GIL
+        # freely now; the stacks are already copied out as strings).
+        with self._lock:
+            self._ticks += 1
+            for tid, leaf_name, key in walked:
+                self._threads_seen.add(tid)
+                weight = 1
+                if self.mode == "cpu":
+                    # Real per-thread CPU accounting where the OS
+                    # provides it (samples weighted by jiffies burned);
+                    # leaf-name heuristic otherwise.
+                    delta = self._cpu_clock.delta(tid)
+                    if delta == 0 or (
+                        delta is None and leaf_name in _IDLE_LEAF_NAMES
+                    ):
+                        self._idle_dropped += 1
+                        continue
+                    if delta is not None:
+                        weight = delta
+                self._samples[key] = self._samples.get(key, 0) + weight
+                self._sample_count += weight
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self, partial: Optional[bool] = None) -> Dict[str, Any]:
+        """The session's record — safe to call mid-capture (a dump of a
+        dying worker returns whatever was sampled so far)."""
+        with self._lock:
+            samples = {";".join(k): v for k, v in self._samples.items()}
+            errors = list(self._errors)
+            ticks, count = self._ticks, self._sample_count
+            idle, nthreads = self._idle_dropped, len(self._threads_seen)
+        return {
+            "session_id": self.session_id,
+            "label": self.label,
+            "pid": os.getpid(),
+            "hz": self.hz,
+            "mode": self.mode,
+            "duration_s": self.duration_s,
+            "started_at": self.started_at,
+            "ended_at": self.ended_at,
+            "running": self.running if partial is None else partial,
+            "ticks": ticks,
+            "sample_count": count,
+            "idle_dropped": idle,
+            "threads_seen": nthreads,
+            "errors": errors,
+            "samples": samples,
+        }
+
+
+# ----------------------------------------------------------------------
+# per-process session registry (one active capture per process)
+# ----------------------------------------------------------------------
+_registry_lock = threading.Lock()
+_active: Optional[SamplingProfiler] = None
+_last_record: Optional[Dict[str, Any]] = None
+
+
+def _ship_finished(profiler: SamplingProfiler) -> None:
+    """Natural end of a capture: cache the record locally (a late dump
+    RPC still gets it) and ship it to the GCS profile table through the
+    existing report channel (worker GCS client, or the raylet/GCS
+    report channel — same path spans ride)."""
+    global _last_record
+    record = profiler.snapshot(partial=False)
+    with _registry_lock:
+        _last_record = record
+    from ray_tpu._private import telemetry
+
+    telemetry.count_profile_session("completed")
+    try:
+        from ray_tpu.util import metrics as metrics_mod
+        from ray_tpu.util import tracing
+
+        tracing.record_event_span(
+            "profile.capture",
+            record["started_at"],
+            record["ended_at"] or time.time(),
+            attributes={
+                "label": record["label"],
+                "hz": record["hz"],
+                "mode": record["mode"],
+                "sample_count": record["sample_count"],
+            },
+        )
+        metrics_mod.report("profile_report", {"profile": record})
+    except Exception:  # noqa: BLE001 — shipping is best-effort
+        pass
+
+
+def handle_profile_start(payload: Optional[dict]) -> Dict[str, Any]:
+    """RPC surface: attach a sampler to THIS process.  Non-blocking —
+    spawns the daemon sampler thread and returns immediately."""
+    global _active
+    payload = payload or {}
+    duration = min(
+        max(0.05, float(payload.get("duration_s") or 10.0)),
+        float(CONFIG.profile_max_duration_s),
+    )
+    hz = float(payload.get("hz") or CONFIG.profile_default_hz)
+    mode = payload.get("mode") or "wall"
+    label = str(payload.get("label") or f"pid:{os.getpid()}")
+    session_id = payload.get("session_id") or _new_session_id()
+    with _registry_lock:
+        # Conflict gate keys on ended_at, not thread liveness: a just-
+        # registered session whose thread hasn't started yet (start()
+        # below, still under this lock) and a running one both have
+        # ended_at None — checking Thread.is_alive() here left a window
+        # where a concurrent attach could silently overwrite the
+        # registry and double the sampling overhead.
+        if _active is not None and _active.ended_at is None:
+            from ray_tpu._private import telemetry
+
+            telemetry.count_profile_session("conflict")
+            raise ProfilerConflictError(
+                f"a profile session ({_active.session_id}) is already running "
+                f"in pid {os.getpid()}; stop it or wait for its deadline",
+                session_id=_active.session_id,
+            )
+        prof = SamplingProfiler(
+            session_id, hz, duration, mode=mode, label=label, on_finish=_ship_finished
+        )
+        _active = prof
+        try:
+            prof.start()
+        except Exception:
+            # Thread spawn failed (e.g. at the process thread limit): a
+            # registered-but-never-started session would hold the
+            # conflict gate (ended_at stays None with no thread to set
+            # it) and brick profiling for the process — release the
+            # slot and surface the error instead.
+            _active = None
+            raise
+    return {
+        "session_id": session_id,
+        "pid": os.getpid(),
+        "hz": prof.hz,
+        "mode": prof.mode,
+        "duration_s": duration,
+        "started_at": prof.started_at,
+        "label": label,
+    }
+
+
+def _find(session_id: Optional[str]) -> SamplingProfiler:
+    if _active is None or (session_id and _active.session_id != session_id):
+        raise ProfilerSessionNotFound(
+            f"no profile session {session_id or '<any>'} in pid {os.getpid()}"
+        )
+    return _active
+
+
+def handle_profile_stop(payload: Optional[dict]) -> Dict[str, Any]:
+    """Stop the capture early; returns the final record."""
+    payload = payload or {}
+    with _registry_lock:
+        prof = _find(payload.get("session_id"))
+    prof.stop()
+    # The sampler thread exits within one interval; don't join on the
+    # dispatch loop — snapshot now (records through the last tick).
+    return prof.snapshot(partial=False)
+
+
+def handle_profile_dump(payload: Optional[dict]) -> Dict[str, Any]:
+    """Dump the capture (partial if still running).  ``stop=True``
+    (default) also ends it — the one-call dump-and-detach the driver
+    orchestration uses."""
+    global _last_record
+    payload = payload or {}
+    sid = payload.get("session_id")
+    with _registry_lock:
+        if _active is None or (sid and _active.session_id != sid):
+            if _last_record is not None and (
+                not sid or _last_record["session_id"] == sid
+            ):
+                return _last_record
+            raise ProfilerSessionNotFound(
+                f"no profile session {sid or '<any>'} in pid {os.getpid()}"
+            )
+        prof = _active
+    if payload.get("stop", True):
+        prof.stop()
+    return prof.snapshot()
+
+
+def active_session_id() -> Optional[str]:
+    with _registry_lock:
+        if _active is not None and _active.running:
+            return _active.session_id
+    return None
+
+
+def _new_session_id() -> str:
+    import secrets
+
+    return secrets.token_hex(8)
+
+
+# ----------------------------------------------------------------------
+# export formats (pure functions; shared by util.profiling + dashboard)
+# ----------------------------------------------------------------------
+def collapse(record: Dict[str, Any], root: Optional[str] = None) -> str:
+    """Brendan-Gregg collapsed-stack lines ("f1;f2;f3 count"), the
+    input format of flamegraph.pl / speedscope / inferno.  ``root``
+    (default: the record's label) prefixes every stack so merged
+    cluster profiles stay attributable per process."""
+    prefix = record.get("label", "") if root is None else root
+    lines = []
+    for stack, count in sorted(record.get("samples", {}).items()):
+        line = f"{prefix};{stack}" if prefix else stack
+        lines.append(f"{line} {count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merge_records(records: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Fold per-process capture records into one cluster-wide stack
+    map, each stack rooted at its process label (actor/tenant/raylet),
+    so one flamegraph shows the whole cluster with per-target subtrees."""
+    merged: Dict[str, int] = {}
+    for rec in records:
+        prefix = rec.get("label", "")
+        for stack, count in rec.get("samples", {}).items():
+            key = f"{prefix};{stack}" if prefix else stack
+            merged[key] = merged.get(key, 0) + count
+    return merged
+
+
+def speedscope(records: List[Dict[str, Any]], name: str = "ray_tpu profile") -> Dict[str, Any]:
+    """Speedscope JSON (sampled profiles, one per capture record) —
+    https://www.speedscope.app file-format-schema.  Aggregated stacks
+    become one weighted sample each; weights are sample counts."""
+    frames: List[Dict[str, str]] = []
+    frame_idx: Dict[str, int] = {}
+
+    def fidx(label: str) -> int:
+        i = frame_idx.get(label)
+        if i is None:
+            i = frame_idx[label] = len(frames)
+            frames.append({"name": label})
+        return i
+
+    profiles = []
+    for rec in records:
+        samples: List[List[int]] = []
+        weights: List[float] = []
+        for stack, count in sorted(rec.get("samples", {}).items()):
+            samples.append([fidx(fr) for fr in stack.split(";")])
+            weights.append(float(count))
+        profiles.append(
+            {
+                "type": "sampled",
+                "name": rec.get("label") or f"pid {rec.get('pid')}",
+                "unit": "none",
+                "startValue": 0.0,
+                "endValue": float(sum(weights)),
+                "samples": samples,
+                "weights": weights,
+            }
+        )
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": profiles,
+        "name": name,
+        "exporter": "ray_tpu.profiling",
+        "activeProfileIndex": 0,
+    }
+
+
+def top_frames(records: List[Dict[str, Any]], n: int = 10) -> List[Tuple[str, int, float]]:
+    """(leaf_frame, samples, fraction) of the hottest exclusive frames
+    across the given records — the "what is it doing" one-liner."""
+    counts: Dict[str, int] = {}
+    total = 0
+    for rec in records:
+        for stack, count in rec.get("samples", {}).items():
+            leaf = stack.rsplit(";", 1)[-1]
+            counts[leaf] = counts.get(leaf, 0) + count
+            total += count
+    ranked = sorted(counts.items(), key=lambda kv: -kv[1])[:n]
+    return [(fr, c, (c / total if total else 0.0)) for fr, c in ranked]
+
+
+# ----------------------------------------------------------------------
+# JAX/XLA introspection (CPU-safe; no-ops when jax is absent)
+# ----------------------------------------------------------------------
+_jit_lock = threading.Lock()
+_jit_records: Dict[str, Dict[str, Any]] = {}
+
+
+def _cache_size(jfn) -> Optional[int]:
+    """Compiled-executable cache size of a jitted callable, or None when
+    the jax version doesn't expose it (then only the first call is
+    counted as a compile)."""
+    try:
+        return int(jfn._cache_size())
+    except Exception:  # noqa: BLE001 — private API, version-dependent
+        return None
+
+
+def jit_stats(name: Optional[str] = None) -> Dict[str, Any]:
+    """Per-instrumented-function compile/retrace/cost records."""
+    with _jit_lock:
+        if name is not None:
+            return dict(_jit_records.get(name, {}))
+        return {k: dict(v) for k, v in _jit_records.items()}
+
+
+def instrument_jit(name: str, jfn):
+    """Wrap an already-jitted callable with compile-time and retrace
+    counters plus first-trace cost_analysis.
+
+    Steady-state cost per call: one cache-size probe + two
+    perf_counter reads (~0.5 us) — far inside the telemetry budget for
+    step-scale functions.  When a call triggers a (re)trace, its wall
+    time is recorded as ``jax_compile_seconds`` (trace+compile+first
+    run — the stall the operator actually sees) and a
+    ``jax.compile`` span lands in the timeline.  Disabled via
+    ``jax_introspection=False`` (returns ``jfn`` unwrapped).
+    """
+    try:
+        if not CONFIG.jax_introspection:
+            return jfn
+    except Exception:  # noqa: BLE001 — config unavailable in exotic contexts
+        pass
+    state = {"cache_size": _cache_size(jfn) or 0, "compiles": 0}
+    with _jit_lock:
+        _jit_records.setdefault(
+            name,
+            {"compiles": 0, "retraces": 0, "compile_seconds": 0.0, "flops": None,
+             "bytes_accessed": None},
+        )
+
+    def wrapped(*args, **kwargs):
+        from ray_tpu._private import telemetry
+
+        # cost_analysis runs BEFORE the first call: donate_argnums
+        # functions consume their buffers, so lowering afterwards would
+        # trace over deleted arrays.
+        if not state.get("cost_done"):
+            state["cost_done"] = True
+            _capture_cost(name, jfn, args, kwargs)
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        out = jfn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        cs = _cache_size(jfn)
+        compiled = (cs is not None and cs > state["cache_size"]) or (
+            cs is None and state["compiles"] == 0
+        )
+        if compiled:
+            state["cache_size"] = cs if cs is not None else state["cache_size"]
+            state["compiles"] += 1
+            first = state["compiles"] == 1
+            with _jit_lock:
+                rec = _jit_records[name]
+                rec["compiles"] += 1
+                rec["compile_seconds"] += dt
+                if not first:
+                    rec["retraces"] += 1
+            telemetry.observe_jax_compile(name, dt)
+            if not first:
+                telemetry.count_jax_retrace(name)
+            try:
+                from ray_tpu.util import tracing
+
+                tracing.record_event_span(
+                    "jax.compile",
+                    t_wall,
+                    t_wall + dt,
+                    attributes={"function": name, "retrace": not first},
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+
+    wrapped.__name__ = f"instrumented_{name}"
+    wrapped.__wrapped__ = jfn
+    return wrapped
+
+
+def _capture_cost(name: str, jfn, args, kwargs) -> None:
+    """First-trace cost_analysis: FLOPs + bytes accessed from the
+    lowered computation (one extra trace, never on the steady path).
+    Backends that don't implement it just skip."""
+    try:
+        if not CONFIG.jax_cost_analysis:
+            return
+        lowered = jfn.lower(*args, **kwargs)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+        with _jit_lock:
+            rec = _jit_records[name]
+            rec["flops"] = flops
+            rec["bytes_accessed"] = nbytes
+        from ray_tpu._private import telemetry
+
+        telemetry.set_jax_cost(name, flops, nbytes)
+    except Exception:  # noqa: BLE001 — introspection must never break the hot path
+        pass
+
+
+_dev_report_lock = threading.Lock()
+_last_dev_report = 0.0
+
+
+def report_device_memory(min_interval_s: float = 1.0) -> None:
+    """Publish per-device memory gauges (``memory_stats``) and the live
+    on-device buffer count (``live_arrays``) where the backend supports
+    them.  CPU backends typically report nothing — then this is a
+    cheap no-op.  Rate-limited so per-step call sites cost one clock
+    read on the fast path."""
+    global _last_dev_report
+    from ray_tpu._private import telemetry
+
+    if not telemetry.enabled():
+        return
+    now = time.monotonic()
+    if now - _last_dev_report < min_interval_s:
+        return  # lock-free fast path for per-step call sites
+    with _dev_report_lock:
+        if now - _last_dev_report < min_interval_s:
+            return
+        _last_dev_report = now
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 — no jax in this process
+        return
+    try:
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend init failure
+        return
+    live_by_dev: Dict[str, int] = {}
+    try:
+        for arr in jax.live_arrays():
+            for d in getattr(arr, "devices", lambda: [])():
+                key = f"{d.platform}:{d.id}"
+                live_by_dev[key] = live_by_dev.get(key, 0) + 1
+    except Exception:  # noqa: BLE001
+        pass
+    for d in devices:
+        dev_label = f"{d.platform}:{d.id}"
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — unsupported backend
+            stats = None
+        if stats:
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                telemetry.set_device_memory(dev_label, "in_use", float(in_use))
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                telemetry.set_device_memory(dev_label, "peak", float(peak))
+            limit = stats.get("bytes_limit")
+            if limit is not None:
+                telemetry.set_device_memory(dev_label, "limit", float(limit))
+        if dev_label in live_by_dev:
+            telemetry.set_device_live_buffers(dev_label, live_by_dev[dev_label])

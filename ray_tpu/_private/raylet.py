@@ -2400,4 +2400,36 @@ class Raylet:
                 for w in self.workers.values()
                 for tb, s in w.running.items()
             ],
+            # Worker roster incl. direct RPC endpoints: the profiling
+            # orchestrator fans a node-wide capture out to these.  Ids
+            # are hex (the JSON-API convention — these records flow out
+            # of /api/workers and state.list_workers unchanged).
+            "workers": [
+                {
+                    "worker_id": w.worker_id.hex(),
+                    "pid": w.pid,
+                    "state": w.state,
+                    "direct_address": w.direct_address,
+                    "actor_id": w.actor_id.hex() if w.actor_id else None,
+                    "tenant": w.tenant,
+                }
+                for w in self.workers.values()
+            ],
         }
+
+    # Sampling-profiler surface for the raylet process itself (see
+    # profiling.py; handlers never block the dispatch loop).
+    async def rpc_profile_start(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_start(payload)
+
+    async def rpc_profile_stop(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_stop(payload)
+
+    async def rpc_profile_dump(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_dump(payload)

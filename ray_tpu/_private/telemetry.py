@@ -211,6 +211,85 @@ class _Metrics:
             "per-deployment in-flight bound, engine = waiting-queue bound)",
             tag_keys=("deployment", "where"),
         )
+        # --- profiling & bottleneck-attribution plane ---
+        self.profile_sessions = m.Counter(
+            "profile_sessions_total",
+            "sampling-profiler sessions by outcome (completed, conflict)",
+            tag_keys=("state",),
+        )
+        self.jax_compile = m.Histogram(
+            "jax_compile_seconds",
+            "wall time of calls that (re)traced+compiled an instrumented "
+            "jitted function (the stall the caller saw)",
+            boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                        30.0, 60.0, 300.0],
+            tag_keys=("function",),
+        )
+        self.jax_retraces = m.Counter(
+            "jax_retraces_total",
+            "recompilations past the first trace of an instrumented jitted "
+            "function (a climbing series = unstable shapes/dtypes)",
+            tag_keys=("function",),
+        )
+        self.jax_cost_flops = m.Gauge(
+            "jax_cost_flops",
+            "XLA cost_analysis FLOPs estimate per call of an instrumented "
+            "jitted function, captured at first trace",
+            tag_keys=("function",),
+        )
+        self.jax_cost_bytes = m.Gauge(
+            "jax_cost_bytes",
+            "XLA cost_analysis bytes-accessed estimate per call of an "
+            "instrumented jitted function, captured at first trace",
+            tag_keys=("function",),
+        )
+        self.device_memory = m.Gauge(
+            "device_memory_bytes",
+            "per-device memory from the backend's memory_stats() "
+            "(kind = in_use, peak, limit); absent on backends without "
+            "memory introspection (CPU)",
+            tag_keys=("device", "kind"),
+        )
+        self.device_live_buffers = m.Gauge(
+            "device_live_buffers",
+            "live on-device arrays per device (jax.live_arrays view)",
+            tag_keys=("device",),
+        )
+        # --- compiled-DAG dataplane (experimental/channel.py + dag/) ---
+        self.channel_ops = m.Counter(
+            "channel_ops_total",
+            "seqlock ring-channel operations (op = read, write); flushed "
+            "in batches off the hot path",
+            tag_keys=("op",),
+        )
+        self.channel_blocked = m.Counter(
+            "channel_blocked_seconds_total",
+            "seconds channel ops spent blocked waiting on the peer "
+            "(write = reader hasn't acked, read = writer hasn't published)",
+            tag_keys=("op",),
+        )
+        self.channel_timeouts = m.Counter(
+            "channel_timeouts_total",
+            "channel ops that hit their timeout (the caller's retry "
+            "signal), by op",
+            tag_keys=("op",),
+        )
+        self.dag_op = m.Histogram(
+            "dag_op_seconds",
+            "execution time of one op (actor method body) inside a "
+            "compiled-DAG resident loop",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("method",),
+        )
+        self.dag_executions = m.Counter(
+            "dag_executions_total",
+            "compiled-DAG executions submitted by drivers",
+        )
+        self.dag_inflight = m.Gauge(
+            "dag_inflight",
+            "compiled-DAG executions in flight (submitted, result not yet "
+            "read) — channel-plane occupancy as seen by the driver",
+        )
 
 
 def _metrics() -> _Metrics:
@@ -466,6 +545,117 @@ def count_serve_shed(deployment: str, where: str, n: int = 1) -> None:
         {"deployment": deployment, "where": where},
     )
     b.inc(float(n))
+
+
+# ----------------------------------------------------------------------
+# profiling & bottleneck-attribution plane.  Function labels are
+# instrumentation-site names (literal strings at the call sites —
+# bounded); device labels enumerate local accelerators (bounded).
+# ----------------------------------------------------------------------
+_profile_bound: dict = {}
+_jax_compile_bound: dict = {}
+_jax_retrace_bound: dict = {}
+_chan_ops_bound: dict = {}
+_chan_blocked_bound: dict = {}
+_chan_timeout_bound: dict = {}
+_dag_op_bound: dict = {}
+
+
+def count_profile_session(state: str) -> None:
+    if not enabled():
+        return
+    b = _profile_bound.get(state) or _bind(
+        _profile_bound, state, "profile_sessions", {"state": state}
+    )
+    b.inc(1.0)
+
+
+def observe_jax_compile(function: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _jax_compile_bound.get(function) or _bind(
+        _jax_compile_bound, function, "jax_compile", {"function": function}
+    )
+    b.observe(max(0.0, seconds))
+
+
+def count_jax_retrace(function: str) -> None:
+    if not enabled():
+        return
+    b = _jax_retrace_bound.get(function) or _bind(
+        _jax_retrace_bound, function, "jax_retraces", {"function": function}
+    )
+    b.inc(1.0)
+
+
+def set_jax_cost(function: str, flops: float, nbytes: float) -> None:
+    if not enabled():
+        return
+    m = _metrics()
+    m.jax_cost_flops.set(flops, tags={"function": function})
+    m.jax_cost_bytes.set(nbytes, tags={"function": function})
+
+
+def set_device_memory(device: str, kind: str, value: float) -> None:
+    if not enabled():
+        return
+    _metrics().device_memory.set(value, tags={"device": device, "kind": kind})
+
+
+def set_device_live_buffers(device: str, count: int) -> None:
+    if not enabled():
+        return
+    _metrics().device_live_buffers.set(float(count), tags={"device": device})
+
+
+def count_channel_ops(op: str, n: int) -> None:
+    """Batched (callers accumulate locally and flush every N ops) so
+    the channel hot path stays at dict increments."""
+    if not enabled() or n <= 0:
+        return
+    b = _chan_ops_bound.get(op) or _bind(
+        _chan_ops_bound, op, "channel_ops", {"op": op}
+    )
+    b.inc(float(n))
+
+
+def add_channel_blocked(op: str, seconds: float) -> None:
+    if not enabled() or seconds <= 0.0:
+        return
+    b = _chan_blocked_bound.get(op) or _bind(
+        _chan_blocked_bound, op, "channel_blocked", {"op": op}
+    )
+    b.inc(seconds)
+
+
+def count_channel_timeout(op: str, n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    b = _chan_timeout_bound.get(op) or _bind(
+        _chan_timeout_bound, op, "channel_timeouts", {"op": op}
+    )
+    b.inc(float(n))
+
+
+def observe_dag_op(method: str, seconds: float) -> None:
+    if not enabled():
+        return
+    b = _dag_op_bound.get(method) or _bind(
+        _dag_op_bound, method, "dag_op", {"method": method}
+    )
+    b.observe(max(0.0, seconds))
+
+
+def count_dag_execution() -> None:
+    if not enabled():
+        return
+    _metrics().dag_executions.inc(1.0)
+
+
+def set_dag_inflight(n: int) -> None:
+    if not enabled():
+        return
+    _metrics().dag_inflight.set(float(n))
 
 
 def set_drain_budget(deadline_remaining_s: float, inflight_tasks: int) -> None:

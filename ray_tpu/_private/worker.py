@@ -603,6 +603,26 @@ class Worker:
         if not started.wait(10):
             self.direct_address = None
 
+    # Sampling-profiler surface on the worker's direct server: any
+    # submitter/driver with the worker's direct address can attach
+    # (util.state.profile resolves actors to these endpoints).  The
+    # handlers never block — start spawns a daemon sampler thread,
+    # stop/dump snapshot under a short lock (see profiling.py).
+    async def rpc_profile_start(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_start(payload)
+
+    async def rpc_profile_stop(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_stop(payload)
+
+    async def rpc_profile_dump(self, payload, conn):
+        from ray_tpu._private import profiling
+
+        return profiling.handle_profile_dump(payload)
+
     async def push_exec_direct(self, payload, conn):
         """Direct task push from a submitter (runs on the server loop)."""
         spec: TaskSpec = payload["spec"]

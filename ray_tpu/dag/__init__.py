@@ -195,8 +195,10 @@ def _actor_channel_loop(self, ops, chan_paths):
     flows through the op's out-channels like a result (downstream ops
     see it, skip execution, and propagate), so the driver's get raises
     the original exception and the DAG stays usable."""
+    import time as _time
+
     from ray_tpu import exceptions
-    from ray_tpu._private import serialization
+    from ray_tpu._private import serialization, telemetry
     from ray_tpu.experimental.channel import Channel, ChannelClosed
 
     chans = {p: Channel(p) for p in chan_paths}
@@ -225,7 +227,11 @@ def _actor_channel_loop(self, ops, chan_paths):
                     result, tag = arg_error, serialization.TAG_ERROR
                 else:
                     try:
+                        t0 = _time.perf_counter()
                         result = getattr(self, op["method"])(*args)
+                        telemetry.observe_dag_op(
+                            op["method"], _time.perf_counter() - t0
+                        )
                         tag = serialization.TAG_NORMAL
                     except ChannelClosed:
                         raise
@@ -249,6 +255,24 @@ def _actor_channel_loop(self, ops, chan_paths):
                 except Exception:
                     pass
         return "closed"
+
+
+# Process-wide in-flight count across ALL CompiledDAGs: the exported
+# dag_inflight gauge is per process (last-writer-wins at the registry),
+# so two concurrently-driven DAGs must contribute to one aggregate
+# instead of overwriting each other's occupancy.
+_inflight_lock = threading.Lock()
+_inflight_total = 0
+
+
+def _inflight_adjust(delta: int) -> None:
+    global _inflight_total
+    from ray_tpu._private import telemetry
+
+    with _inflight_lock:
+        _inflight_total = max(0, _inflight_total + delta)
+        total = _inflight_total
+    telemetry.set_dag_inflight(total)
 
 
 class CompiledDAGRef:
@@ -294,6 +318,10 @@ class CompiledDAG:
         self._seq = 0
         self._results: Dict[int, Any] = {}
         self._next_result = 1
+        # This DAG's live contribution to the process-wide dag_inflight
+        # gauge (returned on drain or at teardown, so an abandoned DAG
+        # can't pin the gauge elevated forever).
+        self._inflight_contrib = 0
         self._partial: List[Any] = []
         self._channels_on = False
         self._buffer_size = buffer_size_bytes
@@ -445,6 +473,11 @@ class CompiledDAG:
                     if key not in blobs:
                         blobs[key] = serialization.serialize_to_bytes(extract(key))
                     chan.write(blobs[key])
+                from ray_tpu._private import telemetry
+
+                telemetry.count_dag_execution()
+                self._inflight_contrib += 1
+                _inflight_adjust(+1)
                 return CompiledDAGRef(self, self._seq)
         cache: Dict[str, Any] = {}
         with self._lock:
@@ -457,36 +490,93 @@ class CompiledDAG:
         from ray_tpu._private import serialization
 
         with self._lock:
-            while self._next_result <= seq:
-                # _partial survives a ChannelTimeout partway through a
-                # multi-output read: already-consumed channels are not
-                # re-read on retry, so results can't cross executions.
-                while len(self._partial) < len(self._driver_out):
-                    chan = self._driver_out[len(self._partial)]
-                    self._partial.append(
-                        serialization.deserialize(memoryview(chan.read(timeout)))
-                    )
-                vals, self._partial = self._partial, []
-                if any(tag == serialization.TAG_ERROR for tag, _ in vals):
-                    out = next(v for tag, v in vals if tag == serialization.TAG_ERROR)
-                else:
-                    out = (
-                        [v for _, v in vals]
-                        if isinstance(self._root, MultiOutputNode)
-                        else vals[0][1]
-                    )
-                self._results[self._next_result] = out
-                self._next_result += 1
-            result = self._results.pop(seq)
+            drained_from = self._next_result
+            try:
+                while self._next_result <= seq:
+                    # _partial survives a ChannelTimeout partway through a
+                    # multi-output read: already-consumed channels are not
+                    # re-read on retry, so results can't cross executions.
+                    while len(self._partial) < len(self._driver_out):
+                        chan = self._driver_out[len(self._partial)]
+                        self._partial.append(
+                            serialization.deserialize(memoryview(chan.read(timeout)))
+                        )
+                    vals, self._partial = self._partial, []
+                    if any(tag == serialization.TAG_ERROR for tag, _ in vals):
+                        out = next(v for tag, v in vals if tag == serialization.TAG_ERROR)
+                    else:
+                        out = (
+                            [v for _, v in vals]
+                            if isinstance(self._root, MultiOutputNode)
+                            else vals[0][1]
+                        )
+                    self._results[self._next_result] = out
+                    self._next_result += 1
+                result = self._results.pop(seq)
+            finally:
+                # One decrement per execution DRAINED (in the finally so
+                # a ChannelTimeout mid-loop still accounts the results
+                # it did materialize), not per get() call: a get() on a
+                # later ref materializes every earlier result too, and
+                # decrementing once would leave the gauge elevated
+                # forever.
+                drained = self._next_result - drained_from
+                if drained:
+                    self._inflight_contrib = max(0, self._inflight_contrib - drained)
+                    _inflight_adjust(-drained)
         if isinstance(result, exceptions.RayTaskError):
             raise result.as_instanceof_cause()
         return result
+
+    def stats(self) -> Dict[str, Any]:
+        """Driver-side dataplane counters: per-channel op/blocked-time/
+        timeout stats plus in-flight occupancy (the compiled-graphs
+        bottleneck view; actor-side op timings flow through telemetry
+        as ``dag_op_seconds``/``channel_*``).
+
+        Never blocks: ``_read_result`` holds ``self._lock`` across its
+        (possibly long) channel reads, and a diagnostic view that hangs
+        exactly when the DAG is stalled would be useless.  If the lock
+        is busy the snapshot is taken lockless (counters are plain
+        ints/dicts — a torn read costs one off-by-one in a diagnostic,
+        flagged via ``"consistent": False``)."""
+        locked = self._lock.acquire(blocking=False)
+        try:
+            inflight = self._seq - self._next_result + 1
+            out: Dict[str, Any] = {
+                "compiled": self._channels_on,
+                "consistent": locked,
+                "executions": self._seq,
+                "inflight": max(0, inflight),
+                "max_inflight": self._max_inflight,
+                "input_channels": [],
+                "output_channels": [],
+            }
+            if self._channels_on:
+                for chan, key in self._driver_in:
+                    out["input_channels"].append(
+                        {"key": key, "pending": chan.pending(), **chan.stats}
+                    )
+                for chan in self._driver_out:
+                    out["output_channels"].append(
+                        {"pending": chan.pending(), **chan.stats}
+                    )
+        finally:
+            if locked:
+                self._lock.release()
+        return out
 
     def teardown(self):
         import shutil
 
         import ray_tpu
 
+        # Return this DAG's undrained executions to the process gauge:
+        # a torn-down (or abandoned) DAG must not pin dag_inflight.
+        with self._lock:
+            leftover, self._inflight_contrib = self._inflight_contrib, 0
+        if leftover:
+            _inflight_adjust(-leftover)
         if self._channels_on:
             for chan, _ in self._driver_in:
                 try:

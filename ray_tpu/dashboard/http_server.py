@@ -159,6 +159,36 @@ class _DashboardState:
         events = self.gcs.call("list_task_events", {"limit": 100_000})
         return build_chrome_trace(events, self.spans())
 
+    def profile(
+        self,
+        target=None,
+        duration_s: float = 3.0,
+        hz=None,
+        mode: str = "wall",
+        include_workers: bool = True,
+    ):
+        """Drive an on-demand sampling-profiler capture (util.profiling
+        orchestration over the dashboard's own GCS/raylet clients).
+        Blocks this HTTP thread for ~duration_s (ThreadingHTTPServer:
+        other routes keep serving)."""
+        from ray_tpu.util import profiling as profiling_mod
+
+        targets = profiling_mod.resolve_targets(
+            target, self.gcs.call, include_workers=include_workers
+        )
+        return profiling_mod.run_profile(
+            targets,
+            self.gcs.call,
+            lambda addr, m, p, t: self._raylet(addr).call(m, p, timeout=t),
+            duration_s=duration_s,
+            hz=hz,
+            mode=mode,
+        )
+
+    def list_profiles(self, session_id=None):
+        payload = {"session_id": session_id} if session_id else None
+        return self.gcs.call("list_profiles", payload) or []
+
     def chaos(self):
         """Active chaos schedule + per-rule injection counts: the GCS
         process's view, every alive raylet's view (node_stats), and the
@@ -363,6 +393,44 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if path == "/api/chaos":
                 return self._json(self.state.chaos())
+            if path == "/api/profile":
+                from urllib.parse import parse_qs
+
+                q = parse_qs(urlparse(self.path).query)
+
+                def qget(key, default=None):
+                    vals = q.get(key)
+                    return vals[0] if vals else default
+
+                duration = max(0.05, min(float(qget("duration_s", 3.0)), 30.0))
+                hz = qget("hz")
+                result = self.state.profile(
+                    target=qget("target") or None,
+                    duration_s=duration,
+                    hz=float(hz) if hz else None,
+                    mode=qget("mode", "wall"),
+                    include_workers=qget("workers", "1") not in ("0", "false"),
+                )
+                fmt = qget("format", "json")
+                if fmt == "collapsed":
+                    return self._send(200, result.collapsed().encode(), "text/plain")
+                if fmt == "speedscope":
+                    return self._send(
+                        200, json.dumps(result.speedscope()).encode(), "application/json"
+                    )
+                return self._json(
+                    {
+                        **result.summary(),
+                        "collapsed": result.collapsed(),
+                        "profiles": result.profiles,
+                    }
+                )
+            if path == "/api/profiles":
+                from urllib.parse import parse_qs
+
+                q = parse_qs(urlparse(self.path).query)
+                sid = q.get("session_id", [None])[0]
+                return self._json(self.state.list_profiles(sid))
             if path == "/metrics":
                 return self._send(
                     200, self.state.prometheus_metrics().encode(), "text/plain; version=0.0.4"

@@ -15,11 +15,20 @@ Two kinds of latency-critical entry points exist in this codebase
   (blocking GCS pushes stalled stream consumption through outages).
 
 The checker collects those entry points per module, builds a
-module-local call graph (``self.method()`` and module-level ``func()``
-edges), and flags ``time.sleep`` / blocking ``recv`` reachable within
-the module.  Cross-module reachability is out of scope by design — a
-blocking call behind an import boundary needs its own local entry point
-to be flagged, which keeps the analysis fast and the findings precise.
+**cross-module call graph**, and flags ``time.sleep`` / blocking
+``recv`` reachable from any entry point.  Edges resolved:
+
+- ``self.method()`` within the entry's class and bare ``func()`` within
+  the module (as before);
+- ``alias.func()`` where ``alias`` imports another module in the linted
+  tree (``from ray_tpu._private import rpc`` → ``rpc.call_idempotent``
+  lands in rpc.py's ``call_idempotent``) — the PR 5 follow-up: blocking
+  calls reached *through helper modules* used to escape the analysis;
+- ``alias.Class(...)`` constructor calls → ``Class.__init__`` in the
+  target module.
+
+Method calls on arbitrary objects stay unresolved by design (no type
+inference); depth is bounded by ``_MAX_DEPTH``.
 """
 
 from __future__ import annotations
@@ -27,12 +36,15 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from ray_tpu.devtools.lint.core import Module, Violation, call_name
+from ray_tpu.devtools.lint.core import Module, Project, Violation, call_name
 
 name = "blocking-in-handler"
 
 _CALLBACK_KWARGS = ("on_push", "on_close", "on_reconnect", "on_disconnect")
 _MAX_DEPTH = 8
+
+# (relpath, qualname) node in the cross-module call graph
+_Node = Tuple[str, str]
 
 
 def _blocking(node: ast.Call, in_async: bool) -> Optional[str]:
@@ -109,9 +121,77 @@ def _callback_targets(ref: ast.AST) -> List[str]:
     return []
 
 
-def _callees(mod: Module, q: str, fn: ast.AST, fns: Dict[str, ast.AST]) -> Set[str]:
+def _module_relpath_index(project: Project) -> Dict[str, str]:
+    """Dotted module name -> relpath for every module in the linted tree
+    (``ray_tpu/_private/rpc.py`` -> ``ray_tpu._private.rpc``; packages
+    map their ``__init__.py`` too)."""
+    out: Dict[str, str] = {}
+    for mod in project.modules:
+        rel = mod.relpath
+        if not rel.endswith(".py"):
+            continue
+        dotted = rel[:-3].replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        out[dotted] = rel
+    return out
+
+
+def _import_aliases(
+    mod: Module, mod_index: Dict[str, str]
+) -> Tuple[Dict[str, str], Dict[str, Tuple[str, str]]]:
+    """Alias maps from every import statement in the module (module
+    scope AND function-local — this tree imports lazily for cycle
+    avoidance, and a lazy import is exactly how helper modules are
+    reached from handlers).
+
+    Returns (module_aliases: alias -> relpath,
+             symbol_aliases: alias -> (relpath, symbol))."""
+    mod_aliases: Dict[str, str] = {}
+    sym_aliases: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    # `import a.b as x` binds x to module a.b
+                    rel = mod_index.get(a.name)
+                    if rel:
+                        mod_aliases[a.asname] = rel
+                else:
+                    # `import a.b` binds the name `a` (the TOP package),
+                    # not a.b — resolving `a` to a.b would send alias
+                    # lookups into the wrong module.
+                    top = a.name.split(".")[0]
+                    rel = mod_index.get(top)
+                    if rel:
+                        mod_aliases[top] = rel
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                continue  # relative imports: out of scope
+            base = node.module or ""
+            for a in node.names:
+                full = f"{base}.{a.name}" if base else a.name
+                rel = mod_index.get(full)
+                if rel:
+                    # `from ray_tpu._private import rpc` — a module alias
+                    mod_aliases[a.asname or a.name] = rel
+                elif base in mod_index:
+                    # `from ray_tpu._private.rpc import call_idempotent`
+                    sym_aliases[a.asname or a.name] = (mod_index[base], a.name)
+    return mod_aliases, sym_aliases
+
+
+def _callees(
+    mod: Module,
+    q: str,
+    fn: ast.AST,
+    fns_by_mod: Dict[str, Dict[str, ast.AST]],
+    mod_aliases: Dict[str, str],
+    sym_aliases: Dict[str, Tuple[str, str]],
+) -> Set[_Node]:
     cls = q.split(".")[0] if "." in q else None
-    out: Set[str] = set()
+    fns = fns_by_mod[mod.relpath]
+    out: Set[_Node] = set()
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
             continue
@@ -119,60 +199,120 @@ def _callees(mod: Module, q: str, fn: ast.AST, fns: Dict[str, ast.AST]) -> Set[s
         if cn.startswith("self.") and cls:
             cand = f"{cls}.{cn[5:]}"
             if cand in fns:
-                out.add(cand)
-        elif "." not in cn and cn in fns:
-            out.add(cn)
+                out.add((mod.relpath, cand))
+        elif "." not in cn:
+            if cn in fns:
+                out.add((mod.relpath, cn))
+            elif cn in sym_aliases:
+                rel, sym = sym_aliases[cn]
+                target_fns = fns_by_mod.get(rel, {})
+                if sym in target_fns:
+                    out.add((rel, sym))
+                elif f"{sym}.__init__" in target_fns:
+                    out.add((rel, f"{sym}.__init__"))
+        else:
+            # alias.func(...) / alias.Class(...) through an imported module
+            head, rest = cn.split(".", 1)
+            rel = mod_aliases.get(head)
+            if rel is None or "." in rest:
+                continue  # deeper attribute chains: unresolved by design
+            target_fns = fns_by_mod.get(rel, {})
+            if rest in target_fns:
+                out.add((rel, rest))
+            elif f"{rest}.__init__" in target_fns:
+                out.add((rel, f"{rest}.__init__"))
     return out
 
 
-def check(mod: Module) -> Iterable[Violation]:
-    fns = _fn_index(mod)
-    if not fns:
-        return []
-    entries = _entries(mod, fns)
-    if not entries:
-        return []
+def check_project(project: Project) -> Iterable[Violation]:
+    mods_by_rel = {m.relpath: m for m in project.modules}
+    fns_by_mod = {m.relpath: _fn_index(m) for m in project.modules}
+    mod_index = _module_relpath_index(project)
+    alias_cache: Dict[str, Tuple[Dict[str, str], Dict[str, Tuple[str, str]]]] = {}
+
+    def aliases(rel: str):
+        got = alias_cache.get(rel)
+        if got is None:
+            got = alias_cache[rel] = _import_aliases(mods_by_rel[rel], mod_index)
+        return got
+
+    # Per-function memo of blocking sites + outgoing edges.
+    site_cache: Dict[_Node, List[Tuple[str, int]]] = {}
+    edge_cache: Dict[_Node, Set[_Node]] = {}
+
+    def sites(node: _Node) -> List[Tuple[str, int]]:
+        got = site_cache.get(node)
+        if got is None:
+            rel, q = node
+            fn = fns_by_mod[rel][q]
+            in_async = isinstance(fn, ast.AsyncFunctionDef)
+            got = []
+            for n in _own_nodes(fn):
+                if isinstance(n, ast.Call):
+                    kind = _blocking(n, in_async)
+                    if kind:
+                        got.append((kind, n.lineno))
+            site_cache[node] = got
+        return got
+
+    def edges(node: _Node) -> Set[_Node]:
+        got = edge_cache.get(node)
+        if got is None:
+            rel, q = node
+            mod_aliases, sym_aliases = aliases(rel)
+            got = edge_cache[node] = _callees(
+                mods_by_rel[rel], q, fns_by_mod[rel][q], fns_by_mod,
+                mod_aliases, sym_aliases,
+            )
+        return got
+
     out: List[Violation] = []
-    reported: Set[Tuple[str, int]] = set()
-    for entry in entries:
-        # BFS through the module-local call graph.
-        seen = {entry}
-        frontier: List[Tuple[str, Tuple[str, ...]]] = [(entry, (entry,))]
-        depth = 0
-        while frontier and depth < _MAX_DEPTH:
-            nxt: List[Tuple[str, Tuple[str, ...]]] = []
-            for q, trail in frontier:
-                fn = fns[q]
-                in_async = isinstance(fn, ast.AsyncFunctionDef)
-                for node in _own_nodes(fn):
-                    if isinstance(node, ast.Call):
-                        kind = _blocking(node, in_async)
-                        if kind and (q, node.lineno) not in reported:
-                            reported.add((q, node.lineno))
-                            via = (
-                                "" if len(trail) == 1
-                                else " via " + " -> ".join(trail[1:])
+    reported: Set[Tuple[str, str, int]] = set()
+    for mod in project.modules:
+        fns = fns_by_mod[mod.relpath]
+        if not fns:
+            continue
+        for entry in _entries(mod, fns):
+            root: _Node = (mod.relpath, entry)
+            seen = {root}
+            frontier: List[Tuple[_Node, Tuple[str, ...]]] = [(root, (entry,))]
+            depth = 0
+            while frontier and depth < _MAX_DEPTH:
+                nxt: List[Tuple[_Node, Tuple[str, ...]]] = []
+                for node, trail in frontier:
+                    rel, q = node
+                    for kind, lineno in sites(node):
+                        if (rel, q, lineno) in reported:
+                            continue
+                        reported.add((rel, q, lineno))
+                        via = (
+                            "" if len(trail) == 1
+                            else " via " + " -> ".join(trail[1:])
+                        )
+                        origin = (
+                            "" if rel == mod.relpath
+                            else f" (entry in {mod.relpath})"
+                        )
+                        out.append(
+                            Violation(
+                                check=name,
+                                path=rel,
+                                line=lineno,
+                                symbol=q,
+                                tag=f"{kind}@{entry}",
+                                message=(
+                                    f"{kind} reachable from handler/pubsub "
+                                    f"entry point {entry}{origin}{via} — this "
+                                    "blocks the RPC dispatch loop / reader "
+                                    "thread; defer to a worker thread or use "
+                                    "asyncio.sleep in async handlers"
+                                ),
                             )
-                            out.append(
-                                Violation(
-                                    check=name,
-                                    path=mod.relpath,
-                                    line=node.lineno,
-                                    symbol=q,
-                                    tag=f"{kind}@{entry}",
-                                    message=(
-                                        f"{kind} reachable from handler/pubsub "
-                                        f"entry point {entry}{via} — this blocks "
-                                        "the RPC dispatch loop / reader thread; "
-                                        "defer to a worker thread or use "
-                                        "asyncio.sleep in async handlers"
-                                    ),
-                                )
-                            )
-                for callee in _callees(mod, q, fn, fns):
-                    if callee not in seen:
-                        seen.add(callee)
-                        nxt.append((callee, trail + (callee,)))
-            frontier = nxt
-            depth += 1
+                        )
+                    for callee in edges(node):
+                        if callee not in seen:
+                            seen.add(callee)
+                            nxt.append((callee, trail + (callee[1],)))
+                frontier = nxt
+                depth += 1
     return out

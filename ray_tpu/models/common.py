@@ -66,12 +66,18 @@ def make_sharded_train_step(step_fn: Callable, mesh):
     from ray_tpu.parallel.sharding import batch_spec
 
     data_sharding = NamedSharding(mesh, batch_spec(mesh))
-    jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+    from ray_tpu._private import profiling
+
+    jitted = profiling.instrument_jit(
+        "train_step", jax.jit(step_fn, donate_argnums=(0, 1))
+    )
 
     def run(params, opt_state, tokens, targets):
         tokens = jax.device_put(tokens, data_sharding)
         targets = jax.device_put(targets, data_sharding)
-        return jitted(params, opt_state, tokens, targets)
+        out = jitted(params, opt_state, tokens, targets)
+        profiling.report_device_memory()
+        return out
 
     run.data_sharding = data_sharding
     return run

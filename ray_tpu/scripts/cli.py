@@ -1,6 +1,6 @@
 """CLI: `python -m ray_tpu.scripts.cli <cmd>` or the `ray-tpu` console
 script (reference: python/ray/scripts/scripts.py — ray
-start/stop/status/submit/memory/timeline/list)."""
+start/stop/status/submit/memory/timeline/profile/list)."""
 
 from __future__ import annotations
 
@@ -147,6 +147,35 @@ def cmd_timeline(args):
     path = args.output or f"ray_tpu_timeline_{int(time.time())}.json"
     state.timeline(path, include_spans=not args.tasks_only)
     print(f"wrote chrome trace to {path} (open in chrome://tracing or perfetto)")
+    return 0
+
+
+def cmd_profile(args):
+    """Attach the on-demand sampling profiler to a live target and write
+    the merged capture (docs/profiling.md)."""
+    from ray_tpu.util import state
+
+    _connect(args)
+    result = state.profile(
+        args.target or None,
+        duration_s=args.duration,
+        hz=args.hz,
+        mode=args.mode,
+    )
+    fmt = args.format
+    path = args.output or f"ray_tpu_profile_{int(time.time())}." + (
+        "speedscope.json" if fmt == "speedscope" else "folded"
+    )
+    result.save(path, fmt=fmt)
+    summary = result.summary()
+    for err in summary["errors"]:
+        print(f"warning: {err}")
+    print(
+        f"wrote {fmt} profile to {path} "
+        f"({summary['total_samples']} samples from {len(summary['targets'])} process(es))"
+    )
+    for row in summary["top_frames"][:5]:
+        print(f"  {row['fraction']:>6.1%}  {row['frame']}")
     return 0
 
 
@@ -308,6 +337,21 @@ def main(argv=None):
                    help="omit spans; task events only (pre-flight-recorder shape)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "profile",
+        help="attach the sampling profiler to a live actor/node/gcs/cluster",
+    )
+    p.add_argument("target", nargs="?", default=None,
+                   help="actor id hex, node id hex, 'gcs', or omit for the whole cluster")
+    p.add_argument("-d", "--duration", type=float, default=5.0)
+    p.add_argument("--hz", type=float, default=None)
+    p.add_argument("--mode", choices=("wall", "cpu"), default="wall")
+    p.add_argument("-f", "--format", choices=("collapsed", "speedscope"),
+                   default="collapsed")
+    p.add_argument("-o", "--output", default=None)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("memory", help="object store usage")
     p.add_argument("--limit", type=int, default=50)

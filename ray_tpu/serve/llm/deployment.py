@@ -170,7 +170,11 @@ class StaticBatchLLMServer:
 
         # compiles once per (B, Ctot-bucket) shape — Ctot is bucketed in
         # _run_batch so mixed max_tokens don't fan out compilations
-        self._step_jit = jax.jit(step, donate_argnums=(3, 4))
+        from ray_tpu._private import profiling as _profiling
+
+        self._step_jit = _profiling.instrument_jit(
+            "serve_static_step", jax.jit(step, donate_argnums=(3, 4))
+        )
 
     async def _generate_batch(self, payloads: List[Any]) -> List[Dict[str, Any]]:
         loop = asyncio.get_running_loop()

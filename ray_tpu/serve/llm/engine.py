@@ -145,8 +145,18 @@ class LLMEngine:
             nxt = gpt2.sample_logits(logits, rng, temp, top_k)
             return nxt, k_pages, v_pages
 
-        self._prefill_jit = jax.jit(prefill_step, donate_argnums=(1, 2))
-        self._decode_jit = jax.jit(decode_step, donate_argnums=(1, 2))
+        # XLA introspection on the serving hot path: compile-time/
+        # retrace counters (prefill compiles once per prompt bucket —
+        # a retrace storm here is a bucketing bug) + first-trace
+        # FLOPs/bytes (docs/profiling.md).
+        from ray_tpu._private import profiling as _profiling
+
+        self._prefill_jit = _profiling.instrument_jit(
+            "serve_prefill", jax.jit(prefill_step, donate_argnums=(1, 2))
+        )
+        self._decode_jit = _profiling.instrument_jit(
+            "serve_decode", jax.jit(decode_step, donate_argnums=(1, 2))
+        )
 
     def _next_rng(self):
         import jax
@@ -544,6 +554,11 @@ class LLMEngine:
             telemetry.set_serve_queue_depth(name, len(self.waiting))
             telemetry.set_serve_kv_blocks(name, self.bm.blocks_in_use)
             telemetry.set_serve_tokens_per_s(name, self._tokens_per_s())
+            # Device memory attribution for the paged KV cache (no-op on
+            # backends without memory_stats; internally rate-limited).
+            from ray_tpu._private import profiling as profiling_mod
+
+            profiling_mod.report_device_memory()
             if self._shed_unreported:
                 telemetry.count_serve_shed(name, "engine", self._shed_unreported)
                 self._shed_unreported = 0

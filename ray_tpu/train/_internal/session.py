@@ -149,6 +149,11 @@ class _TrainSession:
 
             telemetry.observe_train_step(self.world_rank, now - self._last_report_t)
         self._last_report_t = now
+        # Device memory gauges ride the same per-step cadence (CPU-safe
+        # no-op; internally rate-limited to ~1/s).
+        from ray_tpu._private import profiling as profiling_mod
+
+        profiling_mod.report_device_memory()
         persisted = None
         if checkpoint is not None:
             # Persist into the run's storage dir; rank-tagged (reference:

@@ -137,6 +137,41 @@ def metrics() -> List[Dict[str, Any]]:
     return _gcs().call("metrics_get", None)
 
 
+def profile(
+    target: Any = None,
+    duration_s: float = 5.0,
+    hz: Optional[float] = None,
+    mode: str = "wall",
+    include_workers: bool = True,
+):
+    """Attach the on-demand sampling profiler to a live actor, node,
+    the GCS, or the whole cluster, and return the merged
+    ``ProfileResult`` (collapsed-stack / speedscope exports, top-frame
+    attribution — docs/profiling.md).
+
+    ``target``: an ``ActorHandle`` / actor id, a node id hex, ``"gcs"``,
+    or ``None``/``"cluster"`` for everything.  Blocks ~``duration_s``.
+    A target that dies mid-capture yields a partial result with an
+    ``errors`` entry, never an exception.
+    """
+    from ray_tpu.util import profiling as profiling_mod
+
+    gcs_call = _gcs().call
+    targets = profiling_mod.resolve_targets(
+        target, gcs_call, include_workers=include_workers
+    )
+    return profiling_mod.run_profile(
+        targets, gcs_call, _node_call, duration_s=duration_s, hz=hz, mode=mode
+    )
+
+
+def profiles(session_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Capture records in the GCS profile table (shipped by profiled
+    processes at end of capture — survives the profiled process)."""
+    payload = {"session_id": session_id} if session_id else None
+    return _gcs().call("list_profiles", payload) or []
+
+
 def _dedupe_spans(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Span delivery to the GCS is at-least-once (a lost span_report
     reply re-sends the batch), so collapse duplicates by span_id —
@@ -396,11 +431,15 @@ def _matches(rec: Dict[str, Any], filters: Optional[List[tuple]]) -> bool:
 _node_clients: Dict[str, Any] = {}
 
 
-def _node_call(address: str, method: str, payload: Any):
+def _node_call(address: str, method: str, payload: Any, timeout: Optional[float] = None):
     from ray_tpu._private import rpc
 
     client = _node_clients.get(address)
-    if client is None:
+    if client is None or client.closed:
+        # Re-dial closed cached clients (connection loss must not
+        # permanently break this address — the target may be back).
         client = rpc.RpcClient(address)
         _node_clients[address] = client
-    return client.call(method, payload)
+    if timeout is None:  # unset: keep the config-default call timeout
+        return client.call(method, payload)
+    return client.call(method, payload, timeout=timeout)

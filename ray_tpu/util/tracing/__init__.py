@@ -214,6 +214,31 @@ def record_span(
     )
 
 
+def record_event_span(
+    name: str,
+    start_time: float,
+    end_time: float,
+    attributes: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Record an already-timed standalone event as its own root span
+    (fresh trace id), regardless of any active context.  For events
+    that happen on background threads with no caller to parent them —
+    jax compiles, profile captures — so they still land in
+    ``state.timeline()``."""
+    _record_span(
+        {
+            "name": name,
+            "trace_id": _new_trace_id(),
+            "span_id": _new_span_id(),
+            "parent_span_id": None,
+            "start_time": start_time,
+            "end_time": end_time,
+            "pid": os.getpid(),
+            "attributes": attributes or {},
+        }
+    )
+
+
 def drain_spans() -> List[Dict[str, Any]]:
     """Pop and return this process's finished spans."""
     global _flushed_upto, _drain_epoch

@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""bench_gate: the perf-trajectory regression gate.
+
+The BENCH_r04/r05 confusion class motivates this: captures taken
+off-TPU (``on_tpu: false``) were read as an 8x regression against r03's
+TPU number.  The gate loads the checked-in ``BENCH_r*.json`` lineage
+and:
+
+- **refuses cross-platform comparisons** — consecutive captures of the
+  same metric whose ``on_tpu`` provenance differs (or is missing) are
+  SKIPPED with a loud note, never scored;
+- **flags >15% regressions** on like-for-like captures (same metric,
+  same platform, both with explicit provenance);
+- exits nonzero on regressions unless ``--warn-only`` (the verify.sh
+  mode: the trajectory is reported every run, but only a human promotes
+  a warning to a block — perf capture boxes vary).
+
+Also supports ``--compare OLD.json NEW.json`` for metric-dict captures
+(BENCH_micro/BENCH_serve style: ``{metric: {value, ...}}``) so two runs
+of the same bench can be gated directly.
+
+Run standalone:  python scripts/bench_gate.py [--repo DIR] [--warn-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.15
+
+# Metrics where larger is worse (latencies); everything else in the
+# lineage is a throughput (larger is better).  Rate metrics
+# (`*_per_s`, `*_per_sec`) are throughputs even though they end in a
+# seconds-ish suffix — they must not match the latency patterns.
+_RATE = re.compile(r"per_s(ec)?$")
+_LOWER_IS_BETTER = re.compile(
+    r"(latency|seconds|_s$|_ms$|p50|p95|p99|ttft|shed|leak|error|fail|drop"
+    r"|evict|timeout|blocks_after)"
+)
+
+
+def _higher_is_better(metric: str) -> bool:
+    metric = metric or ""
+    if _RATE.search(metric):
+        return True
+    return not _LOWER_IS_BETTER.search(metric)
+
+
+def load_lineage(repo: str) -> List[Dict[str, Any]]:
+    """Ordered capture records from BENCH_r*.json: one entry per round
+    with {round, metric, value, on_tpu}; unparseable rounds (rc != 0,
+    empty tail) surface as {parsed: None} entries so the report names
+    them instead of silently shortening the lineage."""
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r[0-9]*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            out.append({"round": path, "parsed": None, "note": f"unreadable: {e}"})
+            continue
+        parsed = rec.get("parsed")
+        entry: Dict[str, Any] = {
+            "round": rec.get("n", os.path.basename(path)),
+            "file": os.path.basename(path),
+            "parsed": parsed,
+        }
+        if parsed:
+            entry["metric"] = parsed.get("metric")
+            entry["value"] = parsed.get("value")
+            entry["on_tpu"] = parsed.get("on_tpu")  # None = missing provenance
+            entry["platform"] = parsed.get("platform")
+        out.append(entry)
+    return out
+
+
+def _provenance(rec: Dict[str, Any]) -> Tuple[Optional[bool], Optional[str]]:
+    """(on_tpu, platform) provenance of a capture, deriving one from
+    the other where only one is stamped.  (None, None) = no provenance
+    at all."""
+    platform = rec.get("platform")
+    platform = str(platform) if platform else None
+    on_tpu = rec.get("on_tpu")
+    if on_tpu is None and platform is not None:
+        on_tpu = platform == "tpu"
+    return on_tpu, platform
+
+
+def _prov_label(rec: Dict[str, Any]) -> str:
+    on_tpu, platform = _provenance(rec)
+    if platform:
+        return platform
+    return "tpu" if on_tpu else "non-tpu(unknown backend)"
+
+
+def _comparable(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    """Like-for-like: on_tpu must match, and when BOTH captures also
+    stamp a platform name those must match too (a gpu capture is not
+    comparable to a cpu one even though both are on_tpu=False).  A
+    legacy on_tpu-only record stays comparable to a platform-stamped
+    one of the same on_tpu value — the coarse evidence doesn't
+    contradict the fine."""
+    a_tpu, a_plat = _provenance(a)
+    b_tpu, b_plat = _provenance(b)
+    if a_tpu is None or b_tpu is None or a_tpu != b_tpu:
+        return False
+    if a_plat and b_plat and a_plat != b_plat:
+        return False
+    return True
+
+
+def check_lineage(
+    lineage: List[Dict[str, Any]], threshold: float = DEFAULT_THRESHOLD
+) -> Dict[str, List[Dict[str, Any]]]:
+    """Compare each capture against the latest EARLIER like-for-like
+    capture of the same metric.  Returns {regressions, skips, ok}."""
+    regressions: List[Dict[str, Any]] = []
+    skips: List[Dict[str, Any]] = []
+    ok: List[Dict[str, Any]] = []
+    # Per-metric history of provenance-stamped captures: each capture
+    # compares against the MOST RECENT earlier one it is comparable
+    # with (a TPU capture after a CPU blip still scores against the
+    # last TPU point, not the blip).
+    history: Dict[str, List[Dict[str, Any]]] = {}
+    for cap in lineage:
+        if not cap.get("parsed"):
+            skips.append(
+                {
+                    "round": cap.get("round"),
+                    "reason": cap.get("note", "no parsed record (bench failed/timed out)"),
+                }
+            )
+            continue
+        metric, value = cap.get("metric"), cap.get("value")
+        if metric is None or value is None:
+            skips.append({"round": cap.get("round"), "reason": "record missing metric/value"})
+            continue
+        # Infra failures emit a parseable record (error key, value 0)
+        # so the lineage stays honest — but they are not perf points
+        # and must never be scored as a like-for-like regression.
+        if cap.get("parsed", {}).get("error") or value <= 0:
+            skips.append(
+                {
+                    "round": cap.get("round"),
+                    "metric": metric,
+                    "reason": (
+                        "BENCH FAILED (error record / non-positive value) — "
+                        "an infra failure, not a perf point"
+                    ),
+                }
+            )
+            continue
+        if _provenance(cap)[0] is None:
+            skips.append(
+                {
+                    "round": cap.get("round"),
+                    "metric": metric,
+                    "reason": (
+                        "NO PLATFORM PROVENANCE (on_tpu/platform missing) — capture "
+                        "cannot be compared; re-run with a provenance-stamped bench"
+                    ),
+                }
+            )
+            continue
+        earlier = history.setdefault(metric, [])
+        prev = next((p for p in reversed(earlier) if _comparable(p, cap)), None)
+        if prev is not None:
+            comparison = _score(metric, prev, cap, threshold)
+            (regressions if comparison["regressed"] else ok).append(comparison)
+        elif earlier:
+            # Loud cross-platform note (the r04/r05 class): lineage
+            # exists for this metric but none of it is like-for-like.
+            other = earlier[-1]
+            skips.append(
+                {
+                    "round": cap.get("round"),
+                    "metric": metric,
+                    "reason": (
+                        f"CROSS-PLATFORM: this capture is {_prov_label(cap)} but "
+                        f"the previous lineage point (round {other.get('round')}) "
+                        f"is {_prov_label(other)} — NOT comparable; a "
+                        f"'{value} vs {other.get('value')}' read would be a "
+                        "platform artifact, not a perf change"
+                    ),
+                }
+            )
+        earlier.append(cap)
+    return {"regressions": regressions, "skips": skips, "ok": ok}
+
+
+def _score(metric: str, prev: Dict[str, Any], cap: Dict[str, Any], threshold: float):
+    pv, cv = float(prev["value"]), float(cap["value"])
+    if _higher_is_better(metric):
+        delta = (cv - pv) / pv if pv else 0.0
+        regressed = pv > 0 and cv < pv * (1.0 - threshold)
+    else:
+        delta = (pv - cv) / pv if pv else 0.0
+        regressed = pv > 0 and cv > pv * (1.0 + threshold)
+    return {
+        "metric": metric,
+        "from_round": prev.get("round"),
+        "to_round": cap.get("round"),
+        "from_value": pv,
+        "to_value": cv,
+        "on_tpu": cap.get("on_tpu"),
+        "delta_pct": round(delta * 100.0, 2),
+        "regressed": regressed,
+    }
+
+
+# ----------------------------------------------------------------------
+# metric-dict comparison (BENCH_micro / BENCH_serve style captures)
+# ----------------------------------------------------------------------
+def compare_metric_dicts(
+    old: Dict[str, Any], new: Dict[str, Any], threshold: float = DEFAULT_THRESHOLD
+) -> Dict[str, List[Dict[str, Any]]]:
+    regressions: List[Dict[str, Any]] = []
+    skips: List[Dict[str, Any]] = []
+    ok: List[Dict[str, Any]] = []
+    for metric, new_rec in sorted(new.items()):
+        if not isinstance(new_rec, dict) or "value" not in new_rec:
+            continue
+        old_rec = old.get(metric)
+        if not isinstance(old_rec, dict) or "value" not in old_rec:
+            skips.append({"metric": metric, "reason": "no prior capture"})
+            continue
+        # Error records and negative values are infra failures, never
+        # perf points.  Zero is NOT failure here: metric-dict captures
+        # include legitimately-zero gauges (kv_blocks_after=0 is the
+        # healthy value) — they score, with _score's pv=0 guard making
+        # a zero baseline unratioable rather than a bogus regression.
+        if any(
+            r.get("error") or not isinstance(r.get("value"), (int, float))
+            or r["value"] < 0
+            for r in (old_rec, new_rec)
+        ):
+            skips.append(
+                {
+                    "metric": metric,
+                    "reason": (
+                        "BENCH FAILED (error record / negative value) — "
+                        "an infra failure, not a perf point"
+                    ),
+                }
+            )
+            continue
+        o_tpu, n_tpu = _provenance(old_rec)[0], _provenance(new_rec)[0]
+        if o_tpu is None or n_tpu is None:
+            skips.append(
+                {
+                    "metric": metric,
+                    "reason": (
+                        "NO PLATFORM PROVENANCE (on_tpu/platform missing on "
+                        f"{'old' if o_tpu is None else 'new'} capture) — "
+                        "cannot be compared"
+                    ),
+                }
+            )
+            continue
+        if not _comparable(old_rec, new_rec):
+            skips.append(
+                {
+                    "metric": metric,
+                    "reason": (
+                        f"CROSS-PLATFORM: {_prov_label(old_rec)} -> "
+                        f"{_prov_label(new_rec)} — not comparable"
+                    ),
+                }
+            )
+            continue
+        prev = {"value": old_rec["value"], "round": "old"}
+        cap = {"value": new_rec["value"], "round": "new", "on_tpu": new_rec.get("on_tpu")}
+        comparison = _score(metric, prev, cap, threshold)
+        (regressions if comparison["regressed"] else ok).append(comparison)
+    return {"regressions": regressions, "skips": skips, "ok": ok}
+
+
+def _report(result: Dict[str, List[Dict[str, Any]]], warn_only: bool) -> int:
+    for s in result["skips"]:
+        print(f"bench_gate SKIP  [{s.get('metric', s.get('round', '?'))}] {s['reason']}")
+    for c in result["ok"]:
+        print(
+            f"bench_gate ok    {c['metric']}: {c['from_value']} -> {c['to_value']} "
+            f"({c['delta_pct']:+.1f}%, on_tpu={c['on_tpu']})"
+        )
+    for c in result["regressions"]:
+        print(
+            f"bench_gate REGRESSION {c['metric']}: {c['from_value']} -> "
+            f"{c['to_value']} ({c['delta_pct']:+.1f}%, on_tpu={c['on_tpu']}, "
+            f"rounds {c['from_round']} -> {c['to_round']})"
+        )
+    n_reg = len(result["regressions"])
+    if n_reg:
+        verdict = "WARN" if warn_only else "FAIL"
+        print(f"bench_gate {verdict}: {n_reg} like-for-like regression(s) > threshold")
+        return 0 if warn_only else 1
+    print(
+        f"bench_gate PASS: {len(result['ok'])} like-for-like comparison(s), "
+        f"{len(result['skips'])} skip(s)"
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    ap.add_argument("--warn-only", action="store_true",
+                    help="report regressions but exit 0 (verify.sh mode)")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"),
+                    help="compare two metric-dict capture files instead of the lineage")
+    args = ap.parse_args(argv)
+    if args.compare:
+        with open(args.compare[0]) as f:
+            old = json.load(f)
+        with open(args.compare[1]) as f:
+            new = json.load(f)
+        result = compare_metric_dicts(old, new, args.threshold)
+    else:
+        lineage = load_lineage(args.repo)
+        if not lineage:
+            print("bench_gate PASS: no BENCH_r*.json lineage found")
+            return 0
+        result = check_lineage(lineage, args.threshold)
+    return _report(result, args.warn_only)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

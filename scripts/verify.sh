@@ -70,6 +70,30 @@ if [ "${RAY_TPU_SKIP_SERVE_LLM_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Profiling smoke (bottleneck-attribution plane end-to-end): actor under
+# load, attach the sampling profiler, assert a non-empty merged
+# flamegraph with the workload visible and valid speedscope output.
+# Skippable via RAY_TPU_SKIP_PROFILING_SMOKE=1.
+if [ "${RAY_TPU_SKIP_PROFILING_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      python scripts/profiling_smoke.py; then
+    echo "profiling smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
+# Bench trajectory gate (warn-only): report like-for-like perf
+# regressions across the checked-in BENCH lineage; cross-platform
+# captures (on_tpu mismatch) are skipped loudly, never scored.  Warn
+# mode: a human promotes warnings to blocks — perf boxes vary.
+# Skippable via RAY_TPU_SKIP_BENCH_GATE=1.
+if [ "${RAY_TPU_SKIP_BENCH_GATE:-0}" != "1" ]; then
+  if ! timeout -k 5 30 python scripts/bench_gate.py --warn-only; then
+    echo "bench gate step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Elastic smoke (resize-on-preemption end-to-end): 2-node local cluster,
 # elastic JaxTrainer (min_workers=1), preempt one rank's node mid-run,
 # assert shrink -> resume -> completion with zero failure charges and

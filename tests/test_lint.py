@@ -307,6 +307,81 @@ def test_blocking_handler_flags_pubsub_callback(tmp_path):
     assert len(v) == 1 and v[0].symbol == "Watcher._on_push"
 
 
+def test_blocking_handler_cross_module_helper_module(tmp_path):
+    """The PR 5 follow-up: a blocking call reached THROUGH a helper
+    module (`from pkg import helper; helper.settle()`) must be caught —
+    module-local analysis used to stop at the import boundary."""
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (tmp_path / "pkg" / "helper.py").write_text(textwrap.dedent("""
+        import time
+
+        def settle():
+            time.sleep(0.2)
+    """))
+    (tmp_path / "pkg" / "server.py").write_text(textwrap.dedent("""
+        from pkg import helper
+
+        class Server:
+            async def rpc_get_thing(self, req):
+                helper.settle()
+                return {}
+    """))
+    result = core.run_lint([str(tmp_path)], root=str(tmp_path),
+                           select=["blocking-in-handler"])
+    v = [x for x in result.violations if x.check == "blocking-in-handler"]
+    assert len(v) == 1
+    assert v[0].path == "pkg/helper.py" and v[0].symbol == "settle"
+    assert "rpc_get_thing" in v[0].tag
+
+
+def test_blocking_handler_cross_module_symbol_import(tmp_path):
+    """`from pkg.helper import settle` direct-symbol imports resolve
+    too, including constructor calls (`Class()` -> `Class.__init__`)."""
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (tmp_path / "pkg" / "helper.py").write_text(textwrap.dedent("""
+        import time
+
+        class SyncClient:
+            def __init__(self):
+                time.sleep(1.0)
+    """))
+    (tmp_path / "pkg" / "server.py").write_text(textwrap.dedent("""
+        from pkg.helper import SyncClient
+
+        class Server:
+            async def rpc_connect(self, req):
+                return SyncClient()
+    """))
+    result = core.run_lint([str(tmp_path)], root=str(tmp_path),
+                           select=["blocking-in-handler"])
+    v = [x for x in result.violations if x.check == "blocking-in-handler"]
+    assert len(v) == 1
+    assert v[0].symbol == "SyncClient.__init__"
+    assert "rpc_connect" in v[0].tag
+
+
+def test_blocking_handler_cross_module_clean_helper_passes(tmp_path):
+    """A helper module with no blocking calls adds no findings."""
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (tmp_path / "pkg" / "helper.py").write_text(textwrap.dedent("""
+        def settle():
+            return 1 + 1
+    """))
+    (tmp_path / "pkg" / "server.py").write_text(textwrap.dedent("""
+        from pkg import helper
+
+        class Server:
+            async def rpc_get_thing(self, req):
+                return helper.settle()
+    """))
+    result = core.run_lint([str(tmp_path)], root=str(tmp_path),
+                           select=["blocking-in-handler"])
+    assert [x for x in result.violations if x.check == "blocking-in-handler"] == []
+
+
 def test_blocking_handler_exempts_thread_target_closure(tmp_path):
     # The checker's own advice: defer blocking work to a worker thread.
     # The closure's sleep runs on that thread, not the dispatch loop.
