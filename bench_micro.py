@@ -201,6 +201,115 @@ def bench_get_small(n=1000) -> float:
     return n / timeit(run)
 
 
+@ray_tpu.remote
+class EchoActor:
+    def echo(self, x):
+        return x
+
+
+def _compile_echo(max_inflight=64, **actor_opts):
+    from ray_tpu.dag import InputNode
+
+    cls = EchoActor.options(**actor_opts) if actor_opts else EchoActor
+    with InputNode() as inp:
+        dag = cls.bind().echo.bind(inp)
+    compiled = dag.experimental_compile(max_inflight=max_inflight)
+    ray_tpu.get(compiled.execute(0))  # warm: loops resident, channels open
+    return compiled
+
+
+_compiled_lat: list = []  # p50/p99 share one capture with the sync rate
+
+
+def bench_compiled_actor_sync(n=2000) -> float:
+    """Compiled-DAG sync round-trip rate (the like-for-like comparator
+    of actor_calls_per_s_1_1_sync: same 1:1 echo, zero-copy dataplane
+    instead of the per-call RPC stack).  Also captures per-call latency
+    for the p50/p99 entries."""
+    compiled = _compile_echo()
+
+    def run():
+        _compiled_lat.clear()
+        start = time.perf_counter()
+        for i in range(n):
+            t1 = time.perf_counter()
+            ray_tpu.get(compiled.execute(i))
+            _compiled_lat.append(time.perf_counter() - t1)
+        return time.perf_counter() - start
+
+    out = n / timeit(run)
+    compiled.teardown()
+    _compiled_lat.sort()
+    return out
+
+
+def bench_compiled_roundtrip_p50_ms() -> float:
+    """p50 of the sync capture above (ordering: runs after it)."""
+    return _compiled_lat[len(_compiled_lat) // 2] * 1e3 if _compiled_lat else -1.0
+
+
+def bench_compiled_roundtrip_p99_ms() -> float:
+    return (
+        _compiled_lat[int(len(_compiled_lat) * 0.99)] * 1e3 if _compiled_lat else -1.0
+    )
+
+
+def bench_compiled_actor_pipelined(n=4000, depth=32) -> float:
+    """Compiled executions submitted depth-deep before each get: the
+    multi-slot ring carries many in-flight messages per edge, so driver
+    serialization overlaps actor compute."""
+    compiled = _compile_echo(max_inflight=depth * 2)
+
+    def run():
+        start = time.perf_counter()
+        refs = []
+        for i in range(n):
+            refs.append(compiled.execute(i))
+            if len(refs) >= depth:
+                ray_tpu.get(refs.pop(0))
+        for r in refs:
+            ray_tpu.get(r)
+        return time.perf_counter() - start
+
+    out = n / timeit(run)
+    compiled.teardown()
+    return out
+
+
+def bench_compiled_socket_roundtrip(n=1000) -> dict:
+    """Cross-host (separate-raylet) compiled edge: the same echo DAG
+    with the actor pinned to a second node, so every hop rides a
+    persistent socket channel.  Runs on its OWN 2-node cluster AFTER the
+    main single-node benches (returns {calls/s, p50_ms})."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"edge": 2})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    try:
+        compiled = _compile_echo(resources={"edge": 0.1})
+        assert any(
+            d["kind"] == "socket" for d in compiled._descs.values()
+        ), "socket edge not selected"
+        lat = []
+        start = time.perf_counter()
+        for i in range(n):
+            t1 = time.perf_counter()
+            ray_tpu.get(compiled.execute(i))
+            lat.append(time.perf_counter() - t1)
+        elapsed = time.perf_counter() - start
+        compiled.teardown()
+        lat.sort()
+        return {
+            "compiled_socket_calls_per_s": n / elapsed,
+            "compiled_socket_roundtrip_p50_ms": lat[len(lat) // 2] * 1e3,
+        }
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def bench_wait_1k() -> float:
     refs = [nullary.remote() for _ in range(1000)]
     ray_tpu.get(refs)  # all complete
@@ -225,6 +334,12 @@ BENCHES = [
     ("put_small_per_s", bench_put_small, "puts/s", None),
     ("get_small_per_s", bench_get_small, "gets/s", None),
     ("wait_1k_refs_per_s", bench_wait_1k, "waits/s", None),
+    # Compiled-DAG fast path (zero-copy dataplane; ROADMAP item 1's
+    # >=10x-vs-uncompiled target is stamped as vs_uncompiled below).
+    ("compiled_actor_calls_per_s_1_1_sync", bench_compiled_actor_sync, "calls/s", None),
+    ("compiled_local_roundtrip_p50_ms", bench_compiled_roundtrip_p50_ms, "ms", None),
+    ("compiled_local_roundtrip_p99_ms", bench_compiled_roundtrip_p99_ms, "ms", None),
+    ("compiled_actor_calls_per_s_pipelined", bench_compiled_actor_pipelined, "calls/s", None),
 ]
 
 
@@ -263,6 +378,35 @@ def main():
         results[name] = rec
         print(json.dumps(rec), flush=True)
     ray_tpu.shutdown()
+
+    # like-for-like speedup of the compiled dataplane vs the per-call
+    # RPC stack, measured in THIS run on THIS box (acceptance: >=10x)
+    sync = results.get("actor_calls_per_s_1_1_sync")
+    for compiled_name in (
+        "compiled_actor_calls_per_s_1_1_sync",
+        "compiled_actor_calls_per_s_pipelined",
+    ):
+        comp = results.get(compiled_name)
+        if comp and sync and sync["value"]:
+            comp["vs_uncompiled"] = round(comp["value"] / sync["value"], 2)
+            print(json.dumps(comp), flush=True)
+
+    # cross-host socket edge: its own 2-node cluster, after the main one
+    if not args.only or "socket" in args.only:
+        from bench_common import provenance
+
+        with open("/proc/loadavg") as f:
+            load1m = float(f.read().split()[0])
+        for name, value in bench_compiled_socket_roundtrip().items():
+            rec = {
+                "metric": name,
+                "value": round(value, 3),
+                "unit": "ms" if name.endswith("_ms") else "calls/s",
+                **provenance(),
+                "loadavg_1m_at_capture": load1m,
+            }
+            results[name] = rec
+            print(json.dumps(rec), flush=True)
 
     # merge-preserve keys this run didn't produce (stress_* entries come
     # from tests/test_stress.py runs)

@@ -227,6 +227,81 @@ def phase_stream_drill(out, n_streams=1024, max_tokens=12, width=32):
 
 
 # ----------------------------------------------------------------------
+# phase 2b: router→replica channel dataplane A/B (ROADMAP item 1 wiring:
+# per-token stream items through the object store were the bottleneck —
+# route token streaming over compiled-DAG channels, record before/after)
+# ----------------------------------------------------------------------
+def _run_stream_batch(handle, n_streams, max_tokens):
+    stream_handle = handle.options(stream=True)
+    t_start = time.time()
+    streams = []
+    for i in range(n_streams):
+        gen = stream_handle.generate.remote(
+            {"prompt": [1, 2, i % 11], "max_tokens": max_tokens}
+        )
+        streams.append({"gen": gen, "tokens": 0, "done": False})
+    open_set = list(streams)
+    deadline = time.time() + 300
+    while open_set and time.time() < deadline:
+        for s in list(open_set):
+            try:
+                ev = s["gen"].try_next()
+            except StopIteration:
+                s["done"] = True
+                open_set.remove(s)
+                continue
+            except Exception:  # noqa: BLE001
+                open_set.remove(s)
+                continue
+            if ev is not None and isinstance(ev, dict) and "token" in ev:
+                s["tokens"] += 1
+    wall = time.time() - t_start
+    assert all(s["done"] for s in streams), "streams failed in A/B phase"
+    return sum(s["tokens"] for s in streams), wall
+
+
+def phase_dataplane_ab(out, n_streams=192, max_tokens=16, width=16):
+    """The same token-stream workload over both transports: per-token
+    object-store items (RPC path, dataplane off) vs multiplexed channel
+    frames (dataplane on).  Fresh app per arm so neither inherits the
+    other's attach state."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.serve._private.router import _routers
+
+    results = {}
+    for arm, enabled in (("rpc", False), ("dataplane", True)):
+        CONFIG._overrides["serve_channel_dataplane"] = enabled
+        app = llm.build_app(
+            llm.LLMConfig(model="tiny", max_batch_size=width, num_blocks=512,
+                          block_size=8, max_queue=n_streams + 64,
+                          name=f"bench_ab_{arm}"),
+            max_ongoing_requests=2 * n_streams,
+        )
+        handle = serve.run(app, name=f"bench_ab_{arm}_app")
+        handle.remote({"prompt": [1], "max_tokens": 4}).result(timeout=120)
+        tokens, wall = _run_stream_batch(handle, n_streams, max_tokens)
+        if enabled:
+            router = _routers.get(handle.deployment_name)
+            engaged = bool(
+                router
+                and any(
+                    getattr(v, "replica_id", None) is not None
+                    for v in router._dataplanes.values()
+                )
+            )
+            assert engaged, "dataplane arm did not attach channel clients"
+        results[arm] = tokens / wall
+        record(out, f"serve_stream_tokens_per_s_{arm}", tokens / wall,
+               "tokens/s", streams=n_streams, max_tokens=max_tokens)
+        serve.delete(f"bench_ab_{arm}")
+    CONFIG._overrides["serve_channel_dataplane"] = True
+    record(out, "serve_stream_dataplane_speedup",
+           results["dataplane"] / results["rpc"], "x",
+           acceptance="token streaming over compiled channels vs object-store hops")
+    return results
+
+
+# ----------------------------------------------------------------------
 # phase 3: shed rate far past the bound
 # ----------------------------------------------------------------------
 def phase_shed(out, n_requests=256, max_queue=48):
@@ -380,6 +455,7 @@ def main():
     try:
         cont, static = phase_throughput(out)
         phase_stream_drill(out, n_streams=args.streams)
+        phase_dataplane_ab(out)
         phase_shed(out)
         if not args.skip_chaos:
             phase_chaos(out)

@@ -295,6 +295,11 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
 
     if isinstance(refs, CompiledDAGRef):
         return refs.get(timeout)  # None = wait forever, like ObjectRefs
+    channel_get = getattr(refs, "__channel_get__", None)
+    if channel_get is not None:
+        # Dataplane futures (e.g. serve's ChannelFuture) resolve like
+        # refs so await paths need no transport awareness.
+        return channel_get(timeout)
     if not isinstance(refs, (list, tuple)):
         raise TypeError(f"ray_tpu.get takes an ObjectRef or a list of them, got {type(refs)}")
     for r in refs:

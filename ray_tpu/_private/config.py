@@ -168,6 +168,20 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # Run lowered.cost_analysis() at a function's FIRST trace (one extra
     # trace per instrumented function, never on the steady-state path).
     "jax_cost_analysis": True,
+    # --- compiled-DAG dataplane (dag/ + experimental/channel.py) ---
+    # Unacked-message window per cross-host socket channel: the socket
+    # analog of the ring's free-space bound, sized to hide the network
+    # RTT (flow control counts CONSUMED messages, so reader-side
+    # buffering stays bounded at ~window frames).
+    "socket_channel_window": 8,
+    # How long a compiled edge's writer retries dialing its reader's
+    # listener at loop start before the typed ChannelConnectionError.
+    "dag_socket_connect_timeout_s": 15.0,
+    # Route serve router→replica calls and token streams over compiled
+    # per-replica channels instead of per-call actor RPC / per-token
+    # object-store items.  Any attach failure falls back to the RPC path
+    # per replica; off = always the RPC path.
+    "serve_channel_dataplane": True,
     # --- drain / preemption (reference: gcs DrainNode + autoscaler drain
     # API; RLAX-style planned-interruption handling) ---
     # Fallback drain notice window when a drain_node call carries none.

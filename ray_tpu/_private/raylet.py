@@ -165,6 +165,8 @@ class Raylet:
         self.queue: deque[TaskSpec] = deque()
         self.infeasible: List[TaskSpec] = []
         self._dispatch_scheduled = False
+        # Monotonic stamp backing the dispatch queue's per-tenant FIFO.
+        self._dispatch_seq = 0
 
         # Cluster view (node_id bytes -> {"raylet_address", "available"})
         self.cluster_view: Dict[bytes, dict] = {}
@@ -1155,7 +1157,38 @@ class Raylet:
         a stale stamp would fold execution + retry delay into the
         task_phase_seconds{phase=queue} signal."""
         spec.queued_at = time.monotonic()
+        # FIFO stamp for tenant-fair dispatch ordering; survives requeues
+        # (a retried task keeps its place within its tenant's FIFO).
+        if getattr(spec, "dispatch_seq", None) is None:
+            self._dispatch_seq += 1
+            spec.dispatch_seq = self._dispatch_seq
         self.queue.append(spec)
+
+    def _spec_tenant_priority(self, spec: TaskSpec) -> Tuple[str, int]:
+        cfg = self.job_configs.get(spec.job_id) or {}
+        try:
+            priority = int(cfg.get("priority") or 0)
+        except (TypeError, ValueError):
+            priority = 0
+        return tenants_mod.normalize_tenant(cfg.get("tenant")), priority
+
+    def _fair_queue_order(self, queue) -> deque:
+        """Tenant-aware ordering for the raylet-mediated dispatch queue:
+        the same (priority, FIFO)-per-tenant rule the lease queue
+        already applies, tenants served ascending dominant share
+        (carried PR 6 follow-up — previously plain FIFO, so one
+        tenant's task burst delayed every other tenant's queued work)."""
+        entries = [
+            (*self._spec_tenant_priority(spec), spec.dispatch_seq, spec)
+            for spec in queue
+        ]
+        usage = self._effective_tenant_usage()
+        totals = self.cluster_resource_totals or self._cluster_totals_view()
+        return deque(
+            tenants_mod.fair_dispatch_order(
+                entries, usage, totals, self.tenant_specs
+            )
+        )
 
     def _cluster_decision(self, spec: TaskSpec) -> Optional[str]:
         """Return a peer raylet address to spill to, or None to keep local.
@@ -1261,6 +1294,10 @@ class Raylet:
             return
         self._grant_lease_waiters()
         remaining = deque()
+        if len(self.queue) > 1 and len(self.job_configs) > 1:
+            # Multiple jobs queued: apply tenant-fair ordering (a single
+            # job's queue is already (priority, FIFO) by construction).
+            self.queue = self._fair_queue_order(self.queue)
         while self.queue:
             spec = self.queue.popleft()
             if not self._locally_feasible(spec):

@@ -290,6 +290,25 @@ class _Metrics:
             "compiled-DAG executions in flight (submitted, result not yet "
             "read) — channel-plane occupancy as seen by the driver",
         )
+        self.socket_connects = m.Counter(
+            "socket_channel_connects_total",
+            "cross-host socket-channel dial outcomes (result = ok, "
+            "refused); refused after the retry budget means a consumed "
+            "or dead listener — the compiled edge must be rebuilt",
+            tag_keys=("result",),
+        )
+        self.serve_dataplane_requests = m.Counter(
+            "serve_dataplane_requests_total",
+            "serve router→replica requests carried over compiled channels "
+            "instead of per-call actor RPC (kind = call, stream); compare "
+            "with serve_queue_depth-era RPC volume for adoption",
+            tag_keys=("kind",),
+        )
+        self.serve_dataplane_items = m.Counter(
+            "serve_dataplane_stream_items_total",
+            "stream items (e.g. generated tokens) returned over serve "
+            "compiled channels — each one replaces an object-store hop",
+        )
 
 
 def _metrics() -> _Metrics:
@@ -559,6 +578,8 @@ _chan_ops_bound: dict = {}
 _chan_blocked_bound: dict = {}
 _chan_timeout_bound: dict = {}
 _dag_op_bound: dict = {}
+_socket_connect_bound: dict = {}
+_serve_dataplane_bound: dict = {}
 
 
 def count_profile_session(state: str) -> None:
@@ -635,6 +656,31 @@ def count_channel_timeout(op: str, n: int = 1) -> None:
         _chan_timeout_bound, op, "channel_timeouts", {"op": op}
     )
     b.inc(float(n))
+
+
+def count_socket_connect(result: str) -> None:
+    if not enabled():
+        return
+    b = _socket_connect_bound.get(result) or _bind(
+        _socket_connect_bound, result, "socket_connects", {"result": result}
+    )
+    b.inc(1.0)
+
+
+def count_serve_dataplane_request(kind: str) -> None:
+    if not enabled():
+        return
+    b = _serve_dataplane_bound.get(kind) or _bind(
+        _serve_dataplane_bound, kind, "serve_dataplane_requests", {"kind": kind}
+    )
+    b.inc(1.0)
+
+
+def count_serve_dataplane_items(n: int) -> None:
+    """Batched (the router's rx thread accumulates locally)."""
+    if not enabled() or n <= 0:
+        return
+    _metrics().serve_dataplane_items.inc(float(n))
 
 
 def observe_dag_op(method: str, seconds: float) -> None:

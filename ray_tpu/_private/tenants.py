@@ -193,6 +193,50 @@ def pick_next(
     return None
 
 
+def fair_dispatch_order(
+    entries: List[Tuple[str, int, int, object]],
+    usage: Dict[str, Dict[str, float]],
+    totals: Dict[str, float],
+    tenants: Dict[str, TenantSpec],
+) -> List[object]:
+    """Tenant-fair ordering for a raylet's mediated dispatch queue —
+    the same rule the lease queue applies per grant, adapted to a whole
+    queue pass: within a tenant strictly (priority desc, FIFO), so no
+    intra-tenant queue-jumping; across tenants round-robin in ascending
+    weighted dominant share, so the low-share tenant's head runs first
+    but a burst from one tenant can't monopolize an entire pass (the
+    lease path re-evaluates share per grant; the round-robin is that
+    re-evaluation's queue-pass approximation).
+
+    ``entries`` are ``(tenant, priority, seq, item)``; returns items.
+    """
+    by_tenant: Dict[str, List[Tuple[int, int, object]]] = {}
+    for tenant, priority, seq, item in entries:
+        by_tenant.setdefault(tenant, []).append((-priority, seq, item))
+    for lst in by_tenant.values():
+        lst.sort(key=lambda t: (t[0], t[1]))
+
+    def tenant_key(tenant: str):
+        spec = tenants.get(tenant)
+        weight = spec.weight if spec else 1.0
+        head = by_tenant[tenant][0]
+        return (dominant_share(usage.get(tenant), totals, weight), head[0], head[1])
+
+    order = sorted(by_tenant, key=tenant_key)
+    out: List[object] = []
+    depth = 0
+    while True:
+        emitted = False
+        for tenant in order:
+            lst = by_tenant[tenant]
+            if depth < len(lst):
+                out.append(lst[depth][2])
+                emitted = True
+        if not emitted:
+            return out
+        depth += 1
+
+
 def preemption_victim_order(
     jobs: List[dict],
     usage: Dict[str, Dict[str, float]],

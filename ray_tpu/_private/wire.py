@@ -1,0 +1,282 @@
+"""Binary fast-path wire format for compiled-DAG channels.
+
+The compiled dataplane's hot loop moves *small* values (ints, strs,
+small tuples/dicts, numpy arrays) between resident op loops thousands
+of times per second.  Pickling each one costs ~10 us and an intermediate
+bytes object per hop; this module replaces that with a fixed two-byte
+header and raw little-endian encodings written **directly into the
+destination mapping** (the seqlock ring or a socket scratch buffer) —
+zero pickling and zero intermediate copies on the fast path.  Anything
+the fast path can't express falls back to the existing pickle-5
+serialization layer, embedded verbatim after the header.
+
+Layout: ``[u8 tag][u8 type_code][payload]``.  Container elements recurse
+as ``[u8 type_code][payload]`` (no tag byte).  The ``tag`` is the same
+namespace as ``serialization.TAG_*`` (NORMAL / ERROR), so errors flow
+through channels exactly like results.
+
+Capacity errors surface as the encoder's ``struct.error``/``ValueError``
+/``IndexError`` (writes past the destination view fail — which of the
+three depends on whether a struct field, a slice, or a single type-code
+byte hit the boundary); channel callers catch all three and translate
+into their typed capacity error.
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+from typing import Any, Tuple
+
+from ray_tpu._private import serialization
+
+# Type codes (second byte of every encoded value).
+NONE = 0
+TRUE = 1
+FALSE = 2
+I64 = 3
+BIGINT = 4
+F64 = 5
+BYTES = 6
+STR = 7
+TUPLE = 8
+LIST = 9
+DICT = 10
+NDARRAY = 11
+PICKLE = 12
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+
+# Fast-path bounds: bigger containers fall back to pickle (one blob beats
+# thousands of per-element dispatches there anyway).
+MAX_ELEMS = 64
+MAX_DICT = 1024
+MAX_DEPTH = 4
+
+
+class _Unencodable(Exception):
+    """Internal signal: this value needs the pickle fallback."""
+
+
+def _enc(dest: memoryview, off: int, v: Any, depth: int) -> int:
+    t = type(v)
+    if v is None:
+        dest[off] = NONE
+        return off + 1
+    if v is True:
+        dest[off] = TRUE
+        return off + 1
+    if v is False:
+        dest[off] = FALSE
+        return off + 1
+    if t is int:
+        if _I64_MIN <= v <= _I64_MAX:
+            dest[off] = I64
+            _I64.pack_into(dest, off + 1, v)
+            return off + 9
+        n = (v.bit_length() + 8) // 8
+        raw = v.to_bytes(n, "little", signed=True)
+        dest[off] = BIGINT
+        _U32.pack_into(dest, off + 1, n)
+        dest[off + 5 : off + 5 + n] = raw
+        return off + 5 + n
+    if t is float:
+        dest[off] = F64
+        _F64.pack_into(dest, off + 1, v)
+        return off + 9
+    if t is bytes:
+        dest[off] = BYTES
+        _U32.pack_into(dest, off + 1, len(v))
+        end = off + 5 + len(v)
+        dest[off + 5 : end] = v
+        return end
+    if t is str:
+        raw = v.encode("utf-8")
+        dest[off] = STR
+        _U32.pack_into(dest, off + 1, len(raw))
+        end = off + 5 + len(raw)
+        dest[off + 5 : end] = raw
+        return end
+    if t is tuple or t is list:
+        if len(v) > MAX_ELEMS or depth >= MAX_DEPTH:
+            raise _Unencodable
+        dest[off] = TUPLE if t is tuple else LIST
+        dest[off + 1] = len(v)
+        off += 2
+        for item in v:
+            off = _enc(dest, off, item, depth + 1)
+        return off
+    if t is dict:
+        if len(v) > MAX_DICT or depth >= MAX_DEPTH:
+            raise _Unencodable
+        dest[off] = DICT
+        _U32.pack_into(dest, off + 1, len(v))
+        off += 5
+        for k, item in v.items():
+            off = _enc(dest, off, k, depth + 1)
+            off = _enc(dest, off, item, depth + 1)
+        return off
+    np = sys.modules.get("numpy")
+    if np is not None and t is np.ndarray:
+        return _enc_array(dest, off, v, np)
+    jax = sys.modules.get("jax")
+    jax_array = getattr(jax, "Array", None) if jax is not None else None
+    if jax_array is not None and isinstance(v, jax_array):
+        import numpy as _np
+
+        return _enc_array(dest, off, _np.asarray(v), _np)
+    raise _Unencodable
+
+
+def _enc_array(dest: memoryview, off: int, arr, np) -> int:
+    dt = arr.dtype
+    if dt.hasobject or arr.ndim > 16:
+        raise _Unencodable
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    ds = dt.str.encode("ascii")
+    dest[off] = NDARRAY
+    dest[off + 1] = len(ds)
+    off += 2
+    dest[off : off + len(ds)] = ds
+    off += len(ds)
+    dest[off] = arr.ndim
+    off += 1
+    for dim in arr.shape:
+        _U64.pack_into(dest, off, dim)
+        off += 8
+    nb = arr.nbytes
+    _U64.pack_into(dest, off, nb)
+    off += 8
+    if arr.ndim == 0 or 0 in arr.shape:
+        # 0-d / zero-size views can't cast; both are tiny — copy is free
+        dest[off : off + nb] = arr.tobytes()
+    else:
+        dest[off : off + nb] = memoryview(arr).cast("B")
+    return off + nb
+
+
+def encode_into(dest: memoryview, value: Any, tag: int = 0) -> int:
+    """Encode ``value`` directly into ``dest``; returns bytes written.
+
+    Raises ``struct.error``/``ValueError``/``IndexError`` when the
+    destination is too small (channel callers catch all three and
+    translate to their typed capacity error).
+    """
+    dest[0] = tag
+    try:
+        return _enc(dest, 1, value, 0)
+    except _Unencodable:
+        meta, buffers = serialization.serialize(value, tag)
+        need = 2 + serialization.total_size(meta, buffers)
+        if need > len(dest):
+            raise ValueError(
+                f"serialized value of {need} bytes exceeds buffer of {len(dest)}"
+            )
+        dest[1] = PICKLE
+        serialization.write_into(dest[2:], meta, buffers)
+        return need
+
+
+def encode(value: Any, tag: int = 0) -> bytes:
+    """Encode to a fresh bytes object (socket frames, tests)."""
+    size = 256
+    np = sys.modules.get("numpy")
+    if np is not None and isinstance(value, np.ndarray):
+        size += value.nbytes + 64 + 16 * 8
+    while True:
+        buf = bytearray(size)
+        try:
+            n = encode_into(memoryview(buf), value, tag)
+            return bytes(buf[:n])
+        except (struct.error, ValueError, IndexError):
+            size *= 4
+            if size > 1 << 34:
+                raise
+
+
+def _dec(view: memoryview, off: int, copy_arrays: bool) -> Tuple[Any, int]:
+    code = view[off]
+    off += 1
+    if code == NONE:
+        return None, off
+    if code == TRUE:
+        return True, off
+    if code == FALSE:
+        return False, off
+    if code == I64:
+        return _I64.unpack_from(view, off)[0], off + 8
+    if code == BIGINT:
+        (n,) = _U32.unpack_from(view, off)
+        off += 4
+        return int.from_bytes(view[off : off + n], "little", signed=True), off + n
+    if code == F64:
+        return _F64.unpack_from(view, off)[0], off + 8
+    if code == BYTES:
+        (n,) = _U32.unpack_from(view, off)
+        off += 4
+        return bytes(view[off : off + n]), off + n
+    if code == STR:
+        (n,) = _U32.unpack_from(view, off)
+        off += 4
+        return str(view[off : off + n], "utf-8"), off + n
+    if code == TUPLE or code == LIST:
+        n = view[off]
+        off += 1
+        items = []
+        for _ in range(n):
+            item, off = _dec(view, off, copy_arrays)
+            items.append(item)
+        return (tuple(items) if code == TUPLE else items), off
+    if code == DICT:
+        (n,) = _U32.unpack_from(view, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = _dec(view, off, copy_arrays)
+            v, off = _dec(view, off, copy_arrays)
+            d[k] = v
+        return d, off
+    if code == NDARRAY:
+        import numpy as np
+
+        ds_len = view[off]
+        off += 1
+        dt = np.dtype(str(view[off : off + ds_len], "ascii"))
+        off += ds_len
+        ndim = view[off]
+        off += 1
+        shape = []
+        for _ in range(ndim):
+            shape.append(_U64.unpack_from(view, off)[0])
+            off += 8
+        (nb,) = _U64.unpack_from(view, off)
+        off += 8
+        arr = np.frombuffer(view[off : off + nb], dtype=dt).reshape(shape)
+        if copy_arrays:
+            arr = arr.copy()
+        return arr, off + nb
+    raise ValueError(f"unknown wire type code {code}")
+
+
+def decode(view: memoryview, copy_arrays: bool = True) -> Tuple[int, Any]:
+    """Decode one value; returns ``(tag, value)``.
+
+    ``copy_arrays=True`` materializes array payloads (required when
+    ``view`` is a reusable ring that the writer will overwrite after the
+    ack); ``False`` lets arrays alias ``view`` (safe for one-shot socket
+    frames the receiver owns).
+    """
+    view = view.cast("B") if view.format != "B" else view
+    tag = view[0]
+    if view[1] == PICKLE:
+        inner_tag, value = serialization.deserialize(view[2:])
+        return tag, value
+    value, _ = _dec(view, 1, copy_arrays)
+    return tag, value

@@ -4,16 +4,23 @@ dag/compiled_dag_node.py:694).
 
 `fn.bind(x)` builds nodes instead of launching tasks; `node.execute(v)`
 materializes one run.  `experimental_compile()` freezes the graph into a
-static per-actor schedule: actors are instantiated once and, for
-all-actor-method graphs, execution switches to mutable shared-memory
-channels written in place per call with resident per-actor op loops —
-no task submission or object-store traffic on the steady-state path
-(reference: compiled_dag_node.py:1639 schedules +
-experimental_mutable_object_manager.h:48 channels).  Graphs with
-driver-side FunctionNodes keep the per-node task path."""
+static per-actor schedule: actors are instantiated once and execution
+switches to mutable channels written in place per call with resident
+per-actor op loops — no task submission, no object store, no RPC on the
+steady-state path (reference: compiled_dag_node.py:1639 schedules +
+experimental_mutable_object_manager.h:48 channels).  Channel transport
+is selected per edge at compile time by placement: same-node edges ride
+mmap'd seqlock rings, cross-node edges one persistent socket each, so
+the same compiled graph spans hosts.  Driver-side FunctionNodes are
+compiled into resident executor actors too; only graphs using features
+the op schedule can't express (kwargs, exotic arg nodes) keep the
+per-node task path.  Values move in the binary wire format
+(_private/wire.py): zero pickling and zero intermediate copies for
+small args/results."""
 
 from __future__ import annotations
 
+import os
 import threading
 import uuid
 from typing import Any, Dict, List, Optional
@@ -184,24 +191,96 @@ class MultiOutputNode(DAGNode):
         return [cache[n._stable_uuid] for n in self._bound_args]
 
 
-def _actor_channel_loop(self, ops, chan_paths):
+class _FnExecutor:
+    """Resident executor hosting a compiled driver-side FunctionNode
+    (reference: compiled graphs pin every computation to a long-lived
+    worker).  One per FunctionNode (num_cpus=0) so independent function
+    branches overlap instead of serializing through one process; the op
+    loop calls ``self._dag_fns[op["fn"]]``."""
+
+    def __init__(self, fn_blob: bytes):
+        from ray_tpu._private import serialization
+
+        self._dag_fns = [serialization.loads_function(fn_blob)]
+
+
+def _ring_dir(token: str) -> str:
+    """Per-DAG ring directory, same path on every node of the cluster
+    (tmpfs when available).  Channel ids are unique across the DAG, so
+    two nodes of one machine sharing /dev/shm can't collide."""
+    from ray_tpu.experimental.channel import ring_base_dir
+
+    return os.path.join(ring_base_dir(), f"ray_tpu_dag_{token}")
+
+
+def _dag_probe(self):
+    """Runs inside a compiled actor: placement probe for compile-time
+    channel-transport selection."""
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    return w.node_id.hex() if w.node_id is not None else ""
+
+
+def _dag_setup(self, token, ring_creates, socket_binds, buffer_size):
+    """Runs inside a compiled actor, BEFORE any loop starts: the reader
+    side of every edge creates its ring files / binds its socket
+    listeners, so writers (which dial / open at loop start) never race a
+    missing endpoint.  Returns {channel_id: bound port}."""
+    from ray_tpu.experimental import channel as channel_mod
+
+    d = _ring_dir(token)
+    if ring_creates:
+        os.makedirs(d, exist_ok=True)
+    for cid in ring_creates:
+        channel_mod.Channel.create_file(os.path.join(d, cid), buffer_size)
+    return {cid: channel_mod.bind_listener(token, cid) for cid in socket_binds}
+
+
+def _actor_channel_loop(self, ops, descs, token):
     """Runs INSIDE a compiled DAG's actor (via __ray_call__): a frozen
     per-actor op schedule reading args from in-channels and local
     results, writing cross-process results to out-channels (reference:
     compiled_dag_node.py:1639 per-actor op schedules executing over
     preallocated channels).
 
+    Graph-level scheduling: writers DIAL all their socket edges first
+    (listeners are pre-bound in the setup phase, so dials never block on
+    a peer's accept), and multi-out results fan out with round-robin
+    try-writes so one slow consumer never head-of-line-blocks an
+    independent branch.
+
     Application errors do NOT kill the loop: the error is serialized and
     flows through the op's out-channels like a result (downstream ops
     see it, skip execution, and propagate), so the driver's get raises
     the original exception and the DAG stays usable."""
+    import shutil
     import time as _time
 
     from ray_tpu import exceptions
     from ray_tpu._private import serialization, telemetry
-    from ray_tpu.experimental.channel import Channel, ChannelClosed
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.experimental import channel as channel_mod
+    from ray_tpu.experimental.channel import ChannelClosed
 
-    chans = {p: Channel(p) for p in chan_paths}
+    read_ids, write_ids = set(), set()
+    for op in ops:
+        for kind, val in op["args"]:
+            if kind == "chan":
+                read_ids.add(val)
+        write_ids.update(op["outs"])
+    chans = {}
+    try:
+        for cid in sorted(write_ids):
+            chans[cid] = channel_mod.open_channel(
+                descs[cid], "write", timeout=CONFIG.dag_socket_connect_timeout_s
+            )
+        for cid in sorted(read_ids):
+            chans[cid] = channel_mod.open_channel(descs[cid], "read")
+    except Exception:
+        channel_mod.drop_listeners(token)
+        raise
+    TAG_ERROR = serialization.TAG_ERROR
     try:
         while True:
             local = {}
@@ -210,10 +289,8 @@ def _actor_channel_loop(self, ops, chan_paths):
                 arg_error = None
                 for kind, val in op["args"]:
                     if kind == "chan":
-                        tag, v = serialization.deserialize(
-                            memoryview(chans[val].read(timeout=None))
-                        )
-                        if tag == serialization.TAG_ERROR:
+                        tag, v = chans[val].read_value(timeout=None)
+                        if tag == TAG_ERROR:
                             arg_error = v
                         args.append(v)
                     elif kind == "local":
@@ -224,11 +301,14 @@ def _actor_channel_loop(self, ops, chan_paths):
                     else:  # const
                         args.append(val)
                 if arg_error is not None:
-                    result, tag = arg_error, serialization.TAG_ERROR
+                    result, tag = arg_error, TAG_ERROR
                 else:
                     try:
                         t0 = _time.perf_counter()
-                        result = getattr(self, op["method"])(*args)
+                        if "fn" in op:
+                            result = self._dag_fns[op["fn"]](*args)
+                        else:
+                            result = getattr(self, op["method"])(*args)
                         telemetry.observe_dag_op(
                             op["method"], _time.perf_counter() - t0
                         )
@@ -239,21 +319,23 @@ def _actor_channel_loop(self, ops, chan_paths):
                         result = exceptions.RayTaskError.from_exception(
                             e, f"compiled_dag.{op['method']}"
                         )
-                        tag = serialization.TAG_ERROR
+                        tag = TAG_ERROR
                 local[op["uuid"]] = result
                 if op["outs"]:
-                    blob = serialization.serialize_to_bytes(result, tag=tag)
-                    for out in op["outs"]:
-                        chans[out].write(blob, timeout=None)
+                    channel_mod.write_value_fanout(
+                        [(chans[o], result, tag) for o in op["outs"]],
+                        timeout=None,
+                    )
     except ChannelClosed:
         # Teardown: propagate the poison downstream so every consumer
-        # (other actor loops, the driver) unblocks.
-        for op in ops:
-            for out in op["outs"]:
-                try:
-                    chans[out].close()
-                except Exception:
-                    pass
+        # (other actor loops, the driver) unblocks, then reclaim local
+        # endpoints + this node's ring directory.
+        for c in chans.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        shutil.rmtree(_ring_dir(token), ignore_errors=True)
         return "closed"
 
 
@@ -292,13 +374,16 @@ class CompiledDAG:
     dag/compiled_dag_node.py:694 — per-actor op schedules :1639,
     execute :2118).
 
-    When the whole graph is actor-method nodes, execution switches to
-    the zero-copy data plane: one mutable shared-memory channel per
-    cross-process edge, written in place every execution, with each
-    actor running its frozen op schedule in a resident loop — no task
-    submission, no object store, no RPC per call (reference:
-    experimental_mutable_object_manager.h:48).  Graphs containing
-    driver-side FunctionNodes fall back to per-node task submission."""
+    Execution runs on the zero-copy data plane for any graph the op
+    schedule can express: one mutable channel per cross-process edge
+    (mmap ring same-node, persistent socket cross-node — chosen at
+    compile time from actor placement), written in place every
+    execution, with each actor running its frozen op schedule in a
+    resident loop — no task submission, no object store, no RPC per
+    call (reference: experimental_mutable_object_manager.h:48).
+    Driver-side FunctionNodes compile into resident _FnExecutor actors.
+    Graphs using kwargs or arg nodes outside the schedule's vocabulary
+    fall back to per-node task submission."""
 
     def __init__(
         self,
@@ -325,8 +410,11 @@ class CompiledDAG:
         self._partial: List[Any] = []
         self._channels_on = False
         self._buffer_size = buffer_size_bytes
-        # Flow control: channels hold one message each, so in-flight
-        # executions are bounded (reference: max_inflight_executions).
+        # Flow control: the driver-side cap on executions submitted
+        # before a get (reference: max_inflight_executions).  The
+        # channels themselves carry many in-flight messages (ring free
+        # space / socket unacked window), so this is the only limit a
+        # pipelined driver sees.
         self._max_inflight = max_inflight
         try:
             self._build_channel_plan(cache)
@@ -334,20 +422,31 @@ class CompiledDAG:
             pass
 
     # -- channel compilation -------------------------------------------
-    def _build_channel_plan(self, actor_cache: Dict[str, Any]):
-        import os
-        import tempfile
-
-        method_nodes = []
+    def _validate_channelable(self) -> List[DAGNode]:
+        """All _NotChannelable decisions happen HERE, before any executor
+        actor is created, so a fallback graph never leaks actors."""
+        method_nodes: List[DAGNode] = []
         for n in self._order:
             if isinstance(n, (InputNode, InputAttributeNode, ClassNode, MultiOutputNode)):
                 continue
-            if isinstance(n, ClassMethodNode):
+            if isinstance(n, (ClassMethodNode, FunctionNode)):
                 if n._bound_kwargs:
                     raise _NotChannelable  # kwargs not in the op schedule
+                if isinstance(n, FunctionNode) and getattr(n._remote_fn, "_function", None) is None:
+                    raise _NotChannelable
+                data_args = (
+                    n._bound_args[1:]
+                    if isinstance(n, ClassMethodNode)
+                    else n._bound_args
+                )
+                for arg in data_args:
+                    if isinstance(arg, DAGNode) and not isinstance(
+                        arg, (InputNode, InputAttributeNode, ClassMethodNode, FunctionNode)
+                    ):
+                        raise _NotChannelable
                 method_nodes.append(n)
             else:
-                raise _NotChannelable  # FunctionNode etc: legacy path
+                raise _NotChannelable
         if not method_nodes:
             raise _NotChannelable
         outputs = (
@@ -355,61 +454,108 @@ class CompiledDAG:
             if isinstance(self._root, MultiOutputNode)
             else [self._root]
         )
-        if not all(isinstance(o, ClassMethodNode) for o in outputs):
+        if not all(isinstance(o, (ClassMethodNode, FunctionNode)) for o in outputs):
             raise _NotChannelable
+        return method_nodes
 
-        chan_dir = tempfile.mkdtemp(prefix="ray_tpu_dag_", dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
-        self._chan_dir = chan_dir
-        # tmpfs survives the process: reclaim even when the user never
-        # calls teardown (GC / interpreter exit).
-        import shutil
-        import weakref
+    @staticmethod
+    def _node_hosts(worker) -> Dict[str, str]:
+        from ray_tpu.experimental.channel import node_hosts
 
-        self._chan_finalizer = weakref.finalize(
-            self, shutil.rmtree, chan_dir, ignore_errors=True
+        return node_hosts(worker)
+
+    def _build_channel_plan(self, actor_cache: Dict[str, Any]):
+        import ray_tpu
+        from ray_tpu._private import serialization
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.worker import get_global_worker
+        from ray_tpu.experimental import channel as channel_mod
+
+        method_nodes = self._validate_channelable()
+        outputs = (
+            list(self._root._bound_args)
+            if isinstance(self._root, MultiOutputNode)
+            else [self._root]
         )
+
+        # Driver-side FunctionNodes become resident executor actors so
+        # the whole graph lives on the channel plane (they previously
+        # forced the per-call task path).
+        from ray_tpu.actor import ActorClass
+
+        actor_of: Dict[str, str] = {}
+        for n in method_nodes:
+            if isinstance(n, ClassMethodNode):
+                actor_of[n._stable_uuid] = n._bound_args[0]._stable_uuid
+            else:
+                executor = ActorClass(_FnExecutor, {"num_cpus": 0}).remote(
+                    serialization.dumps_function(n._remote_fn._function)
+                )
+                self._ctx["actors"][n._stable_uuid] = executor
+                actor_of[n._stable_uuid] = n._stable_uuid
+
+        # Placement probe: transport per edge is chosen by node identity
+        # (separate raylets on one machine are distinct "hosts" — the
+        # conservative direction: sockets always work, rings need a
+        # shared raylet).
+        actors = self._ctx["actors"]
+        live_actor_uuids = sorted(set(actor_of.values()))
+        probe_refs = {
+            a: actors[a].__ray_call__.remote(_dag_probe) for a in live_actor_uuids
+        }
+        node_of_actor = {a: ray_tpu.get(ref) for a, ref in probe_refs.items()}
+        worker = get_global_worker()
+        driver_node = worker.node_id.hex() if worker.node_id is not None else ""
+
+        token = uuid.uuid4().hex[:12]
+        self._token = token
+        chan_meta: Dict[str, dict] = {}  # cid -> {writer: ep, reader: ep}
         counter = [0]
 
-        def new_chan() -> str:
+        def new_chan(writer_ep: str, reader_ep: str) -> str:
             counter[0] += 1
-            return os.path.join(chan_dir, f"c{counter[0]}")
+            cid = f"c{counter[0]}"
+            chan_meta[cid] = {"writer": writer_ep, "reader": reader_ep}
+            return cid
 
-        actor_of = {n._stable_uuid: n._bound_args[0]._stable_uuid for n in method_nodes}
         ops_by_actor: Dict[str, list] = {}
-        actor_chans: Dict[str, set] = {}
-        # (chan_path, key-or-None) the driver writes each execute.
+        # (cid, key-or-None) the driver writes each execute.
         self._input_chans: List[tuple] = []
 
         for n in method_nodes:
             a_uuid = actor_of[n._stable_uuid]
-            op = {"uuid": n._stable_uuid, "method": n._method, "args": [], "outs": []}
-            for arg in n._bound_args[1:]:
+            if isinstance(n, ClassMethodNode):
+                op = {"uuid": n._stable_uuid, "method": n._method, "args": [], "outs": []}
+                data_args = n._bound_args[1:]
+            else:
+                op = {
+                    "uuid": n._stable_uuid,
+                    "method": n._remote_fn._function.__name__,
+                    "fn": 0,
+                    "args": [],
+                    "outs": [],
+                }
+                data_args = n._bound_args
+            for arg in data_args:
                 if isinstance(arg, InputNode):
-                    p = new_chan()
-                    self._input_chans.append((p, None))
-                    op["args"].append(("chan", p))
-                    actor_chans.setdefault(a_uuid, set()).add(p)
+                    cid = new_chan("driver", a_uuid)
+                    self._input_chans.append((cid, None))
+                    op["args"].append(("chan", cid))
                 elif isinstance(arg, InputAttributeNode):
-                    p = new_chan()
-                    self._input_chans.append((p, arg._key))
-                    op["args"].append(("chan", p))
-                    actor_chans.setdefault(a_uuid, set()).add(p)
-                elif isinstance(arg, ClassMethodNode):
-                    if actor_of[arg._stable_uuid] == a_uuid:
-                        op["args"].append(("local", arg._stable_uuid))
+                    cid = new_chan("driver", a_uuid)
+                    self._input_chans.append((cid, arg._key))
+                    op["args"].append(("chan", cid))
+                elif isinstance(arg, (ClassMethodNode, FunctionNode)):
+                    src = arg._stable_uuid
+                    if actor_of[src] == a_uuid:
+                        op["args"].append(("local", src))
                     else:
-                        p = new_chan()
-                        # producer writes, this actor reads
-                        prod_uuid = arg._stable_uuid
+                        cid = new_chan(actor_of[src], a_uuid)
                         for ops in ops_by_actor.values():
                             for prod_op in ops:
-                                if prod_op["uuid"] == prod_uuid:
-                                    prod_op["outs"].append(p)
-                        actor_chans.setdefault(actor_of[prod_uuid], set()).add(p)
-                        op["args"].append(("chan", p))
-                        actor_chans.setdefault(a_uuid, set()).add(p)
-                elif isinstance(arg, DAGNode):
-                    raise _NotChannelable
+                                if prod_op["uuid"] == src:
+                                    prod_op["outs"].append(cid)
+                        op["args"].append(("chan", cid))
                 else:
                     op["args"].append(("const", arg))
             ops_by_actor.setdefault(a_uuid, []).append(op)
@@ -417,39 +563,105 @@ class CompiledDAG:
         # Output channels to the driver, in MultiOutput order.
         self._output_chans = []
         for o in outputs:
-            p = new_chan()
+            cid = new_chan(actor_of[o._stable_uuid], "driver")
             for ops in ops_by_actor.values():
                 for op in ops:
                     if op["uuid"] == o._stable_uuid:
-                        op["outs"].append(p)
-            actor_chans.setdefault(actor_of[o._stable_uuid], set()).add(p)
-            self._output_chans.append(p)
+                        op["outs"].append(cid)
+            self._output_chans.append(cid)
 
-        from ray_tpu.experimental.channel import Channel
+        # -- transport selection + descriptor table ---------------------
+        def node_of(ep: str) -> str:
+            return driver_node if ep == "driver" else node_of_actor[ep]
 
-        # Driver creates every channel file before the loops start.
-        all_paths = sorted({p for s in actor_chans.values() for p in s})
-        for p in all_paths:
-            Channel.create_file(p, self._buffer_size)
-        self._driver_in = [(Channel(p), key) for p, key in self._input_chans]
-        self._driver_out = [Channel(p) for p in self._output_chans]
+        ring_dir = _ring_dir(token)
+        self._chan_dir = ring_dir
+        descs: Dict[str, dict] = {}
+        ring_reads: Dict[str, list] = {}
+        socket_binds: Dict[str, list] = {}
+        driver_ring_reads: List[str] = []
+        driver_socket_reads: List[str] = []
+        for cid, meta in chan_meta.items():
+            if node_of(meta["writer"]) == node_of(meta["reader"]):
+                descs[cid] = {"kind": "ring", "path": os.path.join(ring_dir, cid)}
+                if meta["reader"] == "driver":
+                    driver_ring_reads.append(cid)
+                else:
+                    ring_reads.setdefault(meta["reader"], []).append(cid)
+            else:
+                descs[cid] = {"kind": "socket", "token": token, "id": cid}
+                if meta["reader"] == "driver":
+                    driver_socket_reads.append(cid)
+                else:
+                    socket_binds.setdefault(meta["reader"], []).append(cid)
+        self._chan_meta = chan_meta
+        self._descs = descs
 
-        # Kick off the resident loops.
-        self._loop_refs = []
-        for a_uuid, ops in ops_by_actor.items():
-            actor = self._ctx["actors"][a_uuid]
-            self._loop_refs.append(
-                actor.__ray_call__.remote(
-                    _actor_channel_loop, ops, sorted(actor_chans.get(a_uuid, ()))
-                )
+        # -- setup phase: every reader creates/binds its endpoints ------
+        os.makedirs(ring_dir, exist_ok=True)
+        # tmpfs survives the process: reclaim even when the user never
+        # calls teardown (GC / interpreter exit).
+        import shutil
+        import weakref
+
+        self._chan_finalizer = weakref.finalize(
+            self, shutil.rmtree, ring_dir, ignore_errors=True
+        )
+        for cid in driver_ring_reads:
+            channel_mod.Channel.create_file(descs[cid]["path"], self._buffer_size)
+        ports: Dict[str, int] = {}
+        for cid in driver_socket_reads:
+            ports[cid] = channel_mod.bind_listener(token, cid)
+        setup_refs = {
+            a: actors[a].__ray_call__.remote(
+                _dag_setup, token, ring_reads.get(a, []),
+                socket_binds.get(a, []), self._buffer_size,
             )
+            for a in live_actor_uuids
+        }
+        try:
+            for a, ref in setup_refs.items():
+                ports.update(ray_tpu.get(ref))
+            hosts = self._node_hosts(worker)
+            for cid, desc in descs.items():
+                if desc["kind"] == "socket":
+                    reader_node = node_of(chan_meta[cid]["reader"])
+                    desc["addr"] = (hosts.get(reader_node, "127.0.0.1"), ports[cid])
+
+            # -- start the resident loops, then open driver endpoints ----
+            self._loop_refs = []
+            for a_uuid, ops in ops_by_actor.items():
+                actor = actors[a_uuid]
+                actor_cids = {
+                    cid
+                    for op in ops
+                    for cid in [v for k, v in op["args"] if k == "chan"] + op["outs"]
+                }
+                self._loop_refs.append(
+                    actor.__ray_call__.remote(
+                        _actor_channel_loop, ops,
+                        {cid: descs[cid] for cid in actor_cids}, token,
+                    )
+                )
+            connect_t = CONFIG.dag_socket_connect_timeout_s
+            self._driver_in = [
+                (channel_mod.open_channel(descs[cid], "write", timeout=connect_t), key)
+                for cid, key in self._input_chans
+            ]
+            self._driver_out = [
+                channel_mod.open_channel(descs[cid], "read", timeout=30.0)
+                for cid in self._output_chans
+            ]
+        except Exception:
+            channel_mod.drop_listeners(token)
+            raise
         self._channels_on = True
 
     # -- execution ------------------------------------------------------
     def execute(self, *input_vals):
         input_val = input_vals[0] if len(input_vals) == 1 else (input_vals if input_vals else None)
         if self._channels_on:
-            from ray_tpu._private import serialization
+            from ray_tpu.experimental import channel as channel_mod
 
             def extract(key):
                 if key is None:
@@ -468,11 +680,13 @@ class CompiledDAG:
                         f"at experimental_compile if the pipeline is deeper)"
                     )
                 self._seq += 1
-                blobs: Dict[Any, bytes] = {}
-                for chan, key in self._driver_in:
-                    if key not in blobs:
-                        blobs[key] = serialization.serialize_to_bytes(extract(key))
-                    chan.write(blobs[key])
+                # Fan-out scheduling: issue every input write (round-robin
+                # on blocked edges) before blocking on any single one, so
+                # independent branches start in parallel.
+                channel_mod.write_value_fanout(
+                    [(chan, extract(key), 0) for chan, key in self._driver_in],
+                    timeout=30.0,
+                )
                 from ray_tpu._private import telemetry
 
                 telemetry.count_dag_execution()
@@ -498,9 +712,7 @@ class CompiledDAG:
                     # re-read on retry, so results can't cross executions.
                     while len(self._partial) < len(self._driver_out):
                         chan = self._driver_out[len(self._partial)]
-                        self._partial.append(
-                            serialization.deserialize(memoryview(chan.read(timeout)))
-                        )
+                        self._partial.append(chan.read_value(timeout))
                     vals, self._partial = self._partial, []
                     if any(tag == serialization.TAG_ERROR for tag, _ in vals):
                         out = next(v for tag, v in vals if tag == serialization.TAG_ERROR)
@@ -529,10 +741,10 @@ class CompiledDAG:
         return result
 
     def stats(self) -> Dict[str, Any]:
-        """Driver-side dataplane counters: per-channel op/blocked-time/
-        timeout stats plus in-flight occupancy (the compiled-graphs
-        bottleneck view; actor-side op timings flow through telemetry
-        as ``dag_op_seconds``/``channel_*``).
+        """Driver-side dataplane counters: per-channel transport kind,
+        op/blocked-time/timeout stats, and in-flight occupancy (the
+        compiled-graphs bottleneck view; actor-side op timings flow
+        through telemetry as ``dag_op_seconds``/``channel_*``).
 
         Never blocks: ``_read_result`` holds ``self._lock`` across its
         (possibly long) channel reads, and a diagnostic view that hangs
@@ -555,11 +767,11 @@ class CompiledDAG:
             if self._channels_on:
                 for chan, key in self._driver_in:
                     out["input_channels"].append(
-                        {"key": key, "pending": chan.pending(), **chan.stats}
+                        {"key": key, "kind": chan.kind, "pending": chan.pending(), **chan.stats}
                     )
                 for chan in self._driver_out:
                     out["output_channels"].append(
-                        {"pending": chan.pending(), **chan.stats}
+                        {"kind": chan.kind, "pending": chan.pending(), **chan.stats}
                     )
         finally:
             if locked:
@@ -589,8 +801,9 @@ class CompiledDAG:
                 except Exception:
                     pass
             self._channels_on = False
-            # The channel files live in tmpfs: they must be unlinked or
-            # the RAM survives this process.
+            # The local ring files live in tmpfs: they must be unlinked
+            # or the RAM survives this process (each actor loop reclaims
+            # its own node's directory on exit).
             shutil.rmtree(getattr(self, "_chan_dir", ""), ignore_errors=True)
         for actor in self._ctx.get("actors", {}).values():
             try:

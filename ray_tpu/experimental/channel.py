@@ -1,20 +1,33 @@
-"""Mutable shared-memory channels for compiled DAGs.
+"""Channels for compiled DAGs: same-host shm ring buffers + cross-host sockets.
 
 Reference: src/ray/core_worker/experimental_mutable_object_manager.h:48
 and python/ray/experimental/channel/shared_memory_channel.py — a
-fixed-size buffer written in place per execution instead of allocating
-a new object in the store per message.
+fixed-size buffer written in place per message instead of allocating a
+new object in the store per message.
 
-Single-writer / single-reader, same host.  Layout of the mmap'd file:
+``Channel`` is a single-writer / single-reader, same-host ring buffer
+over an mmap'd file:
 
-    [seq u64][ack u64][len u64][pad u64][payload ...]
+    [wbytes u64][rbytes u64][closed u64][pad..64][ring payload ...]
 
-Seqlock protocol: the writer waits for ``ack == seq`` (previous message
-consumed — flow control), bumps ``seq`` to odd, writes len+payload,
-then bumps ``seq`` to the next even value.  The reader waits for an
-even ``seq`` it hasn't consumed, copies the payload, re-checks ``seq``
-(torn-read guard), and publishes ``ack = seq``.  A length of 2**64-1 is
-the poison pill: the channel is closed and readers raise ChannelClosed.
+Records are ``[u64 len][payload][pad to 8]`` appended at ``wbytes %
+capacity``; a len of 2**64-2 is a wrap marker (the rest of the region is
+skipped), and the writer publishes ``wbytes`` only after the payload is
+in place.  ``rbytes`` advancing IS the consume-ack: free space is
+``capacity - (wbytes - rbytes)``, so the writer blocks only when the
+ring is genuinely full — multiple messages ride in flight per edge
+(pipelined compiled executions), unlike the previous one-slot seqlock
+design which deadlocked any pipeline deeper than the edge count.
+``closed`` is a drain-then-close flag: readers see ChannelClosed only
+after consuming the backlog; blocked writers see it immediately.
+
+``SocketChannel`` carries the same write/read/pending contract over one
+long-lived TCP connection for compiled edges whose endpoints live on
+different nodes: framed messages one way, consume-acks the other, a
+bounded unacked window as flow control.  Either transport moves values
+via the binary wire format (``_private/wire.py``) with ``write_value``
+/ ``read_value`` — encoded straight into the ring / scratch frame, no
+pickling and no intermediate copies for the fast-path types.
 """
 
 from __future__ import annotations
@@ -23,22 +36,40 @@ import mmap
 import os
 import struct
 import time
-from typing import Optional
+from typing import Any, List, Optional, Sequence, Tuple
 
 _U64 = struct.Struct("<Q")
-HEADER = 32
-POISON = (1 << 64) - 1
+HEADER = 64
+POISON = (1 << 64) - 1  # socket framing: orderly close
+WRAP = (1 << 64) - 2  # ring: rest of region is skipped
+_WOFF, _ROFF, _COFF = 0, 8, 16
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
 
 
 class ChannelClosed(Exception):
-    pass
+    """The peer closed the channel (drained) or died (socket EOF)."""
 
 
 class ChannelTimeout(Exception):
-    pass
+    """The peer is alive but didn't produce/consume within the timeout."""
+
+
+class ChannelCapacityError(ValueError):
+    """Payload exceeds the channel's fixed capacity (typed, never a hang)."""
+
+
+class ChannelConnectionError(ConnectionError):
+    """A socket channel could not (re)connect: the listener accepts
+    exactly one peer for its lifetime (single-writer/single-reader
+    contract), so dialing a consumed or dead endpoint is refused."""
 
 
 class Channel:
+    kind = "ring"
+
     @staticmethod
     def create_file(path: str, max_size: int = 8 * 1024 * 1024) -> None:
         """Allocate a channel's backing file without opening an endpoint
@@ -48,16 +79,18 @@ class Channel:
 
     def __init__(self, path: str, max_size: int = 8 * 1024 * 1024, create: bool = False):
         self.path = path
-        self.max_size = max_size
         if create:
             with open(path, "wb") as f:
                 f.truncate(HEADER + max_size)
         # Open by both sides; size from the file (reader may not know).
         self._f = open(path, "r+b")
         size = os.fstat(self._f.fileno()).st_size
-        self.max_size = size - HEADER
+        cap = size - HEADER
+        self.capacity = cap - (cap % 8)
+        # Largest single record (header + aligned payload) the ring can
+        # carry: one wrap marker must always fit beside it.
+        self.max_size = self.capacity - 16
         self._mm = mmap.mmap(self._f.fileno(), size)
-        self._last_read = 0
         # Dataplane counters (item-2 hot path must land measurable):
         # plain dict increments on the fast path (~100 ns), folded into
         # telemetry in batches of _TELE_FLUSH_OPS so per-op cost stays
@@ -127,58 +160,173 @@ class Channel:
         self._tele_ops = 0
 
     def pending(self) -> bool:
-        """Occupancy: a published message the reader hasn't acked yet."""
+        """Occupancy: published bytes the reader hasn't consumed yet."""
         try:
-            return self._get(8) != self._get(0)
+            return self._get(_WOFF) != self._get(_ROFF)
         except ValueError:
             return False  # mmap closed
 
+    def _closed_flag(self) -> bool:
+        try:
+            return self._get(_COFF) != 0
+        except ValueError:
+            return True
+
     # -- writer ---------------------------------------------------------
-    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
-        if len(data) > self.max_size:
-            raise ValueError(
-                f"message of {len(data)} bytes exceeds channel capacity "
-                f"{self.max_size}; raise max_size at compile time"
-            )
-        deadline = None if timeout is None else time.monotonic() + timeout
-        spins = 0
-        t_block = 0.0
-        while self._get(8) != self._get(0):  # previous not yet consumed
-            if spins == 0:
-                t_block = time.monotonic()
-            spins += 1
-            self._backoff(spins)
-            if deadline is not None and (spins >= 2000 or spins % 512 == 0) and time.monotonic() > deadline:
-                self.stats["write_timeouts"] += 1
-                self.stats["write_blocked_s"] += time.monotonic() - t_block
-                self._tele_flush()
-                raise ChannelTimeout(f"reader of {self.path} did not consume in {timeout}s")
-        seq = self._get(0)
-        self._set(0, seq + 1)  # odd: write in progress
-        self._set(16, len(data))
-        self._mm[HEADER : HEADER + len(data)] = data
-        self._set(0, seq + 2)  # even: published
+    def _count_write(self, nbytes: int) -> None:
         s = self.stats
         s["writes"] += 1
-        s["bytes_written"] += len(data)
-        if spins:
-            s["write_blocked_s"] += time.monotonic() - t_block
+        s["bytes_written"] += nbytes
         self._tele_ops += 1
         if self._tele_ops >= self._TELE_FLUSH_OPS:
             self._tele_flush()
 
+    def _write_wait(self, spins: int, t_block: float, deadline: Optional[float]) -> float:
+        """One blocked-writer backoff step (shared by write paths)."""
+        if self._closed_flag():
+            self.stats["write_blocked_s"] += time.monotonic() - t_block if spins else 0.0
+            raise ChannelClosed(self.path)
+        self._backoff(spins)
+        if (
+            deadline is not None
+            and (spins >= 2000 or spins % 512 == 0)
+            and time.monotonic() > deadline
+        ):
+            self.stats["write_timeouts"] += 1
+            self.stats["write_blocked_s"] += time.monotonic() - t_block
+            self._tele_flush()
+            raise ChannelTimeout(
+                f"reader of {self.path} did not free ring space in time"
+            )
+        return t_block
+
+    def _wrap(self, wb: int, tail: int) -> int:
+        """Write a wrap marker (when it fits) and skip the tail region.
+        Caller has verified the tail is free."""
+        wpos = wb % self.capacity
+        if tail >= 8:
+            _U64.pack_into(self._mm, HEADER + wpos, WRAP)
+        wb += tail
+        self._set(_WOFF, wb)
+        return wb
+
+    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
+        need = 8 + _align8(len(data))
+        if need > self.max_size:
+            raise ChannelCapacityError(
+                f"message of {len(data)} bytes exceeds channel capacity "
+                f"{self.max_size}; raise the buffer size at compile time"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        t_block = 0.0
+        cap = self.capacity
+        while True:
+            wb = self._get(_WOFF)
+            free = cap - (wb - self._get(_ROFF))
+            tail = cap - (wb % cap)
+            if tail < need:
+                # Wrap once the tail region is free, then retry.
+                if free >= tail:
+                    self._wrap(wb, tail)
+                    continue
+            elif free >= need:
+                break
+            if spins == 0:
+                t_block = time.monotonic()
+            spins += 1
+            self._write_wait(spins, t_block, deadline)
+        wpos = wb % cap
+        self._mm[HEADER + wpos + 8 : HEADER + wpos + 8 + len(data)] = data
+        _U64.pack_into(self._mm, HEADER + wpos, len(data))
+        self._set(_WOFF, wb + need)
+        if spins:
+            self.stats["write_blocked_s"] += time.monotonic() - t_block
+        self._count_write(len(data))
+
+    def _try_publish_value(self, value: Any, tag: int) -> Tuple[bool, bool]:
+        """One encode attempt at the current write position.  Returns
+        (published, blocked_on_reader): encoding straight into the ring
+        means the payload size is unknown up front, so an overflow is
+        disambiguated by WHAT bounded the window — the region tail
+        (fixable by wrapping), the reader's position (fixable by
+        waiting), or the whole ring (typed capacity error)."""
+        from ray_tpu._private import wire
+
+        cap = self.capacity
+        wb = self._get(_WOFF)
+        free = cap - (wb - self._get(_ROFF))
+        wpos = wb % cap
+        tail = cap - wpos
+        window = min(tail, free)
+        if window >= 16:
+            try:
+                n = wire.encode_into(
+                    memoryview(self._mm)[
+                        HEADER + wpos + 8 : HEADER + wpos + window
+                    ],
+                    value,
+                    tag,
+                )
+            except (struct.error, ValueError, IndexError):
+                n = -1
+            if n >= 0 and 8 + _align8(n) <= window:
+                _U64.pack_into(self._mm, HEADER + wpos, n)
+                self._set(_WOFF, wb + 8 + _align8(n))
+                self._count_write(n)
+                return True, False
+        if window >= tail:
+            # Tail-bounded: wrap (the tail is fully free) and retry.
+            if tail >= cap - 16:
+                # Full, empty ring couldn't hold it: genuinely too big.
+                raise ChannelCapacityError(
+                    f"value exceeds ring capacity {self.max_size} of "
+                    f"{self.path}; raise the buffer size at compile time"
+                )
+            self._wrap(wb, tail)
+            return False, False
+        return False, True  # reader-bounded: wait for consumption
+
+    def write_value(self, value: Any, tag: int = 0, timeout: Optional[float] = 30.0) -> None:
+        """Fast-path write: wire-encode ``value`` directly into the ring."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        t_block = 0.0
+        while True:
+            published, blocked = self._try_publish_value(value, tag)
+            if published:
+                if spins:
+                    self.stats["write_blocked_s"] += time.monotonic() - t_block
+                return
+            if blocked:
+                if spins == 0:
+                    t_block = time.monotonic()
+                spins += 1
+                self._write_wait(spins, t_block, deadline)
+
+    def try_write_value(self, value: Any, tag: int = 0) -> bool:
+        """Non-blocking write attempt (fan-out scheduling): False when
+        the ring lacks free space right now."""
+        if self._closed_flag():
+            raise ChannelClosed(self.path)
+        while True:
+            published, blocked = self._try_publish_value(value, tag)
+            if published:
+                return True
+            if blocked:
+                return False
+            # wrapped: retry immediately at the region start
+
     def close(self) -> None:
-        """Poison the channel: the reader's next read raises
-        ChannelClosed.  Does not wait for ack (teardown path)."""
+        """Drain-then-close: the reader sees ChannelClosed after
+        consuming the backlog; blocked writers see it immediately.
+        Either side may close (teardown path)."""
         try:
             self._tele_flush()
         except Exception:
             pass
         try:
-            seq = self._get(0)
-            self._set(0, seq + 1 if seq % 2 == 0 else seq)
-            self._set(16, POISON)
-            self._set(0, (seq // 2) * 2 + 2)
+            self._set(_COFF, 1)
         except ValueError:
             pass  # mmap already closed
         try:
@@ -188,41 +336,540 @@ class Channel:
             pass
 
     # -- reader ---------------------------------------------------------
+    def _read_slot(self) -> Optional[Tuple[int, int]]:
+        """(rpos, len) of the next record, advancing past wrap markers;
+        None when the ring is empty."""
+        cap = self.capacity
+        while True:
+            rb = self._get(_ROFF)
+            if self._get(_WOFF) == rb:
+                return None
+            rpos = rb % cap
+            tail = cap - rpos
+            if tail < 8:
+                self._set(_ROFF, rb + tail)
+                continue
+            n = _U64.unpack_from(self._mm, HEADER + rpos)[0]
+            if n == WRAP:
+                self._set(_ROFF, rb + tail)
+                continue
+            return rpos, n
+
+    def _consume(self, rpos: int, n: int, blocked_since: float) -> None:
+        self._set(_ROFF, self._get(_ROFF) + 8 + _align8(n))
+        s = self.stats
+        s["reads"] += 1
+        s["bytes_read"] += n
+        if blocked_since:
+            s["read_blocked_s"] += time.monotonic() - blocked_since
+        self._tele_ops += 1
+        if self._tele_ops >= self._TELE_FLUSH_OPS:
+            self._tele_flush()
+
+    def _read_wait(self, spins: int, t_block: float, deadline: Optional[float], timeout) -> None:
+        if self._closed_flag():
+            raise ChannelClosed(self.path)
+        self._backoff(spins)
+        if (
+            deadline is not None
+            and (spins >= 2000 or spins % 512 == 0)
+            and time.monotonic() > deadline
+        ):
+            self.stats["read_timeouts"] += 1
+            self.stats["read_blocked_s"] += time.monotonic() - t_block
+            self._tele_flush()
+            raise ChannelTimeout(f"no message on {self.path} within {timeout}s")
+
     def read(self, timeout: Optional[float] = 30.0) -> bytes:
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         t_block = 0.0
         while True:
-            seq = self._get(0)
-            if seq % 2 == 0 and seq != self._last_read:
-                n = self._get(16)
-                if n == POISON:
-                    raise ChannelClosed(self.path)
-                data = bytes(self._mm[HEADER : HEADER + n])
-                if self._get(0) == seq:  # not torn
-                    self._last_read = seq
-                    self._set(8, seq)  # ack: writer may proceed
-                    s = self.stats
-                    s["reads"] += 1
-                    s["bytes_read"] += len(data)
-                    if spins:
-                        s["read_blocked_s"] += time.monotonic() - t_block
-                    self._tele_ops += 1
-                    if self._tele_ops >= self._TELE_FLUSH_OPS:
-                        self._tele_flush()
-                    return data
+            slot = self._read_slot()
+            if slot is not None:
+                rpos, n = slot
+                data = bytes(self._mm[HEADER + rpos + 8 : HEADER + rpos + 8 + n])
+                self._consume(rpos, n, t_block if spins else 0.0)
+                return data
             if spins == 0:
                 t_block = time.monotonic()
             spins += 1
-            self._backoff(spins)
-            if deadline is not None and (spins >= 2000 or spins % 512 == 0) and time.monotonic() > deadline:
-                self.stats["read_timeouts"] += 1
-                self.stats["read_blocked_s"] += time.monotonic() - t_block
-                self._tele_flush()
-                raise ChannelTimeout(f"no message on {self.path} within {timeout}s")
+            self._read_wait(spins, t_block, deadline, timeout)
+
+    def read_value(self, timeout: Optional[float] = 30.0) -> Tuple[int, Any]:
+        """Fast-path read: wire-decode straight from the ring; returns
+        ``(tag, value)``.  Array payloads are copied out before the
+        consume-ack (the writer reuses the region afterwards)."""
+        from ray_tpu._private import wire
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        t_block = 0.0
+        while True:
+            slot = self._read_slot()
+            if slot is not None:
+                rpos, n = slot
+                tag, value = wire.decode(
+                    memoryview(self._mm)[HEADER + rpos + 8 : HEADER + rpos + 8 + n],
+                    copy_arrays=True,
+                )
+                self._consume(rpos, n, t_block if spins else 0.0)
+                return tag, value
+            if spins == 0:
+                t_block = time.monotonic()
+            spins += 1
+            self._read_wait(spins, t_block, deadline, timeout)
 
     def unlink(self) -> None:
         try:
             os.unlink(self.path)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Cross-host socket channels
+
+
+_FRAME = struct.Struct("<Q")
+_ACK = b"\x01"
+
+
+class SocketListener:
+    """One listening endpoint for one compiled edge.  Accepts exactly ONE
+    connection over its lifetime (the single-writer/single-reader
+    contract), then closes the listening socket — a later dial to the
+    same port is refused (``ChannelConnectionError`` on the dialer)."""
+
+    def __init__(self):
+        import socket as _socket
+
+        self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(1)
+        self.port = self._sock.getsockname()[1]
+
+    def accept(self, role: str, timeout: Optional[float] = 30.0) -> "SocketChannel":
+        import socket as _socket
+
+        self._sock.settimeout(timeout)
+        try:
+            conn, _peer = self._sock.accept()
+        except _socket.timeout:
+            raise ChannelTimeout(
+                f"no peer dialed listener :{self.port} within {timeout}s"
+            ) from None
+        finally:
+            self.close()
+        return SocketChannel(conn, role)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def dial(addr: Tuple[str, int], role: str, timeout: float = 15.0) -> "SocketChannel":
+    """Connect to a bound listener; retries transient refusals on the
+    unified CONNECT policy until ``timeout`` (listener startup races),
+    then raises the typed ``ChannelConnectionError``."""
+    import socket as _socket
+
+    from ray_tpu._private import retry, telemetry
+
+    bo = retry.CONNECT.start(deadline_s=timeout)
+    last: Optional[Exception] = None
+    while True:
+        try:
+            sock = _socket.create_connection(tuple(addr), timeout=min(timeout, 5.0))
+            telemetry.count_socket_connect("ok")
+            return SocketChannel(sock, role)
+        except OSError as e:
+            last = e
+            delay = bo.next_delay()
+            if delay is None:
+                telemetry.count_socket_connect("refused")
+                raise ChannelConnectionError(
+                    f"socket channel endpoint {addr} refused ({last}); "
+                    "compiled-edge listeners accept exactly one connection — "
+                    "a dropped edge means the graph must be recompiled"
+                ) from last
+            time.sleep(delay)
+
+
+class SocketChannel:
+    """The mmap ring's write/read/pending contract over one long-lived
+    TCP connection (one per compiled REMOTE edge, chosen at compile time
+    by placement).
+
+    Data frames (``[u64 len][payload]``) flow writer→reader; one ack
+    byte per *consumed* message flows back.  Flow control is a bounded
+    unacked window (like the ring's single slot, widened to hide the
+    network RTT).  Reader-side: a daemonized reader thread drains frames
+    into a local queue so ``pending()`` is local and writer death (EOF /
+    reset) is detected immediately as ``ChannelClosed`` — distinct from
+    ``ChannelTimeout``, which means the peer is alive but silent.
+    """
+
+    kind = "socket"
+
+    _CLOSED = object()  # poison frame received (orderly close)
+    _DIED = object()  # EOF/reset without poison (peer death)
+
+    def __init__(self, sock, role: str, window: Optional[int] = None):
+        import queue as _queue
+        import socket as _socket
+        import threading as _threading
+
+        assert role in ("read", "write"), role
+        if window is None:
+            from ray_tpu._private.config import CONFIG
+
+            window = int(getattr(CONFIG, "socket_channel_window", 8))
+        self.role = role
+        self.path = f"socket:{sock.getpeername()}"
+        self._sock = sock
+        self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._window = max(1, window)
+        self._unacked = 0
+        self._closed = False
+        self.stats = {
+            "writes": 0,
+            "reads": 0,
+            "bytes_written": 0,
+            "bytes_read": 0,
+            "write_blocked_s": 0.0,
+            "read_blocked_s": 0.0,
+            "write_timeouts": 0,
+            "read_timeouts": 0,
+        }
+        self._tele_ops = 0
+        self._tele_flushed = dict(self.stats)
+        self._scratch = bytearray(64 * 1024)
+        if role == "read":
+            self._q: "_queue.Queue" = _queue.Queue()
+            self._rx = _threading.Thread(
+                target=self._rx_loop, daemon=True, name="socket-channel-rx"
+            )
+            self._rx.start()
+
+    # Telemetry rides the SAME channel_* series as the ring (op labels
+    # read/write) — one dataplane, two transports.
+    _TELE_FLUSH_OPS = Channel._TELE_FLUSH_OPS
+    _tele_flush = Channel._tele_flush
+
+    # -- reader ---------------------------------------------------------
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        """None on EOF; runs only on the rx thread."""
+        chunks = []
+        while n:
+            chunk = self._sock.recv(n)
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks) if len(chunks) != 1 else chunks[0]
+
+    def _rx_loop(self) -> None:
+        while True:
+            try:
+                head = self._recv_exact(8)
+                if head is None:
+                    self._q.put(self._DIED)
+                    return
+                (n,) = _FRAME.unpack(head)
+                if n == POISON:
+                    self._q.put(self._CLOSED)
+                    return
+                payload = self._recv_exact(n)
+                if payload is None:
+                    self._q.put(self._DIED)
+                    return
+                self._q.put(payload)
+            except OSError:
+                self._q.put(self._DIED)
+                return
+
+    def _pop_frame(self, timeout: Optional[float]) -> bytes:
+        import queue as _queue
+
+        t0 = time.monotonic()
+        try:
+            item = self._q.get(timeout=timeout)
+        except _queue.Empty:
+            self.stats["read_timeouts"] += 1
+            self.stats["read_blocked_s"] += time.monotonic() - t0
+            self._tele_flush()
+            raise ChannelTimeout(
+                f"no message on {self.path} within {timeout}s"
+            ) from None
+        waited = time.monotonic() - t0
+        if waited > 0.0005:
+            self.stats["read_blocked_s"] += waited
+        if item is self._CLOSED or item is self._DIED:
+            self._closed = True
+            self._q.put(item)  # later reads fail the same way
+            raise ChannelClosed(
+                f"{self.path}: "
+                + ("closed by writer" if item is self._CLOSED else "writer died")
+            )
+        # Consume-ack: flow control counts messages the CONSUMER has
+        # taken, not what the rx thread buffered.
+        try:
+            self._sock.sendall(_ACK)
+        except OSError:
+            pass  # writer already gone; reads of buffered frames still valid
+        s = self.stats
+        s["reads"] += 1
+        s["bytes_read"] += len(item)
+        self._tele_ops += 1
+        if self._tele_ops >= self._TELE_FLUSH_OPS:
+            self._tele_flush()
+        return item
+
+    def read(self, timeout: Optional[float] = 30.0) -> bytes:
+        return self._pop_frame(timeout)
+
+    def read_value(self, timeout: Optional[float] = 30.0) -> Tuple[int, Any]:
+        from ray_tpu._private import wire
+
+        frame = self._pop_frame(timeout)
+        # One-shot frame owned by us: arrays may alias it (no copy).
+        return wire.decode(memoryview(frame), copy_arrays=False)
+
+    def pending(self) -> bool:
+        if self.role == "read":
+            return not self._q.empty()
+        return self._unacked > 0
+
+    # -- writer ---------------------------------------------------------
+    def _drain_acks(self, deadline: Optional[float]) -> None:
+        """Consume available acks; when the window is full, block (up to
+        the deadline) for the next one."""
+        import select as _select
+
+        while True:
+            timeout = 0.0
+            if self._unacked >= self._window:
+                if deadline is None:
+                    timeout = 1.0
+                else:
+                    timeout = max(0.0, deadline - time.monotonic())
+                    if timeout == 0.0:
+                        self.stats["write_timeouts"] += 1
+                        self._tele_flush()
+                        raise ChannelTimeout(
+                            f"reader of {self.path} did not consume "
+                            f"(window {self._window} full)"
+                        )
+            ready, _, _ = _select.select([self._sock], [], [], timeout)
+            if not ready:
+                if self._unacked < self._window:
+                    return
+                continue  # window full: keep waiting for the ack
+            try:
+                acks = self._sock.recv(4096)
+            except OSError:
+                acks = b""
+            if not acks:
+                self._closed = True
+                raise ChannelClosed(f"{self.path}: reader died")
+            self._unacked -= len(acks)
+            if self._unacked < self._window:
+                return
+
+    def _send_frame(self, payload_len: int) -> None:
+        _FRAME.pack_into(self._scratch, 0, payload_len)
+        self._sock.sendall(memoryview(self._scratch)[: 8 + payload_len])
+
+    def _encode_scratch(self, value: Any, tag: int) -> int:
+        from ray_tpu._private import wire
+
+        while True:
+            try:
+                return wire.encode_into(memoryview(self._scratch)[8:], value, tag)
+            except (struct.error, ValueError, IndexError):
+                if len(self._scratch) >= 1 << 31:
+                    raise ChannelCapacityError(
+                        "value exceeds socket channel frame limit (2 GiB)"
+                    ) from None
+                self._scratch = bytearray(len(self._scratch) * 4)
+
+    def _write_payload(self, value: Any, tag: int, timeout: Optional[float], data: Optional[bytes]) -> None:
+        if self._closed:
+            raise ChannelClosed(self.path)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        t0 = time.monotonic()
+        try:
+            self._drain_acks(deadline)
+            if data is not None:
+                n = len(data)
+                if len(self._scratch) < 8 + n:
+                    self._scratch = bytearray(8 + n)
+                self._scratch[8 : 8 + n] = data
+            else:
+                n = self._encode_scratch(value, tag)
+            self._send_frame(n)
+        except OSError as e:
+            self._closed = True
+            raise ChannelClosed(f"{self.path}: {e}") from None
+        waited = time.monotonic() - t0
+        if waited > 0.0005:
+            self.stats["write_blocked_s"] += waited
+        self._unacked += 1
+        self._count_write(n)
+
+    _count_write = Channel._count_write
+
+    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
+        self._write_payload(None, 0, timeout, data)
+
+    def write_value(self, value: Any, tag: int = 0, timeout: Optional[float] = 30.0) -> None:
+        self._write_payload(value, tag, timeout, None)
+
+    def try_write_value(self, value: Any, tag: int = 0) -> bool:
+        if self._closed:
+            raise ChannelClosed(self.path)
+        if self._unacked >= self._window:
+            import select as _select
+
+            ready, _, _ = _select.select([self._sock], [], [], 0.0)
+            if ready:
+                try:
+                    acks = self._sock.recv(4096)
+                except OSError:
+                    acks = b""
+                if not acks:
+                    self._closed = True
+                    raise ChannelClosed(f"{self.path}: reader died")
+                self._unacked -= len(acks)
+            if self._unacked >= self._window:
+                return False
+        self.write_value(value, tag, timeout=None)
+        return True
+
+    # -- teardown -------------------------------------------------------
+    def close(self) -> None:
+        try:
+            self._tele_flush()
+        except Exception:
+            pass
+        if self.role == "write" and not self._closed:
+            try:
+                self._sock.sendall(_FRAME.pack(POISON))
+            except OSError:
+                pass
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def unlink(self) -> None:  # contract parity with the ring
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Compile-time endpoint plumbing
+
+
+# Listeners bound during a compiled graph's setup phase, consumed when
+# its resident loop (or the driver) opens the read side.  Keyed by
+# (dag token, channel id); same process between setup and loop start.
+_BOUND_LISTENERS: dict = {}
+
+
+def bind_listener(token: str, cid: str) -> int:
+    lst = SocketListener()
+    _BOUND_LISTENERS[(token, cid)] = lst
+    return lst.port
+
+
+def take_listener(token: str, cid: str) -> SocketListener:
+    return _BOUND_LISTENERS.pop((token, cid))
+
+
+def drop_listeners(token: str) -> None:
+    for key in [k for k in _BOUND_LISTENERS if k[0] == token]:
+        _BOUND_LISTENERS.pop(key).close()
+
+
+def ring_base_dir() -> str:
+    """Filesystem base for ring-channel files: tmpfs when available.
+    The single place that picks it — compiled-DAG and serve ring
+    directories must land on the same filesystem."""
+    import tempfile
+
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+def node_hosts(worker) -> dict:
+    """node id (hex) -> reachable host, from the GCS cluster view.
+    Local (unix-socket) raylets are same-machine by definition."""
+    from ray_tpu._private.ids import NodeID
+
+    info = worker.gcs_client.call("get_cluster_info")
+    hosts = {}
+    for n in info["nodes"].values():
+        addr = str(n.get("raylet_address", ""))
+        if addr.startswith("unix:") or ":" not in addr:
+            host = "127.0.0.1"
+        else:
+            host = addr.rsplit(":", 1)[0] or "127.0.0.1"
+        if host == "0.0.0.0":
+            host = "127.0.0.1"
+        hosts[NodeID(n["node_id"]).hex()] = host
+    return hosts
+
+
+def open_channel(desc: dict, role: str, timeout: float = 30.0):
+    """Open one endpoint of a planned channel.
+
+    ``desc`` is the compile-time descriptor: ``{"kind": "ring", "path"}``
+    or ``{"kind": "socket", "token", "id", "addr": (host, port)}``.
+    Socket rule: the READER bound the listener during setup (and accepts
+    here); the WRITER dials.  Dials never deadlock accepts because every
+    listener is bound before any loop starts (TCP completes the
+    handshake from the backlog).
+    """
+    if desc["kind"] == "ring":
+        return Channel(desc["path"])
+    if role == "write":
+        return dial(tuple(desc["addr"]), "write", timeout=timeout)
+    return take_listener(desc["token"], desc["id"]).accept("read", timeout=timeout)
+
+
+def write_value_fanout(
+    targets: Sequence[Tuple[Any, Any, int]], timeout: Optional[float] = None
+) -> None:
+    """Write a batch of (channel, value, tag) with fan-out overlap: each
+    blocked edge is retried round-robin via ``try_write_value`` so one
+    slow consumer never head-of-line-blocks an independent branch (the
+    graph-level scheduling rule: issue every fan-out write before
+    blocking on any single peer)."""
+    if len(targets) == 1:
+        chan, value, tag = targets[0]
+        chan.write_value(value, tag, timeout=timeout)
+        return
+    pending: List[Tuple[Any, Any, int]] = list(targets)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    spins = 0
+    while pending:
+        rest = []
+        for chan, value, tag in pending:
+            if not chan.try_write_value(value, tag):
+                rest.append((chan, value, tag))
+        if not rest:
+            return
+        pending = rest
+        spins += 1
+        if spins > 1000:
+            time.sleep(min(0.001, 0.00002 * (spins - 1000)))
+        else:
+            time.sleep(0)
+        if deadline is not None and time.monotonic() > deadline:
+            raise ChannelTimeout(
+                f"{len(pending)} fan-out peers did not consume within {timeout}s"
+            )

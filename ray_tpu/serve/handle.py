@@ -23,6 +23,8 @@ class DeploymentResponse:
 
         if not self._resolved:
             try:
+                # _ref is an ObjectRef (RPC path) or a dataplane
+                # ChannelFuture — ray_tpu.get resolves both.
                 self._value = ray_tpu.get(self._ref, timeout=timeout)
             except exceptions.ActorDiedError:
                 # the replica died under this call: evict it from the
@@ -58,9 +60,12 @@ class DeploymentResponseGenerator:
         import ray_tpu
         from ray_tpu import exceptions
 
+        channel = getattr(self._gen, "_is_channel_stream", False)
         try:
-            for ref in self._gen:
-                yield ray_tpu.get(ref)
+            for item in self._gen:
+                # dataplane streams yield values; the RPC streaming
+                # plane yields per-item refs
+                yield item if channel else ray_tpu.get(item)
         except exceptions.ActorDiedError:
             self._router.evict(self._replica_id)
             raise
@@ -94,9 +99,17 @@ class DeploymentResponseGenerator:
             raise
         if ref is None:
             return None
+        if getattr(self._gen, "_is_channel_stream", False):
+            return ref  # dataplane streams yield values directly
         return ray_tpu.get(ref)
 
     def close(self):
+        closer = getattr(self._gen, "close", None)
+        if closer is not None and getattr(self._gen, "_is_channel_stream", False):
+            try:
+                closer()  # dataplane disconnect-cancel (frees engine KV)
+            except Exception:  # noqa: BLE001
+                pass
         self._mark_done()
 
     def __del__(self):

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Compiled-DAG dataplane smoke (wired into scripts/verify.sh).
+
+End-to-end over a 2-raylet local cluster: compile a 3-actor fan-out
+graph where one branch lives on the second raylet (so one edge rides a
+persistent socket channel and the rest ride shm rings), then assert
+
+- exact results across 200 executions (both branches, fan-in order),
+- the socket transport was really selected for the remote branch,
+- local round-trip p50 under 1 ms on a multicore box (the acceptance
+  bound; relaxed to 10 ms on 1-2 core CI where the ring degrades to
+  sched_yield handoffs — ROADMAP environment note),
+- teardown unblocks every resident loop and reclaims tmpfs.
+"""
+
+import os
+import sys
+import time
+
+# sys.path[0] is scripts/; the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.add_node(num_cpus=2, resources={"edge": 2})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        class Pre:
+            def step(self, x):
+                return x + 1
+
+        @ray_tpu.remote
+        class LocalBranch:
+            def double(self, x):
+                return x * 2
+
+        @ray_tpu.remote(resources={"edge": 0.1})
+        class RemoteBranch:
+            def square(self, x):
+                return x * x
+
+        pre = Pre.bind()
+        with InputNode() as inp:
+            mid = pre.step.bind(inp)
+            dag = MultiOutputNode(
+                [LocalBranch.bind().double.bind(mid),
+                 RemoteBranch.bind().square.bind(mid)]
+            )
+        compiled = dag.experimental_compile(max_inflight=16)
+        assert compiled._channels_on, "graph fell back to the task path"
+        kinds = {d["kind"] for d in compiled._descs.values()}
+        assert "socket" in kinds, f"no socket edge selected: {kinds}"
+        assert "ring" in kinds, f"no ring edge selected: {kinds}"
+
+        ray_tpu.get(compiled.execute(0))  # warm: loops resident
+        lat = []
+        for i in range(200):
+            t0 = time.perf_counter()
+            out = ray_tpu.get(compiled.execute(i))
+            lat.append(time.perf_counter() - t0)
+            assert out == [(i + 1) * 2, (i + 1) ** 2], (i, out)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        bound = 0.001 if (os.cpu_count() or 1) > 2 else 0.010
+        # NOTE: the fan-out p50 includes the socket branch round-trip;
+        # this is the graph-level bound, not the ring-only one.
+        assert p50 < bound * 5, f"fan-out round-trip p50 {p50 * 1e3:.2f} ms"
+
+        # ring-only p50 must be sub-ms on multicore (acceptance bound)
+        with InputNode() as inp:
+            ldag = LocalBranch.bind().double.bind(inp)
+        lcompiled = ldag.experimental_compile()
+        ray_tpu.get(lcompiled.execute(0))
+        llat = []
+        for i in range(200):
+            t0 = time.perf_counter()
+            assert ray_tpu.get(lcompiled.execute(i)) == i * 2
+            llat.append(time.perf_counter() - t0)
+        llat.sort()
+        lp50 = llat[len(llat) // 2]
+        assert lp50 < bound, f"local round-trip p50 {lp50 * 1e3:.3f} ms >= {bound * 1e3} ms"
+
+        stats = compiled.stats()
+        assert stats["executions"] == 201 and stats["inflight"] == 0
+        chan_dir = compiled._chan_dir
+        compiled.teardown()
+        lcompiled.teardown()
+        assert not os.path.exists(chan_dir), "tmpfs ring dir leaked"
+        print(
+            f"compiled_dag_smoke ok: fan-out p50 {p50 * 1e3:.2f} ms, "
+            f"local p50 {lp50 * 1e3:.3f} ms, socket+ring edges exact over 200 runs"
+        )
+        return 0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

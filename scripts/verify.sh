@@ -70,6 +70,18 @@ if [ "${RAY_TPU_SKIP_SERVE_LLM_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Compiled-DAG smoke (zero-copy dataplane end-to-end): 2-raylet cluster,
+# 3-actor fan-out with one socket edge + shm rings, exact results over
+# 200 executions, sub-ms local round-trip p50 (multicore), teardown
+# reclaims tmpfs.  Skippable via RAY_TPU_SKIP_DAG_SMOKE=1.
+if [ "${RAY_TPU_SKIP_DAG_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      python scripts/compiled_dag_smoke.py; then
+    echo "compiled dag smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Profiling smoke (bottleneck-attribution plane end-to-end): actor under
 # load, attach the sampling profiler, assert a non-empty merged
 # flamegraph with the workload visible and valid speedscope output.

@@ -24,34 +24,54 @@ def ray():
 
 def test_channel_roundtrip(tmp_path):
     p = str(tmp_path / "c1")
-    with open(p, "wb") as f:
-        f.truncate(32 + 1024)
+    Channel.create_file(p, 1024)
     w, r = Channel(p), Channel(p)
     w.write(b"hello")
     assert r.read() == b"hello"
-    w.write(b"world")  # ack allowed the second write
+    w.write(b"world")
     assert r.read() == b"world"
+
+
+def test_channel_multiple_inflight(tmp_path):
+    """The ring holds many messages at once (pipelined executions)."""
+    p = str(tmp_path / "c1b")
+    Channel.create_file(p, 4096)
+    w, r = Channel(p), Channel(p)
+    for i in range(10):
+        w.write(f"msg{i}".encode(), timeout=1)
+    assert [r.read() for _ in range(10)] == [f"msg{i}".encode() for i in range(10)]
 
 
 def test_channel_flow_control(tmp_path):
     p = str(tmp_path / "c2")
-    with open(p, "wb") as f:
-        f.truncate(32 + 1024)
+    Channel.create_file(p, 1024)
     w, r = Channel(p), Channel(p)
-    w.write(b"a")
+    w.write(b"x" * 700)
     with pytest.raises(ChannelTimeout):
-        w.write(b"b", timeout=0.3)  # reader hasn't consumed
-    assert r.read() == b"a"
-    w.write(b"b", timeout=5)
-    assert r.read() == b"b"
+        w.write(b"y" * 700, timeout=0.3)  # ring full, reader hasn't consumed
+    assert r.read() == b"x" * 700
+    w.write(b"y" * 700, timeout=5)
+    assert r.read() == b"y" * 700
 
 
 def test_channel_poison(tmp_path):
     p = str(tmp_path / "c3")
-    with open(p, "wb") as f:
-        f.truncate(32 + 1024)
+    Channel.create_file(p, 1024)
     w, r = Channel(p), Channel(p)
     w.close()
+    with pytest.raises(ChannelClosed):
+        r.read(timeout=5)
+
+
+def test_channel_drains_before_close(tmp_path):
+    """close() is drain-then-close: buffered messages stay readable,
+    the reader sees ChannelClosed only after consuming the backlog."""
+    p = str(tmp_path / "c4")
+    Channel.create_file(p, 1024)
+    w, r = Channel(p), Channel(p)
+    w.write(b"last words")
+    w.close()
+    assert r.read(timeout=5) == b"last words"
     with pytest.raises(ChannelClosed):
         r.read(timeout=5)
 
@@ -207,14 +227,314 @@ def test_compiled_teardown_cleans_tmpfs():
     assert not os.path.exists(chan_dir)  # tmpfs reclaimed
 
 
-def test_function_node_falls_back_to_task_path():
+def test_function_node_compiles_to_executor_loop():
+    """Driver-side FunctionNodes ride the channel plane too: each one is
+    hosted by a resident _FnExecutor actor instead of taking the
+    per-call task path."""
+
     @ray_tpu.remote
     def plain(x):
         return x + 1
 
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
     with InputNode() as inp:
-        dag = plain.bind(inp)
+        dag = double.bind(plain.bind(inp))
+    compiled = dag.experimental_compile()
+    assert compiled._channels_on  # no task-path fallback anymore
+    assert [ray_tpu.get(compiled.execute(i)) for i in range(4)] == [2, 4, 6, 8]
+    compiled.teardown()
+
+
+def test_mixed_function_and_actor_graph_compiles():
+    """A FunctionNode feeding an actor method (and vice versa) is one
+    compiled graph spanning executor + user actors."""
+
+    @ray_tpu.remote
+    def pre(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Scale:
+        def __init__(self, k):
+            self.k = k
+
+        def mul(self, x):
+            return x * self.k
+
+    @ray_tpu.remote
+    def post(x):
+        return x - 3
+
+    with InputNode() as inp:
+        dag = post.bind(Scale.bind(10).mul.bind(pre.bind(inp)))
+    compiled = dag.experimental_compile()
+    assert compiled._channels_on
+    assert ray_tpu.get(compiled.execute(4)) == 47  # (4+1)*10-3
+    assert ray_tpu.get(compiled.execute(0)) == 7
+    compiled.teardown()
+
+
+def test_kwargs_fall_back_to_task_path():
+    """Graphs outside the op schedule's vocabulary still execute via the
+    per-node task path."""
+
+    @ray_tpu.remote
+    def f(x, k=1):
+        return x + k
+
+    with InputNode() as inp:
+        dag = f.bind(inp, k=5)
     compiled = dag.experimental_compile()
     assert not compiled._channels_on
-    assert ray_tpu.get(compiled.execute(41)) == 42
+    assert ray_tpu.get(compiled.execute(10)) == 15
     compiled.teardown()
+
+
+# ---------------------------------------------------------------------------
+# Channel edge cases (ring + socket + wire format)
+
+
+def test_ring_wraparound_under_sustained_load(tmp_path):
+    """Thousands of variable-size messages through a small ring: the
+    write position wraps the region many times and every payload
+    survives byte-exact (wrap markers + implicit tail skips)."""
+    import threading
+
+    p = str(tmp_path / "wrap")
+    Channel.create_file(p, 4096)
+    w, r = Channel(p), Channel(p)
+    n = 1500
+    payloads = [bytes([i % 251]) * (1 + (i * 37) % 900) for i in range(n)]
+    errs = []
+
+    def writer():
+        try:
+            for pl in payloads:
+                w.write(pl, timeout=30)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    for i in range(n):
+        assert r.read(timeout=30) == payloads[i], f"payload {i} corrupted"
+    t.join(10)
+    assert not errs
+    assert w.stats["writes"] == n and r.stats["reads"] == n
+    assert w._get(0) > 4096  # really wrapped (wbytes past capacity)
+
+
+def test_ring_value_wraparound_mixed_types(tmp_path):
+    """write_value/read_value across wrap boundaries with every
+    fast-path type mixed (encode-in-place must handle tail-bounded
+    windows by wrapping, reader must skip markers)."""
+    import numpy as np
+
+    p = str(tmp_path / "wrapv")
+    Channel.create_file(p, 2048)
+    w, r = Channel(p), Channel(p)
+    vals = []
+    for i in range(300):
+        vals.append(
+            [i, float(i), f"s{i}" * (i % 20), {"k": i}, np.arange(i % 40)][i % 5]
+        )
+    import threading
+
+    t = threading.Thread(
+        target=lambda: [w.write_value(v, timeout=30) for v in vals], daemon=True
+    )
+    t.start()
+    for i, expect in enumerate(vals):
+        tag, got = r.read_value(timeout=30)
+        assert tag == 0
+        if isinstance(expect, np.ndarray):
+            assert (got == expect).all()
+        else:
+            assert got == expect, i
+    t.join(10)
+
+
+def test_payload_larger_than_ring_is_typed_error_not_hang(tmp_path):
+    from ray_tpu.experimental.channel import ChannelCapacityError
+
+    p = str(tmp_path / "cap")
+    Channel.create_file(p, 1024)
+    w, r = Channel(p), Channel(p)
+    with pytest.raises(ChannelCapacityError):
+        w.write(b"x" * 5000, timeout=5)
+    with pytest.raises(ChannelCapacityError):
+        w.write_value(b"x" * 5000, timeout=5)
+    # the ring stays coherent after the refused writes
+    w.write_value({"ok": 1})
+    assert r.read_value() == (0, {"ok": 1})
+
+
+def test_reader_timeout_vs_writer_death_detection(tmp_path):
+    """Ring: a silent writer is indistinguishable from a dead one —
+    reads raise ChannelTimeout.  Socket: writer death is detected
+    immediately as ChannelClosed (EOF), no timeout burned."""
+    import threading
+
+    from ray_tpu.experimental.channel import SocketListener, dial
+
+    # ring: timeout (peer alive but silent)
+    p = str(tmp_path / "silent")
+    Channel.create_file(p, 1024)
+    r = Channel(p)
+    t0 = time.monotonic()
+    with pytest.raises(ChannelTimeout):
+        r.read(timeout=0.3)
+    assert time.monotonic() - t0 >= 0.25
+
+    # socket: death -> ChannelClosed well before any read timeout
+    lst = SocketListener()
+    out = {}
+
+    def reader():
+        ch = lst.accept("read", timeout=5)
+        out["first"] = ch.read_value(timeout=5)
+        t1 = time.monotonic()
+        try:
+            ch.read_value(timeout=30)
+        except ChannelClosed:
+            out["death_latency"] = time.monotonic() - t1
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    wch = dial(("127.0.0.1", lst.port), "write", timeout=5)
+    wch.write_value("alive")
+    time.sleep(0.2)
+    wch._sock.close()  # simulate writer process death: RST/EOF, no poison
+    t.join(10)
+    assert out["first"] == (0, "alive")
+    assert out["death_latency"] < 5.0  # detected, not timed out at 30s
+
+
+def test_socket_reconnect_refused_semantics(tmp_path):
+    """A compiled edge's listener accepts exactly one connection; once
+    consumed (or dead), a new dial is refused with the typed error —
+    silent reconnects could drop in-flight messages."""
+    import threading
+
+    from ray_tpu.experimental.channel import (
+        ChannelConnectionError,
+        SocketListener,
+        dial,
+    )
+
+    lst = SocketListener()
+    got = {}
+
+    def reader():
+        ch = lst.accept("read", timeout=5)
+        got["v"] = ch.read_value(timeout=5)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    w = dial(("127.0.0.1", lst.port), "write", timeout=5)
+    w.write_value(123)
+    t.join(10)
+    assert got["v"] == (0, 123)
+    with pytest.raises(ChannelConnectionError):
+        dial(("127.0.0.1", lst.port), "write", timeout=0.8)
+    w.close()
+
+
+def test_socket_poison_close_vs_flow_control(tmp_path):
+    """Orderly close drains buffered frames first (like the ring), and
+    the unacked window applies backpressure per CONSUMED message."""
+    import threading
+
+    from ray_tpu.experimental.channel import SocketChannel, SocketListener, dial
+
+    lst = SocketListener()
+    res = {}
+
+    def reader():
+        ch = lst.accept("read", timeout=5)
+        time.sleep(0.4)  # let the writer fill its window
+        vals = []
+        try:
+            while True:
+                vals.append(ch.read_value(timeout=5)[1])
+        except ChannelClosed:
+            res["vals"] = vals
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    w = dial(("127.0.0.1", lst.port), "write", timeout=5)
+    for i in range(w._window):
+        w.write_value(i, timeout=5)
+    # window full + reader asleep: the next write must block
+    with pytest.raises(ChannelTimeout):
+        w.write_value(99, timeout=0.15)
+    w.close()  # poison after the buffered frames
+    t.join(10)
+    assert res["vals"] == list(range(w._window))
+
+
+def test_wire_roundtrip_property():
+    """Property-style round-trip over the full fast-path type lattice +
+    pickle fallback: decode(encode(v)) == v with types preserved."""
+    import numpy as np
+
+    from ray_tpu._private import wire
+
+    cases = [
+        None, True, False, 0, 1, -1, 2**62, -(2**62), 2**100, -(2**100),
+        0.0, -1.5, float("inf"), 3.141592653589793,
+        b"", b"\x00\xff" * 100, "", "ascii", "unicodé ☃", "x" * 10_000,
+        (), (1,), (1, "two", 3.0, None, True), ((1, 2), (3, (4, 5))),
+        [], [1, 2, 3], [[1], [2.0], ["3"]],
+        {}, {"a": 1}, {"nested": {"k": [1, 2, {"deep": "v"}]}},
+        {1: "int-key", "mixed": (1, b"b")},
+        # fallback territory
+        set([1, 2, 3]), frozenset("ab"), complex(1, 2), range(5),
+        {"deep": {"deep": {"deep": {"deep": {"deep": 1}}}}},  # depth > 4
+        tuple(range(100)),  # > MAX_ELEMS
+        Exception("boom"),
+    ]
+    for v in cases:
+        tag, out = wire.decode(memoryview(wire.encode(v, tag=1)))
+        assert tag == 1
+        if isinstance(v, Exception):
+            assert type(out) is type(v) and out.args == v.args
+        elif isinstance(v, float) and v != v:
+            assert out != out
+        else:
+            assert out == v and type(out) is type(v), v
+    # numpy arrays: dtype/shape/content exact, zero-dim and F-order too
+    arrs = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array(7, dtype=np.int8),
+        np.zeros((0, 3), dtype=np.float64),
+        np.asfortranarray(np.arange(6).reshape(2, 3)),
+        np.array([True, False]),
+        np.arange(4, dtype=np.complex128),
+    ]
+    for a in arrs:
+        tag, out = wire.decode(memoryview(wire.encode(a)))
+        assert tag == 0 and out.dtype == a.dtype and out.shape == a.shape
+        assert (out == a).all()
+    # NaN array content
+    tag, out = wire.decode(memoryview(wire.encode(np.array([float("nan")]))))
+    assert np.isnan(out).all()
+
+
+def test_wire_error_tag_roundtrip():
+    """TAG_ERROR + RayTaskError (the loop's error envelope) survives the
+    wire through the pickle fallback."""
+    from ray_tpu import exceptions
+    from ray_tpu._private import serialization, wire
+
+    try:
+        raise ValueError("original")
+    except ValueError as e:
+        err = exceptions.RayTaskError.from_exception(e, "compiled_dag.m")
+    tag, out = wire.decode(memoryview(wire.encode(err, tag=serialization.TAG_ERROR)))
+    assert tag == serialization.TAG_ERROR
+    with pytest.raises(ValueError, match="original"):
+        raise out.as_instanceof_cause()

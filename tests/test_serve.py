@@ -366,3 +366,46 @@ def test_streaming_local_testing_mode(serve_cluster):
 
     h = serve.run(LocalGen.bind(), _local_testing_mode=True)
     assert list(h.options(stream=True).remote(3)) == [0, 1, 2]
+
+
+def test_channel_dataplane_engaged_and_exact(serve_cluster):
+    """The router→replica hot path rides compiled channels: calls and
+    token streams go through the per-replica ChannelClient (no per-call
+    RPC, no per-token object-store items) with exact results, errors
+    surfacing as their original type, and the disconnect-cancel contract
+    intact."""
+    from ray_tpu.serve._private.dataplane import ChannelClient, ChannelStream
+    from ray_tpu.serve._private.router import _routers
+
+    @serve.deployment(name="DataplaneDep")
+    class DataplaneDep:
+        def __call__(self, payload):
+            if payload == "boom":
+                raise ValueError("boom")
+            return {"echo": payload}
+
+        def tokens(self, n):
+            for i in range(n):
+                yield {"tok": i}
+
+    h = serve.run(DataplaneDep.bind(), name="dataplane_dep")
+    assert h.remote({"a": 1}).result(timeout=30) == {"echo": {"a": 1}}
+    router = _routers[h.deployment_name]
+    dps = [v for v in router._dataplanes.values() if isinstance(v, ChannelClient)]
+    assert dps, "dataplane did not attach"
+    # streams multiplex over the same channel pair
+    gen = h.options(stream=True).tokens.remote(6)
+    assert isinstance(gen._gen, ChannelStream)
+    assert list(gen) == [{"tok": i} for i in range(6)]
+    # errors keep their original type across the channel boundary
+    with pytest.raises(ValueError):
+        h.remote("boom").result(timeout=30)
+    # concurrent streams interleave on one channel without crosstalk
+    gens = [h.options(stream=True).tokens.remote(4) for _ in range(8)]
+    outs = [list(g) for g in gens]
+    assert all(o == [{"tok": i} for i in range(4)] for o in outs)
+    # early close sends the cancel frame and releases the waiter slot
+    g = h.options(stream=True).tokens.remote(1000)
+    g.close()
+    dp = dps[0]
+    assert not dp.dead

@@ -186,6 +186,40 @@ def test_preemption_victim_order():
     assert [j["tenant"] for j in ordered] == ["over", "big", "small"]
 
 
+def test_fair_dispatch_order():
+    """The raylet-mediated dispatch queue's ordering rule (carried PR 6
+    follow-up): (priority, FIFO) within a tenant, round-robin across
+    tenants ascending dominant share."""
+    totals = {"CPU": 8.0}
+    usage = {"hog": {"CPU": 6.0}, "light": {"CPU": 1.0}}
+    # entries: (tenant, priority, seq, item)
+    entries = [
+        ("hog", 0, 1, "h1"),
+        ("hog", 0, 2, "h2"),
+        ("hog", 5, 3, "h3-prio"),
+        ("light", 0, 4, "l1"),
+        ("light", 0, 5, "l2"),
+    ]
+    out = tenants_mod.fair_dispatch_order(entries, usage, totals, {})
+    # light (lower share) leads each round; hog's high-priority task
+    # jumps hog's own FIFO but NOT light's turn.
+    assert out == ["l1", "h3-prio", "l2", "h1", "h2"]
+    # weight raises effective fair share: a weighted hog wins the tie
+    specs = {"hog": tenants_mod.TenantSpec("hog", weight=10.0)}
+    out = tenants_mod.fair_dispatch_order(entries, usage, totals, specs)
+    assert out[0] == "h3-prio"
+    # empty usage: pure (priority, FIFO) interleave, deterministic
+    assert tenants_mod.fair_dispatch_order([], {}, totals, {}) == []
+
+
+def test_fair_dispatch_order_single_tenant_is_priority_fifo():
+    """Degenerate case (one job/tenant): ordering reduces to the queue's
+    existing (priority, FIFO) semantics — no behavior change."""
+    entries = [("t", 0, 1, "a"), ("t", 2, 2, "b"), ("t", 0, 3, "c")]
+    out = tenants_mod.fair_dispatch_order(entries, {}, {"CPU": 4.0}, {})
+    assert out == ["b", "a", "c"]
+
+
 def test_tenant_label_bounded():
     assert tenants_mod.tenant_label("teamA", {"teamA"}) == "teamA"
     assert tenants_mod.tenant_label("randomX", {"teamA"}) == "other"
