@@ -276,6 +276,56 @@ def bench_compiled_actor_pipelined(n=4000, depth=32) -> float:
     return out
 
 
+def bench_execute_many(n=4096, k=64) -> float:
+    """Batched submissions: K executions per channel write per edge
+    (execute_many), drained batch-by-batch.  The like-for-like single
+    comparator is bench_compiled_actor_pipelined at the same depth —
+    the `vs_single` stamp below measures exactly the per-message wire
+    overhead the batching amortizes (trajectory-fragment / weight-
+    broadcast shaped traffic)."""
+    compiled = _compile_echo(max_inflight=k * 2)
+
+    def run():
+        start = time.perf_counter()
+        prev = None
+        for base in range(0, n, k):
+            refs = compiled.execute_many(list(range(base, base + k)))
+            if prev is not None:
+                for r in prev:
+                    ray_tpu.get(r)
+            prev = refs
+        for r in prev:
+            ray_tpu.get(r)
+        return time.perf_counter() - start
+
+    out = n / timeit(run)
+    compiled.teardown()
+    return out
+
+
+def bench_compiled_single_depth_k(n=4096, k=64) -> float:
+    """The single-execute comparator for bench_execute_many: identical
+    pipeline depth and get cadence, one channel write per execution."""
+    compiled = _compile_echo(max_inflight=k * 2)
+
+    def run():
+        start = time.perf_counter()
+        prev = None
+        for base in range(0, n, k):
+            refs = [compiled.execute(i) for i in range(base, base + k)]
+            if prev is not None:
+                for r in prev:
+                    ray_tpu.get(r)
+            prev = refs
+        for r in prev:
+            ray_tpu.get(r)
+        return time.perf_counter() - start
+
+    out = n / timeit(run)
+    compiled.teardown()
+    return out
+
+
 def bench_compiled_socket_roundtrip(n=1000) -> dict:
     """Cross-host (separate-raylet) compiled edge: the same echo DAG
     with the actor pinned to a second node, so every hop rides a
@@ -340,6 +390,10 @@ BENCHES = [
     ("compiled_local_roundtrip_p50_ms", bench_compiled_roundtrip_p50_ms, "ms", None),
     ("compiled_local_roundtrip_p99_ms", bench_compiled_roundtrip_p99_ms, "ms", None),
     ("compiled_actor_calls_per_s_pipelined", bench_compiled_actor_pipelined, "calls/s", None),
+    # execute_many (ROADMAP item 1 remainder): K executions per channel
+    # write; vs_single stamped against the depth-matched single path.
+    ("compiled_calls_per_s_single_depth64", bench_compiled_single_depth_k, "calls/s", None),
+    ("compiled_calls_per_s_execute_many_k64", bench_execute_many, "calls/s", None),
 ]
 
 
@@ -390,6 +444,13 @@ def main():
         if comp and sync and sync["value"]:
             comp["vs_uncompiled"] = round(comp["value"] / sync["value"], 2)
             print(json.dumps(comp), flush=True)
+
+    # execute_many vs the depth-matched single-execute path, this run
+    single = results.get("compiled_calls_per_s_single_depth64")
+    many = results.get("compiled_calls_per_s_execute_many_k64")
+    if single and many and single["value"]:
+        many["vs_single"] = round(many["value"] / single["value"], 2)
+        print(json.dumps(many), flush=True)
 
     # cross-host socket edge: its own 2-node cluster, after the main one
     if not args.only or "socket" in args.only:

@@ -157,6 +157,70 @@ def _instrument(runner, learner_group):
     return t
 
 
+def _make_cartpole_podracer_cfg():
+    """Podracer cartpole, like-for-like with the sync config's update
+    schedule: the same 4096-step × 1024-minibatch × 4-epoch fused
+    update, fed by 4 streaming runners × 32 envs.  Env stepping,
+    inference, and (now in-jit) GAE run in parallel runner processes
+    instead of serialized with the update — this is the profile shape
+    (update itself cheap, everything else overhead) where the podracer
+    split pays on ANY box."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    return (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=4,
+            num_envs_per_env_runner=32,
+            rollout_fragment_length=32,
+        )
+        .podracer()
+        .training(lr=3e-4, train_batch_size=4096, minibatch_size=1024, num_epochs=4)
+    )
+
+
+def _make_pong_podracer_cfg(algo: str = "ppo"):
+    """The podracer restructure of pong_scale: 2 streaming env-runner
+    actors × 16 vector envs over compiled channels into the fused
+    learner (docs/rllib.md).  Like-for-like with the sync config: same
+    env, same Nature-CNN model, same total train_batch_size per update."""
+    model = {
+        "conv_filters": ((32, 8, 4), (64, 4, 2), (64, 3, 1)),
+        "hidden": (512,),
+        "vf_share_layers": True,
+    }
+    if algo == "impala":
+        from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+        return (
+            IMPALAConfig()
+            .environment(env_creator=lambda: _RandomImageEnv())
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=16)
+            .podracer()
+            .training(lr=2.5e-4, rollout_fragment_length=32, model=model)
+        )
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    return (
+        PPOConfig()
+        .environment(env_creator=lambda: _RandomImageEnv())
+        .env_runners(
+            num_env_runners=2,
+            num_envs_per_env_runner=16,
+            rollout_fragment_length=32,
+        )
+        .podracer()
+        .training(
+            lr=2.5e-4,
+            train_batch_size=2048,
+            minibatch_size=512,
+            num_epochs=2,
+            model=model,
+        )
+    )
+
+
 def bench_config(name: str, cfg, iters: int = 3) -> dict:
     import jax
 
@@ -185,7 +249,51 @@ def bench_config(name: str, cfg, iters: int = 3) -> dict:
     }
 
 
-def main() -> dict:
+def bench_podracer_config(name: str, cfg, iters: int = 6, warmup: int = 2) -> dict:
+    """Podracer plane throughput: steady-state env-steps/s consumed by
+    the learner off the streaming fragments.  The phase split of the
+    sync bench is replaced by the plane's own attribution: the learner's
+    idle fraction and the queue occupancy say which side bounds."""
+    algo = cfg.build()
+    for _ in range(warmup):
+        algo.train()
+    drv = algo._podracer
+    t0 = time.perf_counter()
+    steps = 0
+    out = {}
+    for _ in range(iters):
+        out = algo.train()
+        steps += out["num_env_steps_sampled"]
+    wall = time.perf_counter() - t0
+    plane = drv.metrics()
+    algo.cleanup()
+    return {
+        "config": name,
+        "env_steps_per_sec": round(steps / wall, 1),
+        "steps": steps,
+        "wall_s": round(wall, 3),
+        "weight_generation": plane["weight_generation"],
+        "stale_fragments_dropped": plane["stale_fragments_dropped"],
+        "fragments_received": plane["fragments_received"],
+        "trajectory_queue_depth_at_end": plane["trajectory_queue_depth"],
+        "runner_deaths": plane["runner_deaths"],
+    }
+
+
+def best_of(fn, n: int) -> dict:
+    """Best-of-N like-for-like capture (the 1-core CI box swings
+    multi-process numbers 2-5x run-to-run; every record carries all N
+    runs so the spread is visible)."""
+    runs = [fn() for _ in range(n)]
+    best = max(runs, key=lambda r: r["env_steps_per_sec"])
+    best["best_of"] = n
+    best["runs_env_steps_per_sec"] = [r["env_steps_per_sec"] for r in runs]
+    return best
+
+
+def main(repeat: int = 2) -> dict:
+    import os
+
     import jax
 
     try:
@@ -194,15 +302,55 @@ def main() -> dict:
         jax.config.update("jax_platforms", "")
     from bench_common import provenance
 
-    out = {
-        "metric": "ppo_env_steps_per_sec",
-        "unit": "env_steps/s",
-        # platform provenance first-class (on_tpu + platform): bench_gate
-        # refuses cross-platform comparisons keyed on it
-        **provenance(),
-        "cartpole": bench_config("cartpole", _make_cartpole_cfg()),
-        "pong_scale": bench_config("pong_scale", _make_pong_cfg()),
-    }
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 1))
+    try:
+        out = {
+            "metric": "ppo_env_steps_per_sec",
+            "unit": "env_steps/s",
+            # platform provenance first-class (on_tpu + platform): bench_gate
+            # refuses cross-platform comparisons keyed on it
+            **provenance(),
+            "loadavg_1m_at_capture": round(os.getloadavg()[0], 2),
+            "cartpole": best_of(
+                lambda: bench_config("cartpole", _make_cartpole_cfg()), repeat
+            ),
+            "cartpole_podracer": best_of(
+                lambda: bench_podracer_config(
+                    "cartpole_podracer", _make_cartpole_podracer_cfg(), iters=25
+                ),
+                repeat,
+            ),
+            "pong_scale": best_of(
+                lambda: bench_config("pong_scale", _make_pong_cfg()), repeat
+            ),
+            "pong_scale_podracer": best_of(
+                lambda: bench_podracer_config(
+                    "pong_scale_podracer", _make_pong_podracer_cfg("ppo"),
+                    iters=3, warmup=1,
+                ),
+                repeat,
+            ),
+            "pong_scale_impala_async": best_of(
+                lambda: bench_podracer_config(
+                    "pong_scale_impala_async", _make_pong_podracer_cfg("impala"),
+                    iters=4, warmup=1,
+                ),
+                repeat,
+            ),
+        }
+    finally:
+        ray_tpu.shutdown()
+    # the podracer restructure's like-for-like before/after, this box
+    for sync_key, pod_keys in (
+        ("pong_scale", ("pong_scale_podracer", "pong_scale_impala_async")),
+        ("cartpole", ("cartpole_podracer",)),
+    ):
+        sync = out[sync_key]["env_steps_per_sec"]
+        if sync:
+            for k in pod_keys:
+                out[k]["vs_sync"] = round(out[k]["env_steps_per_sec"] / sync, 2)
     out["value"] = out["cartpole"]["env_steps_per_sec"]
     return out
 

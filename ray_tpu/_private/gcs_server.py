@@ -96,6 +96,16 @@ class GcsServer:
         # both pass the usage check.  Entries decay after ~2 report
         # periods.
         self._tenant_admit_delta: List[Tuple[str, ResourceSet, float]] = []
+        # Charge-at-admission lease ledger (PR 6 follow-up): raylets
+        # report every lease GRANT the moment they debit resources, so
+        # the cluster quota view converges in RPC latency instead of
+        # report cadence — closing the ~1 s cross-raylet over-admission
+        # race the cooperative-revocation path existed to mop up.
+        # Node-keyed; a node's entries drop when its next resource_report
+        # lands (reconcile on report: the report then carries the lease
+        # in tenant_usage), with a time cap for nodes that die first.
+        self._lease_charges: Dict[NodeID, List[Tuple[str, ResourceSet, float]]] = {}
+        self._last_usage_publish = 0.0
         # Actors parked at admission because their tenant is over quota
         # (actor_id -> parked-since); subset of pending_actors.
         self._quota_parked: Dict[ActorID, float] = {}
@@ -433,6 +443,17 @@ class GcsServer:
         if node_id in self.nodes and self.nodes[node_id].state == "ALIVE":
             self.pending_shapes[node_id] = payload.get("pending_shapes", [])
             self.tenant_usage_by_node[node_id] = payload.get("tenant_usage", {})
+            # Reconcile the lease-admission ledger: this report's
+            # tenant_usage now carries the node's granted leases itself
+            # (the raylet charges them to its local in-flight view).
+            # Entries younger than one report period survive one cycle —
+            # a report that raced past its grant must not uncharge it.
+            entries = self._lease_charges.get(node_id)
+            if entries is not None:
+                cutoff = time.monotonic() - 0.3
+                entries[:] = [e for e in entries if e[2] > cutoff]
+                if not entries:
+                    self._lease_charges.pop(node_id, None)
             self.pending_tenant_demand[node_id] = payload.get(
                 "pending_tenant_demand", []
             )
@@ -877,6 +898,19 @@ class GcsServer:
         ]
         for tenant, res, _ts in self._tenant_admit_delta:
             tenants_mod.add_usage(usage, tenant, res)
+        # Lease-admission charges: counted until the granting node's next
+        # report carries the lease itself.  (The charging raylet briefly
+        # sees its own lease twice — ledger + live local view — which is
+        # the conservative direction: it can transiently under-admit,
+        # never over-admit.)
+        for node_id, entries in list(self._lease_charges.items()):
+            info = self.nodes.get(node_id)
+            if info is None or info.state not in ("ALIVE", "DRAINING"):
+                self._lease_charges.pop(node_id, None)
+                continue
+            entries[:] = [e for e in entries if now - e[2] < 5.0]
+            for tenant, res, _ts in entries:
+                tenants_mod.add_usage(usage, tenant, res)
         return usage
 
     def _tenant_over_quota(
@@ -897,6 +931,34 @@ class GcsServer:
     def _note_admission(self, tenant: str, res: ResourceSet):
         if res:
             self._tenant_admit_delta.append((tenant, res.copy(), time.monotonic()))
+
+    async def rpc_tenant_charge_lease(self, payload, conn):
+        """Atomic check-and-charge against the lease-admission ledger: a
+        raylet about to grant a quota'd tenant's lease asks HERE first.
+        The GCS event loop is the single serialization point, so two
+        raylets racing the same quota headroom can never both pass — the
+        cross-raylet over-admission window the cooperative-revocation
+        path existed to mop up is closed at admission time.  The charge
+        is reconciled away when the granting node's next resource_report
+        arrives carrying the lease (and time-capped for nodes that die
+        first)."""
+        if not CONFIG.tenant_quota_enforcement:
+            return {"ok": True}
+        node_id = NodeID(payload["node_id"])
+        tenant = tenants_mod.normalize_tenant(payload.get("tenant"))
+        res = ResourceSet.of(payload.get("resources") or {})
+        if not res:
+            return {"ok": True}
+        if payload.get("check") and self._tenant_over_quota(tenant, dict(res)):
+            return {"ok": False}
+        self._lease_charges.setdefault(node_id, []).append(
+            (tenant, res, time.monotonic())
+        )
+        # prompt (throttled) publish so peer raylets' own local checks
+        # converge too, not just callers of this RPC
+        if time.monotonic() - self._last_usage_publish >= 0.05:
+            self._publish_tenant_usage()
+        return {"ok": True}
 
     async def rpc_tenant_set_quota(self, payload, conn):
         """Register (or update) a tenant: quota resources, DRF weight,
@@ -956,6 +1018,7 @@ class GcsServer:
         """Broadcast the cluster-wide tenant view (usage + specs +
         totals) so raylets converge on the same DRF ordering and quota
         decisions; also exports the tenant gauges."""
+        self._last_usage_publish = time.monotonic()
         usage = self._aggregate_tenant_usage()
         totals = self._cluster_totals()
         self.publish(

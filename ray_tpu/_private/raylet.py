@@ -1455,6 +1455,36 @@ class Raylet:
     def _charge_inflight_lease(self, tenant: str, res: ResourceSet):
         self._inflight_lease_usage.setdefault(tenant, ResourceSet()).add(res)
 
+    def _tenant_quota_registered(self, tenant: str) -> bool:
+        spec = self.tenant_specs.get(tenant)
+        return bool(
+            CONFIG.tenant_quota_enforcement and spec is not None and spec.quota
+        )
+
+    async def _gcs_confirm_lease(self, tenant: str, res: ResourceSet) -> bool:
+        """Charge-at-admission: atomic check-and-charge against the GCS
+        lease-admission ledger BEFORE granting a quota'd tenant's lease.
+        The GCS loop serializes concurrent raylets' grants, closing the
+        ~1 s cross-raylet over-admission window the cooperative-
+        revocation path existed to mop up (reconcile: the charge drops
+        when this node's next resource_report carries the lease).  GCS
+        trouble → optimistic True: availability over strictness, and
+        reconciliation/revocation still bound any excess."""
+        try:
+            out = await self.gcs.call(
+                "tenant_charge_lease",
+                {
+                    "node_id": self.node_id.binary(),
+                    "tenant": tenant,
+                    "resources": dict(res),
+                    "check": True,
+                },
+                timeout=2,
+            )
+            return bool(out.get("ok", True)) if isinstance(out, dict) else True
+        except Exception:  # noqa: BLE001 — reconcile/revocation mop up
+            return True
+
     def _release_inflight_lease(self, tenant: str, res: ResourceSet):
         held = self._inflight_lease_usage.get(tenant)
         if held is not None:
@@ -1645,6 +1675,19 @@ class Raylet:
         # for usage to fall, it doesn't fail), and never spills — the
         # quota is cluster-wide, so another node can't grant it either.
         over_quota = self._tenant_over_quota(tenant, res)
+        if (
+            not over_quota
+            and not self.lease_waiters
+            and res.fits_in(self.resources_available)
+            and self._tenant_quota_registered(tenant)
+        ):
+            # About to grant a quota'd tenant: authoritative check-and-
+            # charge at the GCS ledger first (the await is an
+            # interleaving point — every grant condition is re-checked
+            # below; a charge stranded by a lost race reconciles away on
+            # the next report).
+            if not await self._gcs_confirm_lease(tenant, res):
+                over_quota = True
         if self.lease_waiters or over_quota or not res.fits_in(self.resources_available):
             if (
                 allow_spill
@@ -1841,7 +1884,36 @@ class Raylet:
             telemetry.observe_tenant_lease_wait(
                 self._tenant_label(w.tenant), now - w.enqueued
             )
+            if self._tenant_quota_registered(w.tenant):
+                # resources stay debited while the GCS ledger confirms;
+                # a denial unwinds and re-parks under the quota gate
+                self.loop.create_task(self._confirm_grant_waiter(w))
+            else:
+                w.fut.set_result(True)
+
+    async def _confirm_grant_waiter(self, w) -> None:
+        """Finish a fair-queue grant for a quota'd tenant: atomic
+        check-and-charge at the GCS lease-admission ledger, then release
+        the waiter.  Denied → unwind the local debit and re-park the
+        waiter (backpressure, not failure — exactly the over-quota park
+        semantics of the request path)."""
+        ok = await self._gcs_confirm_lease(w.tenant, w.res)
+        if ok and not w.fut.done():
             w.fut.set_result(True)
+            return
+        # denied, or the requester abandoned the wait: unwind
+        self.resources_available.add(w.res)
+        self._release_inflight_lease(w.tenant, w.res)
+        if not ok and not w.fut.done():
+            self.lease_waiters.append(w)
+            telemetry.count_tenant_parked(self._tenant_label(w.tenant), "quota")
+            # Denial means the GCS ledger is ahead of our published
+            # usage view: re-running the grant loop NOW would re-pick
+            # the same waiter and busy-loop deny RPCs until the publish
+            # lands — give it one publish interval.
+            self.loop.call_later(0.25, self._grant_lease_waiters)
+            return
+        self._grant_lease_waiters()
 
     async def push_return_worker_lease(self, payload, conn):
         w = self.workers.get(WorkerID(payload["worker_id"]))

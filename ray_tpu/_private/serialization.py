@@ -27,6 +27,10 @@ ALIGNMENT = 64
 TAG_NORMAL = 0
 TAG_ERROR = 1  # payload is a pickled exception to re-raise on get
 TAG_INLINE_REF = 2  # reserved
+# Compiled-DAG execute_many: the payload is a LIST carrying one entry
+# per execution (K executions amortized into one channel write per
+# edge); per-entry errors ride as RayTaskError values inside the list.
+TAG_BATCH = 3
 
 _HEADER = struct.Struct("<BI")
 _BUFLEN = struct.Struct("<Q")

@@ -309,6 +309,28 @@ class _Metrics:
             "stream items (e.g. generated tokens) returned over serve "
             "compiled channels — each one replaces an object-store hop",
         )
+        # --- podracer RLlib streaming plane (rllib/core/stream.py) ---
+        self.rllib_queue_depth = m.Gauge(
+            "rllib_trajectory_queue_depth",
+            "trajectory fragments buffered in the learner-side intake "
+            "queue — sustained full = learner-bound, empty = runner-bound",
+        )
+        self.rllib_learner_idle = m.Gauge(
+            "rllib_learner_idle_fraction",
+            "fraction of the learner loop's wall time spent waiting for "
+            "trajectory fragments since the last update",
+        )
+        self.rllib_weight_lag = m.Histogram(
+            "rllib_weight_lag_generations",
+            "weight generations a consumed fragment trailed the learner "
+            "by (off-policy staleness; bounded by max_weight_lag)",
+            boundaries=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0],
+        )
+        self.rllib_env_steps = m.Counter(
+            "rllib_env_steps_total",
+            "valid environment steps collected by streaming env runners "
+            "(counted runner-side per fragment)",
+        )
 
 
 def _metrics() -> _Metrics:
@@ -692,10 +714,10 @@ def observe_dag_op(method: str, seconds: float) -> None:
     b.observe(max(0.0, seconds))
 
 
-def count_dag_execution() -> None:
+def count_dag_execution(n: int = 1) -> None:
     if not enabled():
         return
-    _metrics().dag_executions.inc(1.0)
+    _metrics().dag_executions.inc(float(n))
 
 
 def set_dag_inflight(n: int) -> None:
@@ -712,3 +734,28 @@ def set_drain_budget(deadline_remaining_s: float, inflight_tasks: int) -> None:
     m = _metrics()
     m.drain_deadline_remaining.set(max(0.0, deadline_remaining_s))
     m.drain_inflight_tasks.set(float(inflight_tasks))
+
+
+def set_rllib_queue_depth(n: int) -> None:
+    if not enabled():
+        return
+    _metrics().rllib_queue_depth.set(float(n))
+
+
+def set_rllib_learner_idle(fraction: float) -> None:
+    if not enabled():
+        return
+    _metrics().rllib_learner_idle.set(min(1.0, max(0.0, fraction)))
+
+
+def observe_rllib_weight_lag(generations: int) -> None:
+    if not enabled():
+        return
+    _metrics().rllib_weight_lag.observe(max(0.0, float(generations)))
+
+
+def count_rllib_env_steps(n: int) -> None:
+    """Batched: runners count once per fragment, not per env step."""
+    if not enabled() or n <= 0:
+        return
+    _metrics().rllib_env_steps.inc(float(n))

@@ -281,45 +281,88 @@ def _actor_channel_loop(self, ops, descs, token):
         channel_mod.drop_listeners(token)
         raise
     TAG_ERROR = serialization.TAG_ERROR
+    TAG_BATCH = serialization.TAG_BATCH
+
+    def run_op(op, args):
+        """One op execution; returns (result, tag) — errors become
+        values that flow downstream like results."""
+        try:
+            t0 = _time.perf_counter()
+            if "fn" in op:
+                result = self._dag_fns[op["fn"]](*args)
+            else:
+                result = getattr(self, op["method"])(*args)
+            telemetry.observe_dag_op(op["method"], _time.perf_counter() - t0)
+            return result, serialization.TAG_NORMAL
+        except ChannelClosed:
+            raise
+        except Exception as e:  # noqa: BLE001
+            return (
+                exceptions.RayTaskError.from_exception(
+                    e, f"compiled_dag.{op['method']}"
+                ),
+                TAG_ERROR,
+            )
+
     try:
         while True:
             local = {}
+            local_batched = set()  # uuids whose local result is a K-list
             for op in ops:
                 args = []
                 arg_error = None
+                batch_k = None  # execute_many: K executions in one frame
                 for kind, val in op["args"]:
                     if kind == "chan":
                         tag, v = chans[val].read_value(timeout=None)
-                        if tag == TAG_ERROR:
+                        if tag == TAG_BATCH:
+                            batch_k = len(v)
+                        elif tag == TAG_ERROR:
                             arg_error = v
-                        args.append(v)
+                        args.append((tag == TAG_BATCH, v))
                     elif kind == "local":
                         v = local[val]
-                        if isinstance(v, exceptions.RayTaskError):
-                            arg_error = v
-                        args.append(v)
+                        if val in local_batched:
+                            batch_k = len(v)
+                            args.append((True, v))
+                        else:
+                            if isinstance(v, exceptions.RayTaskError):
+                                arg_error = v
+                            args.append((False, v))
                     else:  # const
-                        args.append(val)
+                        args.append((False, val))
+                if batch_k is not None:
+                    # K executions amortized into one channel write per
+                    # edge: scalars (consts) broadcast, per-entry errors
+                    # stay entries (downstream skips only their slot).
+                    results = []
+                    for k in range(batch_k):
+                        item_args = [v[k] if b else v for b, v in args]
+                        err = next(
+                            (
+                                a
+                                for a in item_args
+                                if isinstance(a, exceptions.RayTaskError)
+                            ),
+                            None,
+                        )
+                        if err is not None:
+                            results.append(err)
+                        else:
+                            results.append(run_op(op, item_args)[0])
+                    local[op["uuid"]] = results
+                    local_batched.add(op["uuid"])
+                    if op["outs"]:
+                        channel_mod.write_value_fanout(
+                            [(chans[o], results, TAG_BATCH) for o in op["outs"]],
+                            timeout=None,
+                        )
+                    continue
+                plain_args = [v for _b, v in args]
                 if arg_error is not None:
                     result, tag = arg_error, TAG_ERROR
                 else:
-                    try:
-                        t0 = _time.perf_counter()
-                        if "fn" in op:
-                            result = self._dag_fns[op["fn"]](*args)
-                        else:
-                            result = getattr(self, op["method"])(*args)
-                        telemetry.observe_dag_op(
-                            op["method"], _time.perf_counter() - t0
-                        )
-                        tag = serialization.TAG_NORMAL
-                    except ChannelClosed:
-                        raise
-                    except Exception as e:  # noqa: BLE001
-                        result = exceptions.RayTaskError.from_exception(
-                            e, f"compiled_dag.{op['method']}"
-                        )
-                        tag = TAG_ERROR
+                    result, tag = run_op(op, plain_args)
                 local[op["uuid"]] = result
                 if op["outs"]:
                     channel_mod.write_value_fanout(
@@ -407,7 +450,7 @@ class CompiledDAG:
         # gauge (returned on drain or at teardown, so an abandoned DAG
         # can't pin the gauge elevated forever).
         self._inflight_contrib = 0
-        self._partial: List[Any] = []
+        self._out_pending: List[Any] = []  # populated at channel-plan build
         self._channels_on = False
         self._buffer_size = buffer_size_bytes
         # Flow control: the driver-side cap on executions submitted
@@ -521,6 +564,15 @@ class CompiledDAG:
         ops_by_actor: Dict[str, list] = {}
         # (cid, key-or-None) the driver writes each execute.
         self._input_chans: List[tuple] = []
+        # Input-independent source ops produce ONE frame per loop pass;
+        # execute_many's batched frames would desync their edges, so
+        # such graphs take the sequential fallback.
+        self._has_const_sources = any(
+            all(not isinstance(a, DAGNode) for a in (
+                n._bound_args[1:] if isinstance(n, ClassMethodNode) else n._bound_args
+            ))
+            for n in method_nodes
+        )
 
         for n in method_nodes:
             a_uuid = actor_of[n._stable_uuid]
@@ -652,25 +704,34 @@ class CompiledDAG:
                 channel_mod.open_channel(descs[cid], "read", timeout=30.0)
                 for cid in self._output_chans
             ]
+            import collections
+
+            # Per-output-channel pending per-execution entries: a batched
+            # frame (execute_many) expands to K entries here.
+            self._out_pending = [collections.deque() for _ in self._driver_out]
         except Exception:
             channel_mod.drop_listeners(token)
             raise
         self._channels_on = True
 
     # -- execution ------------------------------------------------------
+    @staticmethod
+    def _extract(input_val, key):
+        if key is None:
+            return input_val
+        if isinstance(key, str) and isinstance(input_val, dict):
+            return input_val[key]
+        if isinstance(key, int):
+            return input_val[key]
+        return getattr(input_val, key)
+
     def execute(self, *input_vals):
         input_val = input_vals[0] if len(input_vals) == 1 else (input_vals if input_vals else None)
         if self._channels_on:
             from ray_tpu.experimental import channel as channel_mod
 
             def extract(key):
-                if key is None:
-                    return input_val
-                if isinstance(key, str) and isinstance(input_val, dict):
-                    return input_val[key]
-                if isinstance(key, int):
-                    return input_val[key]
-                return getattr(input_val, key)
+                return self._extract(input_val, key)
 
             with self._lock:
                 if self._seq - self._next_result + 1 >= self._max_inflight:
@@ -699,6 +760,74 @@ class CompiledDAG:
                 cache[node._stable_uuid] = node._execute_one(cache, input_val, self._ctx)
         return cache[self._root._stable_uuid]
 
+    def execute_many(self, input_vals) -> List["CompiledDAGRef"]:
+        """Batch K executions into ONE channel write per input edge (and
+        one result frame per output edge): high-rate small-payload
+        traffic (trajectory fragments, weight broadcasts, router fan-in)
+        amortizes the per-message wire overhead K-fold.  Returns one
+        CompiledDAGRef per input, in order.
+
+        Falls back to K sequential ``execute`` calls for graphs the
+        batched schedule can't express: uncompiled graphs, and graphs
+        with input-independent source nodes (their single frames would
+        desync batched edges)."""
+        input_vals = list(input_vals)
+        k = len(input_vals)
+        if k == 0:
+            return []
+        if k == 1 or not self._channels_on or self._has_const_sources:
+            return [self.execute(v) for v in input_vals]
+        from ray_tpu._private import serialization, telemetry
+        from ray_tpu.experimental import channel as channel_mod
+
+        with self._lock:
+            if self._seq - self._next_result + k >= self._max_inflight:
+                raise RuntimeError(
+                    f"{k} batched executions would exceed max_inflight="
+                    f"{self._max_inflight}; ray_tpu.get earlier results first "
+                    f"(raise max_inflight at experimental_compile for deeper "
+                    f"pipelines)"
+                )
+            channel_mod.write_value_fanout(
+                [
+                    (
+                        chan,
+                        [self._extract(v, key) for v in input_vals],
+                        serialization.TAG_BATCH,
+                    )
+                    for chan, key in self._driver_in
+                ],
+                timeout=30.0,
+            )
+            telemetry.count_dag_execution(k)
+            refs = []
+            for _ in range(k):
+                self._seq += 1
+                refs.append(CompiledDAGRef(self, self._seq))
+            self._inflight_contrib += k
+            _inflight_adjust(+k)
+        return refs
+
+    def _pump_output(self, idx: int, timeout: Optional[float]) -> None:
+        """Ensure output channel ``idx`` has at least one pending
+        per-execution entry (expands batched frames to K entries)."""
+        import collections
+
+        from ray_tpu import exceptions
+        from ray_tpu._private import serialization
+
+        pending = self._out_pending[idx]
+        while not pending:
+            tag, value = self._driver_out[idx].read_value(timeout)
+            if tag == serialization.TAG_BATCH:
+                for item in value:
+                    if isinstance(item, exceptions.RayTaskError):
+                        pending.append((serialization.TAG_ERROR, item))
+                    else:
+                        pending.append((serialization.TAG_NORMAL, item))
+            else:
+                pending.append((tag, value))
+
     def _read_result(self, seq: int, timeout: Optional[float]):
         from ray_tpu import exceptions
         from ray_tpu._private import serialization
@@ -707,13 +836,13 @@ class CompiledDAG:
             drained_from = self._next_result
             try:
                 while self._next_result <= seq:
-                    # _partial survives a ChannelTimeout partway through a
-                    # multi-output read: already-consumed channels are not
-                    # re-read on retry, so results can't cross executions.
-                    while len(self._partial) < len(self._driver_out):
-                        chan = self._driver_out[len(self._partial)]
-                        self._partial.append(chan.read_value(timeout))
-                    vals, self._partial = self._partial, []
+                    # _out_pending survives a ChannelTimeout partway
+                    # through a multi-output read: already-consumed
+                    # channels keep their entries queued, so results
+                    # can't cross executions on retry.
+                    for i in range(len(self._driver_out)):
+                        self._pump_output(i, timeout)
+                    vals = [self._out_pending[i].popleft() for i in range(len(self._driver_out))]
                     if any(tag == serialization.TAG_ERROR for tag, _ in vals):
                         out = next(v for tag, v in vals if tag == serialization.TAG_ERROR)
                     else:

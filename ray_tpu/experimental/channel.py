@@ -288,11 +288,26 @@ class Channel:
         return False, True  # reader-bounded: wait for consumption
 
     def write_value(self, value: Any, tag: int = 0, timeout: Optional[float] = 30.0) -> None:
-        """Fast-path write: wire-encode ``value`` directly into the ring."""
+        """Fast-path write: wire-encode ``value`` directly into the ring.
+
+        A reader-bounded attempt partially ENCODES into the free window
+        before discovering it doesn't fit, so the blocked loop must not
+        re-attempt until the reader has actually consumed something — a
+        parked writer of a large payload would otherwise burn a core
+        re-encoding the same prefix every backoff wakeup (the podracer
+        profile found runners spending >90% of parked CPU there)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         t_block = 0.0
+        blocked_at_rb = None  # _ROFF snapshot taken BEFORE the blocked attempt
         while True:
+            rb_before = self._get(_ROFF)
+            if blocked_at_rb is not None:
+                if rb_before == blocked_at_rb:
+                    spins += 1
+                    self._write_wait(spins, t_block, deadline)
+                    continue
+                blocked_at_rb = None
             published, blocked = self._try_publish_value(value, tag)
             if published:
                 if spins:
@@ -301,6 +316,12 @@ class Channel:
             if blocked:
                 if spins == 0:
                     t_block = time.monotonic()
+                # The pre-attempt snapshot is the race-safe anchor: a
+                # reader advance DURING the attempt leaves _ROFF !=
+                # rb_before, so the gate above retries immediately
+                # instead of waiting on a ring the reader has already
+                # drained (which would never advance again).
+                blocked_at_rb = rb_before
                 spins += 1
                 self._write_wait(spins, t_block, deadline)
 
@@ -531,6 +552,13 @@ class SocketChannel:
         self.role = role
         self.path = f"socket:{sock.getpeername()}"
         self._sock = sock
+        # A dialed socket inherits create_connection's CONNECT timeout;
+        # left in place it would make every later sendall of a frame
+        # larger than the kernel buffers raise socket.timeout (read as
+        # ChannelClosed) when the peer is slow to drain.  Steady-state
+        # blocking is governed by the ack-window flow control, not a
+        # per-syscall timeout.
+        self._sock.settimeout(None)
         self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         self._window = max(1, window)
         self._unacked = 0

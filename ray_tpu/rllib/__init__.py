@@ -22,8 +22,10 @@ from ray_tpu.rllib.connectors import (
     FlattenObservations,
     NormalizeObservations,
 )
+from ray_tpu.rllib.core.inference import InferenceServer
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import QModule, RLModule, RLModuleSpec
+from ray_tpu.rllib.core.stream import PodracerDriver, TrajectoryPlane
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 from ray_tpu.rllib.env.multi_agent_env import (
@@ -64,8 +66,11 @@ __all__ = [
     "FlattenObservations",
     "NormalizeObservations",
     "ClipActions",
+    "InferenceServer",
     "Learner",
     "LearnerGroup",
+    "PodracerDriver",
+    "TrajectoryPlane",
     "RLModule",
     "RLModuleSpec",
     "QModule",

@@ -48,6 +48,17 @@ class AlgorithmConfig:
         # Policy-inference device for env runners ("cpu" keeps per-step
         # calls off the learner's chip; "" follows the JAX default).
         self.inference_backend = "cpu"
+        # podracer streaming plane (core/stream.py): env runners stream
+        # fixed-shape trajectory fragments over compiled channels into
+        # the learner instead of synchronous sample()/get() round-trips.
+        self.podracer_enabled = False
+        # "anakin": action selection inside the runner's jitted step
+        # (cheap envs/policies); "sebulba": a shared continuous-batching
+        # inference server actor (heavy policies).
+        self.policy_mode = "anakin"
+        self.max_weight_lag = 4  # generations a fragment may trail the learner
+        self.broadcast_interval = 1  # learner updates between weight publishes
+        self.trajectory_queue_size = 8  # fragments buffered learner-side
         # Connector pipelines applied in every env runner (reference:
         # config.env_runners(env_to_module_connector=...)).  Stateful
         # connector state lives per-runner and is not checkpointed.
@@ -106,6 +117,27 @@ class AlgorithmConfig:
             self.num_cpus_per_env_runner = num_cpus_per_env_runner
         if restart_failed_env_runners is not None:
             self.restart_failed_env_runners = restart_failed_env_runners
+        return self
+
+    def podracer(self, *, enabled: bool = True, policy_mode: Optional[str] = None,
+                 max_weight_lag: Optional[int] = None,
+                 broadcast_interval: Optional[int] = None,
+                 trajectory_queue_size: Optional[int] = None):
+        """Enable the podracer streaming plane (sebulba/anakin split;
+        PAPERS.md 'Podracer architectures for scalable RL'): env runners
+        stream trajectory fragments asynchronously over compiled-DAG
+        channels; the learner never waits on a rollout round-trip."""
+        self.podracer_enabled = enabled
+        if policy_mode is not None:
+            if policy_mode not in ("anakin", "sebulba"):
+                raise ValueError(f"policy_mode must be anakin|sebulba, got {policy_mode!r}")
+            self.policy_mode = policy_mode
+        if max_weight_lag is not None:
+            self.max_weight_lag = max_weight_lag
+        if broadcast_interval is not None:
+            self.broadcast_interval = broadcast_interval
+        if trajectory_queue_size is not None:
+            self.trajectory_queue_size = trajectory_queue_size
         return self
 
     def training(self, **kwargs):
@@ -223,6 +255,8 @@ class Algorithm(Trainable):
             conv_filters=cfg.model.get("conv_filters"),
         )
         probe_env.close()
+        if cfg.podracer_enabled:
+            return self._setup_podracer(env_creator)
         self.env_runner_group = EnvRunnerGroup(
             env_creator,
             self.module_spec,
@@ -248,6 +282,54 @@ class Algorithm(Trainable):
             resources={"num_cpus": cfg.num_cpus_per_learner},
         )
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._timesteps_total = 0
+
+    def _setup_podracer(self, env_creator):
+        """Podracer plane: TrajectoryPlane (streaming env runners over
+        compiled channels) + local learner + PodracerDriver; replaces
+        the synchronous EnvRunnerGroup entirely (the plane duck-types
+        the group surface the driver touches)."""
+        from ray_tpu.rllib.core.stream import PodracerDriver, TrajectoryPlane
+
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            raise ValueError("the podracer plane requires a local learner (num_learners=0)")
+        inference_handle = None
+        if cfg.policy_mode == "sebulba":
+            import ray_tpu
+
+            from ray_tpu.rllib.core.inference import InferenceServer
+
+            inference_handle = ray_tpu.remote(num_cpus=1)(InferenceServer).remote(
+                self.module_spec, cfg.seed
+            )
+        self.env_runner_group = TrajectoryPlane(
+            env_creator,
+            self.module_spec,
+            num_env_runners=max(1, cfg.num_env_runners),
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            fragment_length=cfg.rollout_fragment_length,
+            seed=cfg.seed,
+            num_cpus_per_runner=cfg.num_cpus_per_env_runner,
+            restart_failed=cfg.restart_failed_env_runners,
+            policy_mode=cfg.policy_mode,
+            inference_handle=inference_handle,
+            trajectory_queue_size=cfg.trajectory_queue_size,
+            env_to_module=cfg.env_to_module,
+            module_to_env=cfg.module_to_env,
+        )
+        self.learner_group = LearnerGroup(
+            type(self).learner_class,
+            self.module_spec,
+            config=self._learner_config(),
+            num_learners=0,
+        )
+        self._podracer = PodracerDriver(
+            self.env_runner_group,
+            self.learner_group,
+            max_weight_lag=cfg.max_weight_lag,
+            broadcast_interval=cfg.broadcast_interval,
+        )
         self._timesteps_total = 0
 
     def _setup_multi_agent(self):

@@ -45,6 +45,12 @@ class IMPALAConfig(AlgorithmConfig):
         self.broadcast_interval = 1  # learner steps between weight pushes
         self.learner_queue_size = 16
         self.learner_queue_timeout_s = 30.0
+        # Podracer staleness: IMPALA bumps the generation once per
+        # FRAGMENT (not per train batch like PPO), so a pipelined queue
+        # alone puts consumed fragments several generations back —
+        # V-trace's rho/c clipping exists to correct exactly that.  A
+        # tight bound here would discard most of a healthy pipeline.
+        self.max_weight_lag = 16
 
     @property
     def algo_class(self):
@@ -73,10 +79,13 @@ def vtrace_discounts_and_mask(batch, gamma: float):
 
 
 def vtrace_returns(logp, behaviour_logp, values, rewards, discounts,
-                   rho_clip: float, c_clip: float):
+                   rho_clip: float, c_clip: float, bootstrap_value=None):
     """V-trace targets (Espeholt et al. 2018, eqs. 1-2), fully in-jit
-    with a reversed lax.scan over time.  Returns (vs, pg_advantages,
-    rhos); gradients are stopped on all targets."""
+    with a reversed lax.scan over time; vectorizes over any trailing
+    batch axes.  ``bootstrap_value`` is the value of the observation
+    after the last row (the streaming fragment path carries it; the
+    flat path approximates with the last row's value).  Returns
+    (vs, pg_advantages, rhos); gradients are stopped on all targets."""
     import jax
     import jax.numpy as jnp
 
@@ -84,7 +93,8 @@ def vtrace_returns(logp, behaviour_logp, values, rewards, discounts,
     clipped_rho = jnp.minimum(rho_clip, rhos)
     clipped_c = jnp.minimum(c_clip, rhos)
     v = jax.lax.stop_gradient(values)
-    next_v = jnp.concatenate([v[1:], v[-1:]], axis=0)
+    boot = v[-1:] if bootstrap_value is None else jax.lax.stop_gradient(bootstrap_value)[None]
+    next_v = jnp.concatenate([v[1:], boot], axis=0)
     deltas = clipped_rho * (rewards + discounts * next_v - v)
 
     def scan_fn(carry, t):
@@ -95,7 +105,7 @@ def vtrace_returns(logp, behaviour_logp, values, rewards, discounts,
     _, vs_minus_v = jax.lax.scan(scan_fn, jnp.zeros_like(v[0]), jnp.arange(T - 1, -1, -1))
     vs_minus_v = vs_minus_v[::-1]
     vs = v + vs_minus_v
-    next_vs = jnp.concatenate([vs[1:], v[-1:]], axis=0)
+    next_vs = jnp.concatenate([vs[1:], boot], axis=0)
     pg_adv = jax.lax.stop_gradient(clipped_rho * (rewards + discounts * next_vs - v))
     return jax.lax.stop_gradient(vs), pg_adv, rhos
 
@@ -122,6 +132,52 @@ class IMPALALearner(Learner):
         ent = (entropy * mask).sum() / denom
         total = pi_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss - cfg.get("entropy_coeff", 0.01) * ent
         return total, {"policy_loss": pi_loss, "vf_loss": vf_loss, "entropy": ent, "mean_rho": rhos.mean()}
+
+    def fragment_loss(self, params, cols: Dict[str, Any], last_values, rng):
+        """Streaming-fragment V-trace on time-major [T, B] columns, with
+        the runner-carried bootstrap value for the T+1-th observation
+        (the flat path had to approximate it with the last row).  The
+        net sees flat [T*B] rows; the temporal scan runs on [T, B]."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.utils.sample_batch import LOSS_MASK, TRUNCATEDS as _TR
+
+        cfg = self.config
+        obs, actions = cols[OBS], cols[ACTIONS]
+        T, B = actions.shape[0], actions.shape[1]
+        flat_obs = obs.reshape((T * B,) + obs.shape[2:])
+        logp, entropy, values = self.module.forward_train(
+            params, flat_obs, actions.reshape(T * B)
+        )
+        logp = logp.reshape(T, B)
+        entropy = entropy.reshape(T, B)
+        values = values.reshape(T, B)
+        done = jnp.maximum(
+            cols[TERMINATEDS].astype(jnp.float32),
+            cols[_TR].astype(jnp.float32),
+        )
+        discounts = cfg.get("gamma", 0.99) * (1.0 - done)
+        mask = cols.get(LOSS_MASK, jnp.ones_like(discounts))
+        vs, pg_adv, rhos = vtrace_returns(
+            logp, cols[LOGP], values, cols[REWARDS], discounts,
+            cfg.get("vtrace_clip_rho", 1.0), cfg.get("vtrace_clip_c", 1.0),
+            bootstrap_value=last_values,
+        )
+        denom = mask.sum() + 1e-8
+        pi_loss = -((logp * pg_adv) * mask).sum() / denom
+        vf_loss = 0.5 * (jnp.square(values - vs) * mask).sum() / denom
+        ent = (entropy * mask).sum() / denom
+        total = (
+            pi_loss
+            + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+            - cfg.get("entropy_coeff", 0.01) * ent
+        )
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "mean_rho": rhos.mean(),
+        }
 
 
 class LearnerThread(threading.Thread):
@@ -211,6 +267,34 @@ class IMPALA(Algorithm):
             self._learner_thread.start()
         return self._learner_thread
 
+    def _podracer_step(self) -> Dict[str, Any]:
+        """True podracer IMPALA: runners stream fragments continuously
+        over channels; this loop consumes at least one and then drains
+        whatever is already buffered — one fused time-major V-trace
+        update per fragment (K=1 keeps shapes static), weights published
+        generation-tagged on the broadcast cadence.  Rollouts never wait
+        on SGD; SGD never waits on a rollout round-trip."""
+        cfg = self.algo_config
+        drv = self._podracer
+        frags = list(drv.collect(1))
+        while drv.pending_fragments() > 0 and len(frags) < 8:
+            try:
+                frags.extend(drv.collect(1, timeout=2.0))
+            except TimeoutError:
+                break
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        for frag in frags:
+            metrics = self.learner_group.update_from_fragments([frag])
+            drv.after_update()
+            steps += int(frag["env_steps"])
+        self._timesteps_total += steps
+        out = dict(metrics)
+        out["num_env_steps_sampled"] = steps
+        out["num_env_steps_trained"] = drv.env_steps_consumed
+        out.update(drv.metrics())
+        return out
+
     def training_step(self) -> Dict[str, Any]:
         """Async pipeline: the driver keeps max_requests_in_flight
         sample() calls outstanding per runner and feeds arrivals to the
@@ -219,6 +303,8 @@ class IMPALA(Algorithm):
         import ray_tpu
 
         cfg = self.algo_config
+        if cfg.podracer_enabled:
+            return self._podracer_step()
         group = self.env_runner_group
         if group.local_runner is not None:
             # degenerate sync mode

@@ -17,8 +17,12 @@ from ray_tpu.rllib.utils.sample_batch import (
     ACTIONS,
     ADVANTAGES,
     LOGP,
+    LOSS_MASK,
     OBS,
+    REWARDS,
     SampleBatch,
+    TERMINATEDS,
+    TRUNCATEDS,
     VALUE_TARGETS,
     VF_PREDS,
 )
@@ -56,9 +60,15 @@ class PPOLearner(Learner):
         vf_clip = self.config.get("vf_clip_param", 10.0)
         vf_err = jnp.clip((value - batch[VALUE_TARGETS]) ** 2, 0.0, vf_clip ** 2)
 
-        pi_loss = -surrogate.mean()
-        vf_loss = vf_err.mean()
-        ent = entropy.mean()
+        # Streaming fragments keep autoreset rows for shape stability
+        # (LOSS_MASK 0); the synchronous path has no mask — all ones.
+        mask = batch.get(LOSS_MASK)
+        if mask is None:
+            mask = jnp.ones_like(adv)
+        denom = mask.sum() + 1e-8
+        pi_loss = -(surrogate * mask).sum() / denom
+        vf_loss = (vf_err * mask).sum() / denom
+        ent = (entropy * mask).sum() / denom
         total = (
             pi_loss
             + self.config.get("vf_loss_coeff", 0.5) * vf_loss
@@ -68,9 +78,58 @@ class PPOLearner(Learner):
             "policy_loss": pi_loss,
             "vf_loss": vf_loss,
             "entropy": ent,
-            "mean_kl": (batch[LOGP] - logp).mean(),
+            "mean_kl": ((batch[LOGP] - logp) * mask).sum() / denom,
         }
         return total, metrics
+
+    def prepare_fragments(self, cols: Dict[str, Any], last_values):
+        """In-jit GAE over time-major [T, B] fragment columns — the
+        host-side per-episode Python scan + concat + standardize that
+        dominated the synchronous path's 'overhead' bucket, fused into
+        the update dispatch.  Truncation and termination both cut the
+        advantage chain; truncated bootstraps are 0 (the same accepted
+        bias as the host path's fragment boundaries)."""
+        import jax
+        import jax.numpy as jnp
+
+        gamma = self.config.get("gamma", 0.99)
+        lam = self.config.get("lambda_", 0.95)
+        v = cols[VF_PREDS]
+        r = cols[REWARDS]
+        done = jnp.clip(
+            cols[TERMINATEDS].astype(jnp.float32)
+            + cols[TRUNCATEDS].astype(jnp.float32),
+            0.0,
+            1.0,
+        )
+        valid = cols.get(LOSS_MASK, jnp.ones_like(r))
+        next_v = jnp.concatenate([v[1:], last_values[None]], axis=0) * (1.0 - done)
+        deltas = r + gamma * next_v - v
+
+        def scan_fn(carry, t):
+            acc = deltas[t] + gamma * lam * (1.0 - done[t]) * carry
+            return acc, acc
+
+        T = r.shape[0]
+        _, adv_rev = jax.lax.scan(
+            scan_fn, jnp.zeros_like(v[0]), jnp.arange(T - 1, -1, -1)
+        )
+        adv = adv_rev[::-1]
+        targets = jax.lax.stop_gradient(adv + v)
+        denom = valid.sum() + 1e-8
+        mean = (adv * valid).sum() / denom
+        var = (((adv - mean) ** 2) * valid).sum() / denom
+        adv = jax.lax.stop_gradient(
+            (adv - mean) / jnp.maximum(1e-8, jnp.sqrt(var))
+        )
+        return {
+            OBS: cols[OBS],
+            ACTIONS: cols[ACTIONS],
+            LOGP: cols[LOGP],
+            ADVANTAGES: adv,
+            VALUE_TARGETS: targets,
+            LOSS_MASK: valid,
+        }
 
 
 class PPO(Algorithm):
@@ -86,6 +145,7 @@ class PPO(Algorithm):
             vf_clip_param=cfg.vf_clip_param,
             vf_loss_coeff=cfg.vf_loss_coeff,
             entropy_coeff=cfg.entropy_coeff,
+            lambda_=cfg.lambda_,  # in-jit GAE on the streaming path
         )
         return out
 
@@ -93,6 +153,8 @@ class PPO(Algorithm):
         cfg = self.algo_config
         if cfg.is_multi_agent:
             return self._multi_agent_training_step()
+        if cfg.podracer_enabled:
+            return self._podracer_step()
         # ① synchronous parallel rollouts (ppo.py:408)
         runners = max(1, cfg.num_env_runners)
         per_runner = max(1, cfg.train_batch_size // (runners * cfg.num_envs_per_env_runner))
@@ -107,6 +169,28 @@ class PPO(Algorithm):
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         out = dict(metrics)
         out["num_env_steps_sampled"] = batch.count
+        return out
+
+    def _podracer_step(self) -> Dict[str, Any]:
+        """Streaming PPO: a FIXED count of fragments per update (static
+        (K, T, N) shapes → one compiled program), GAE/standardize/concat
+        and the epoch×minibatch schedule fused into one jitted dispatch,
+        weights published back generation-tagged without stalling
+        runners."""
+        cfg = self.algo_config
+        drv = self._podracer
+        per_frag = cfg.rollout_fragment_length * cfg.num_envs_per_env_runner
+        k = max(1, round(cfg.train_batch_size / per_frag))
+        frags = drv.collect(k)
+        metrics = self.learner_group.update_from_fragments(
+            frags, minibatch_size=cfg.minibatch_size, num_epochs=cfg.num_epochs
+        )
+        drv.after_update()
+        steps = sum(int(f["env_steps"]) for f in frags)
+        self._timesteps_total += steps
+        out = dict(metrics)
+        out["num_env_steps_sampled"] = steps
+        out.update(drv.metrics())
         return out
 
     def _multi_agent_training_step(self) -> Dict[str, Any]:

@@ -1,0 +1,87 @@
+"""Sebulba-split inference server: one actor owns the policy on the
+learner-side device and serves action selection for EVERY env runner in
+continuous batches (PAPERS.md "Podracer architectures" — the sebulba
+configuration separates acting hardware from stepping hardware for
+policies too heavy to evaluate inside a CPU env runner).
+
+Batching rides the serve plane's ``@serve.batch`` machinery (PR 9): the
+actor is async (the decorator's queue coalesces concurrent runner calls
+within a 2 ms window), one jitted forward serves the coalesced batch,
+and results are split back per caller.  Weights arrive generation-tagged
+from the learner (`set_weights`); every response carries the generation
+so fragments inherit the staleness bookkeeping with no extra channel.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ray_tpu.serve.batching import batch
+
+
+class InferenceServer:
+    """Created via ``ray_tpu.remote(...)(InferenceServer).remote(spec,
+    seed)``; env runners call ``compute_actions`` once per vector-env
+    step and the batcher coalesces across runners."""
+
+    def __init__(self, module_spec, seed: int = 0):
+        import jax
+
+        self.module = module_spec.build()
+        self.params = None
+        self.generation = 0
+        self._rng = jax.random.PRNGKey(seed * 9973 + 17)
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._infer_fn = jax.jit(self.module.forward_inference)
+
+    def set_weights(self, weights, generation: int) -> int:
+        self.params = self.module.set_weights(weights)
+        self.generation = int(generation)
+        return self.generation
+
+    def ping(self) -> str:
+        return "pong"
+
+    @batch(max_batch_size=32, batch_wait_timeout_s=0.002)
+    async def _batched_forward(self, items):
+        """items: list of (obs_batch, explore).  One concat → one jitted
+        forward → split by caller sizes.  Mixed explore flags split into
+        at most two device calls (runners normally agree)."""
+        import jax
+
+        assert self.params is not None, "set_weights before compute_actions"
+        out = [None] * len(items)
+        for explore_flag in (True, False):
+            idx = [i for i, (_o, e) in enumerate(items) if e == explore_flag]
+            if not idx:
+                continue
+            obs = np.concatenate([np.asarray(items[i][0]) for i in idx], axis=0)
+            if explore_flag:
+                self._rng, step_rng = jax.random.split(self._rng)
+                actions, logp, value = self._explore_fn(self.params, obs, step_rng)
+            else:
+                actions, value = self._infer_fn(self.params, obs)
+                logp = np.zeros(obs.shape[0], np.float32)
+            actions = np.asarray(actions)
+            logp = np.asarray(logp, np.float32)
+            value = np.asarray(value, np.float32)
+            start = 0
+            for i in idx:
+                n = len(np.asarray(items[i][0]))
+                out[i] = (
+                    actions[start : start + n],
+                    logp[start : start + n],
+                    value[start : start + n],
+                    self.generation,
+                )
+                start += n
+        return out
+
+    async def compute_actions(
+        self, obs, explore: bool = True
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """One runner's vector-env step worth of observations →
+        (actions, logp, values, weight_generation)."""
+        return await self._batched_forward((obs, explore))

@@ -51,6 +51,8 @@ class Learner:
         self._update_fn = None
         # (batch_count, minibatch_size, num_epochs) -> fused jitted fn
         self._epochs_fns: Dict[tuple, Callable] = {}
+        # (K, T, N, minibatch_size, num_epochs) -> fused fragment fn
+        self._fragments_fns: Dict[tuple, Callable] = {}
         self._metrics: Dict[str, float] = {}
 
     # -- subclass API ----------------------------------------------------
@@ -119,14 +121,12 @@ class Learner:
         return self._metrics
 
     # -- fused epoch/minibatch update (TPU-first) -----------------------
-    def _build_epochs_fn(self, count: int, minibatch_size: int, num_epochs: int) -> Callable:
-        """The reference drives epochs × minibatches as a Python loop of
-        individual update calls (learner.py minibatch loop) — one device
-        dispatch per minibatch.  Here the WHOLE schedule is one jitted
-        program: lax.scan over epochs, each a fresh in-jit permutation
-        scanned over minibatches.  One dispatch per training_step, which
-        is the difference between RTT-bound and compute-bound when the
-        chip sits behind any nonzero link latency."""
+    def _epochs_schedule(self, count: int, minibatch_size: int, num_epochs: int) -> Callable:
+        """Pure/jittable whole-SGD-schedule function over a flat row
+        batch: lax.scan over epochs, each a fresh in-jit permutation
+        scanned over minibatches.  Shared by the padded-batch path
+        (update_from_batch_epochs) and the streaming fragment path
+        (update_from_fragments)."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -174,8 +174,16 @@ class Learner:
             last = jax.tree_util.tree_map(lambda m: m[-1, -1], metrics)
             return params, opt_state, last
 
+        return epochs
+
+    def _build_epochs_fn(self, count: int, minibatch_size: int, num_epochs: int) -> Callable:
+        import jax
+
         # opt_state only — see _build_update_fn on the params/broadcast race
-        return jax.jit(epochs, donate_argnums=(1,))
+        return jax.jit(
+            self._epochs_schedule(count, minibatch_size, num_epochs),
+            donate_argnums=(1,),
+        )
 
     def update_from_batch_epochs(
         self, batch, minibatch_size: int, num_epochs: int
@@ -206,6 +214,125 @@ class Learner:
             jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
         self.params, self.opt_state, metrics = fn(
             self.params, self.opt_state, jbatch, step_rng
+        )
+        self._metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        self.after_update()
+        return self._metrics
+
+    # -- fused streaming-fragment update (podracer plane) ----------------
+    # Fragments arrive as fixed-shape [T, N] time-major columns (see
+    # env_runner._collect_fragment); everything the synchronous path did
+    # on the host — GAE / V-trace targets, concat, standardize, the
+    # minibatch schedule — happens INSIDE one jitted dispatch here.
+
+    def prepare_fragments(self, cols: Dict[str, Any], last_values) -> Dict[str, Any]:
+        """Hook (non-time-order learners): derive training columns from
+        time-major [T, B] fragment columns + [B] bootstrap values, in
+        jit.  Must return a dict of [T, B, ...] arrays ready to flatten
+        into SGD rows.  PPO computes GAE + masked standardization here."""
+        raise NotImplementedError
+
+    def fragment_loss(self, params, cols: Dict[str, Any], last_values, rng):
+        """Hook (preserve_time_order learners): loss directly on the
+        time-major [T, B] columns (IMPALA's V-trace scan).  Returns
+        (loss, metrics) — pure/jittable."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _merge_time_major(x):
+        """[K, T, N, ...] -> [T, K*N, ...]: fragments from any mix of
+        runners concat along the batch axis, inside jit."""
+        import jax.numpy as jnp
+
+        x = jnp.moveaxis(x, 0, 1)
+        return x.reshape(x.shape[0], x.shape[1] * x.shape[2], *x.shape[3:])
+
+    def _build_fragments_fn(
+        self, K: int, T: int, N: int, minibatch_size: int, num_epochs: int
+    ) -> Callable:
+        import jax
+        from jax import lax
+
+        count = K * T * N
+
+        if self.preserve_time_order:
+
+            def fn(params, opt_state, cols, last_values, rng):
+                tm = {k: self._merge_time_major(v) for k, v in cols.items()}
+                last = last_values.reshape(-1)
+
+                def epoch_step(carry, ep_rng):
+                    params, opt_state = carry
+
+                    def loss_wrapper(p):
+                        return self.fragment_loss(p, tm, last, ep_rng)
+
+                    (loss, metrics), grads = jax.value_and_grad(
+                        loss_wrapper, has_aux=True
+                    )(params)
+                    updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+                    metrics["total_loss"] = loss
+                    metrics["grad_norm"] = (
+                        jax.tree_util.tree_reduce(
+                            lambda a, g: a + (g ** 2).sum(), grads, 0.0
+                        )
+                        ** 0.5
+                    )
+                    return (params, opt_state), metrics
+
+                rngs = jax.random.split(rng, num_epochs)
+                (params, opt_state), metrics = lax.scan(
+                    epoch_step, (params, opt_state), rngs
+                )
+                last_m = jax.tree_util.tree_map(lambda m: m[-1], metrics)
+                return params, opt_state, last_m
+
+        else:
+            mb = min(minibatch_size, count)
+            epochs = self._epochs_schedule(count, mb, num_epochs)
+
+            def fn(params, opt_state, cols, last_values, rng):
+                tm = {k: self._merge_time_major(v) for k, v in cols.items()}
+                last = last_values.reshape(-1)
+                prepared = self.prepare_fragments(tm, last)
+                rows = {
+                    k: v.reshape((count,) + v.shape[2:]) for k, v in prepared.items()
+                }
+                return epochs(params, opt_state, rows, rng)
+
+        # opt_state only — see _build_update_fn on the params/broadcast race
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def update_from_fragments(
+        self, frags: List[dict], minibatch_size: Optional[int] = None, num_epochs: int = 1
+    ) -> Dict[str, float]:
+        """One fused device dispatch for a batch of streamed trajectory
+        fragments: advantage targets, concat, and the whole epoch ×
+        minibatch schedule run in-jit.  Shapes are static in (K, T, N),
+        so a steady fragment stream reuses one compiled program."""
+        import jax
+        import jax.numpy as jnp
+
+        assert frags, "update_from_fragments needs at least one fragment"
+        keys = frags[0]["cols"].keys()
+        cols = {
+            k: jnp.asarray(np.stack([np.asarray(f["cols"][k]) for f in frags]))
+            for k in keys
+        }
+        last_values = jnp.asarray(
+            np.stack([np.asarray(f["last_values"]) for f in frags])
+        )
+        K, T, N = last_values.shape[0], *next(iter(cols.values())).shape[1:3]
+        key = (K, T, N, int(minibatch_size or 0), num_epochs)
+        fn = self._fragments_fns.get(key)
+        if fn is None:
+            fn = self._fragments_fns[key] = self._build_fragments_fn(
+                K, T, N, minibatch_size or (K * T * N), num_epochs
+            )
+        self._rng, step_rng = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = fn(
+            self.params, self.opt_state, cols, last_values, step_rng
         )
         self._metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         self.after_update()
@@ -299,6 +426,19 @@ class LearnerGroup:
             refs.append(w.update_from_batch.remote(sub))
         results = ray_tpu.get(refs)
         return results[0]
+
+    def update_from_fragments(self, frags, minibatch_size: Optional[int] = None, num_epochs: int = 1) -> Dict[str, float]:
+        """Fused streaming update (podracer plane).  The learner IS the
+        driver process on the TPU host (num_learners=0); remote learner
+        actors would put the object store back on the hot path the
+        channel plane exists to avoid."""
+        if self._local is None:
+            raise ValueError(
+                "the podracer streaming plane requires a local learner "
+                "(num_learners=0); scale out with one learner spanning "
+                "hosts via jax.distributed instead"
+            )
+        return self._local.update_from_fragments(frags, minibatch_size, num_epochs)
 
     def get_weights(self):
         import ray_tpu

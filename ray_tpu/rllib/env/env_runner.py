@@ -94,6 +94,13 @@ class SingleAgentEnvRunner:
         self._episode_lens = np.zeros(num_envs, dtype=np.int64)
         self._completed_returns: List[float] = []
         self._completed_lens: List[int] = []
+        # podracer streaming state (core/stream.py wires these)
+        self._infer_handle = None
+        self._traj_chan = None
+        self._weight_chan = None
+        self._weight_listener = None
+        self._weight_gen = 0
+        self._frag_seq = 0
 
     def set_weights(self, weights):
         import jax
@@ -193,6 +200,169 @@ class SingleAgentEnvRunner:
             else:
                 batches.append(env_batch)
         return SampleBatch.concat_samples(batches)
+
+    # -- podracer streaming plane (core/stream.py) ----------------------
+    def stream_attach(self, spec: dict) -> dict:
+        """Open this runner's channel endpoints (called BEFORE
+        run_stream, so the driver never races a missing endpoint).
+        Ring: both files already exist (driver created them).  Socket:
+        this side dials the trajectory edge (driver listener pre-bound)
+        and binds the weight listener the driver will dial."""
+        from ray_tpu.experimental.channel import Channel, SocketListener, dial
+
+        self._infer_handle = spec.get("inference")
+        out: dict = {}
+        if spec["kind"] == "ring":
+            self._traj_chan = Channel(spec["traj_path"])
+            self._weight_chan = Channel(spec["w_path"]) if spec.get("w_path") else None
+        else:
+            self._traj_chan = dial(tuple(spec["traj_addr"]), "write")
+            self._weight_chan = None
+            self._weight_listener = None
+            if spec.get("want_weights"):
+                self._weight_listener = SocketListener()
+                out["w_port"] = self._weight_listener.port
+        return out
+
+    def _drain_weights(self, block: bool) -> None:
+        """Adopt the NEWEST pending weight snapshot (generation-tagged);
+        stale intermediates are consumed and discarded.  ``block`` only
+        on the very first fragment (no params yet)."""
+        chan = self._weight_chan
+        if chan is None:
+            return
+        newest = None
+        while chan.pending() or (block and newest is None):
+            _tag, (gen, weights) = chan.read_value(timeout=60.0 if block else 1.0)
+            newest = (gen, weights)
+        if newest is not None:
+            self._weight_gen = int(newest[0])
+            self.set_weights(newest[1])
+
+    def run_stream(self, fragment_length: int, explore: bool = True) -> str:
+        """Resident streaming loop: sample fixed-shape fragments and
+        write them into the trajectory channel until the learner closes
+        it.  The blocking write IS the flow control — a slow learner
+        parks this runner; nothing is dropped or reordered."""
+        from ray_tpu._private import telemetry
+        from ray_tpu.experimental.channel import ChannelClosed
+
+        self._weight_gen = 0
+        self._frag_seq = 0
+        if getattr(self, "_weight_listener", None) is not None:
+            self._weight_chan = self._weight_listener.accept("read", timeout=60.0)
+            self._weight_listener = None
+        try:
+            self._drain_weights(block=self._infer_handle is None)
+            while True:
+                frag = self._collect_fragment(fragment_length, explore)
+                self._traj_chan.write_value(frag, timeout=None)
+                telemetry.count_rllib_env_steps(frag["env_steps"])
+                self._drain_weights(block=False)
+        except ChannelClosed:
+            pass
+        finally:
+            for chan in (self._traj_chan, self._weight_chan):
+                try:
+                    if chan is not None:
+                        chan.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self.envs.close()
+        return "closed"
+
+    def _policy_step(self, mod_obs, step_rng, explore: bool):
+        """One action-selection call: anakin = the local jitted forward
+        (inference lives inside this actor's step), sebulba = the shared
+        continuous-batching inference server (heavy policies on the
+        learner-side device).  Returns (actions, logp, value, gen)."""
+        import jax
+
+        if self._infer_handle is None:
+            if explore:
+                actions, logp, value = self._explore_fn(self.params, mod_obs, step_rng)
+            else:
+                actions, value = self._infer_fn(self.params, mod_obs)
+                logp = np.zeros(self.num_envs, np.float32)
+            return actions, logp, value, self._weight_gen
+        import ray_tpu
+
+        actions, logp, value, gen = ray_tpu.get(
+            self._infer_handle.compute_actions.remote(np.asarray(mod_obs), explore),
+            timeout=60,
+        )
+        return actions, logp, value, gen
+
+    def _collect_fragment(self, num_steps: int, explore: bool = True) -> dict:
+        """Fixed-shape [T, N] time-major fragment with NO host-side GAE
+        and no row drops (autoreset rows carry loss_mask 0): advantage
+        computation and concat belong inside the learner's fused jitted
+        update.  Carries the bootstrap values for the T+1-th obs and the
+        episode stats completed during the fragment."""
+        import jax
+
+        assert self.params is not None or self._infer_handle is not None, (
+            "weights never arrived before streaming started"
+        )
+        T, N = num_steps, self.num_envs
+        obs_rows, act_rows, rew_rows = [], [], []
+        term_rows, trunc_rows, logp_rows, vf_rows, valid_rows = [], [], [], [], []
+        ep_marker = len(self._completed_returns)
+        gen = None  # sebulba: min server generation seen; anakin: local gen
+        for _ in range(T):
+            self._rng, step_rng = jax.random.split(self._rng)
+            mod_obs = self._obs if self.env_to_module is None else self.env_to_module(self._obs)
+            actions, logp, value, step_gen = self._policy_step(mod_obs, step_rng, explore)
+            gen = step_gen if gen is None else min(gen, step_gen)
+            actions = np.asarray(actions)
+            env_actions = actions if self.module_to_env is None else self.module_to_env(actions)
+            next_obs, rewards, term, trunc, _ = self.envs.step(env_actions)
+            obs_rows.append(np.asarray(mod_obs).copy())
+            act_rows.append(actions)
+            rew_rows.append(np.asarray(rewards, np.float32))
+            term_rows.append(term.copy())
+            trunc_rows.append(trunc.copy())
+            logp_rows.append(np.asarray(logp, np.float32))
+            vf_rows.append(np.asarray(value, np.float32))
+            keep = ~self._prev_done
+            valid_rows.append(keep.astype(np.float32))
+            self._episode_returns[keep] += rewards[keep]
+            self._episode_lens[keep] += 1
+            done = (term | trunc) & keep
+            self._prev_done = term | trunc
+            for i in np.where(done)[0]:
+                self._completed_returns.append(float(self._episode_returns[i]))
+                self._completed_lens.append(int(self._episode_lens[i]))
+                self._episode_returns[i] = 0.0
+                self._episode_lens[i] = 0
+            self._obs = next_obs
+        final_obs = self._obs if self.env_to_module is None else self.env_to_module(self._obs)
+        if self._infer_handle is None:
+            _, last_values = self._infer_fn(self.params, final_obs)
+        else:
+            _a, _lp, last_values, _g = self._policy_step(final_obs, None, False)
+        self._frag_seq += 1
+        from ray_tpu.rllib.utils.sample_batch import LOSS_MASK
+
+        return {
+            "seq": self._frag_seq,
+            "gen": int(gen if gen is not None else self._weight_gen),
+            "worker": self.worker_index,
+            "env_steps": int(np.sum(valid_rows)),
+            "cols": {
+                OBS: np.stack(obs_rows),
+                ACTIONS: np.stack(act_rows),
+                REWARDS: np.stack(rew_rows),
+                TERMINATEDS: np.stack(term_rows),
+                TRUNCATEDS: np.stack(trunc_rows),
+                LOGP: np.stack(logp_rows),
+                VF_PREDS: np.stack(vf_rows),
+                LOSS_MASK: np.stack(valid_rows),
+            },
+            "last_values": np.asarray(last_values, np.float32),
+            "episode_returns": self._completed_returns[ep_marker:],
+            "episode_lens": self._completed_lens[ep_marker:],
+        }
 
     def sample_episodes(self, num_episodes: int, explore: bool = False) -> List[float]:
         """Reset, then step until ``num_episodes`` episodes complete;

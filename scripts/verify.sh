@@ -82,6 +82,18 @@ if [ "${RAY_TPU_SKIP_DAG_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# RLlib async smoke (podracer streaming plane end-to-end): 2 streaming
+# env runners + learner over real channels, fixed seed, reward parity
+# vs the synchronous PPO path on CartPole, and the IMPALA-style async
+# config clearing the same bar.  Skippable via RAY_TPU_SKIP_RLLIB_SMOKE=1.
+if [ "${RAY_TPU_SKIP_RLLIB_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/rllib_async_smoke.py; then
+    echo "rllib async smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Profiling smoke (bottleneck-attribution plane end-to-end): actor under
 # load, attach the sampling profiler, assert a non-empty merged
 # flamegraph with the workload visible and valid speedscope output.

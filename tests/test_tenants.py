@@ -659,3 +659,59 @@ def test_priority_preemption_elastic_checkpoint_shrink(tenant_cluster, tmp_path)
         and ("trigger", "preempt") in tuple(tags)
     )
     assert shrank >= 1, "no preempt-triggered resize recorded"
+
+
+def test_lease_ledger_prevents_cross_raylet_over_admission(tenant_cluster):
+    """PR 6 follow-up regression (charge-at-admission ledger): when a
+    tenant's quota exceeds one node's capacity, its demand spills to a
+    peer raylet whose usage view is a report period (~1 s) stale — both
+    raylets could grant against the same headroom, over-admitting past
+    the quota until cooperative revocation mopped up.  Here revocation
+    is DISABLED (chaos drops every revoke_lease push) and the holds are
+    long, so any over-admission is persistent and visible: the GCS
+    lease-admission ledger (charge at admission, reconcile on report)
+    alone must keep concurrent usage at/below the quota."""
+    tenant_cluster(
+        head_args={"num_cpus": 4},
+        nodes=[{"num_cpus": 4}],
+        env={"RAY_TPU_testing_chaos_spec": "revoke_lease:drop_req:n=-1"},
+        tenant="teamQ",
+    )
+    # quota 6 > head's 4 CPUs: demand past 4 spills to the worker raylet
+    _gcs().call("tenant_set_quota", {"tenant": "teamQ", "quota": {"CPU": 6}})
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold(t):
+        time.sleep(t)
+        return 1
+
+    refs = [hold.remote(25.0) for _ in range(10)]
+    try:
+        overshoot = []  # (t, usage) samples above quota
+        peak = 0.0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 8.0:
+            u = _usage_cpu("teamQ")
+            peak = max(peak, u)
+            if u > 6.0 + 1e-6:
+                overshoot.append((round(time.monotonic() - t0, 1), u))
+            # graftlint: disable=retry-gate -- fixed sampling cadence of the drill's usage time series
+            time.sleep(0.2)
+        # Pre-ledger behavior: both raylets grant into the same headroom
+        # and usage sits at 7-8 for the WHOLE 30 s hold (revocation is
+        # disabled, so nothing can mop an excess lease up — persistence
+        # IS the over-admission signal).  With charge-at-admission the
+        # only tolerated artifact is the grant-burst accounting overlap
+        # (ledger entry + report both carrying a fresh lease for a few
+        # hundred ms) — never a persistent excess lease.
+        assert not [o for o in overshoot if o[0] > 2.5], (
+            f"over-admission persisted past the grant burst: {overshoot}"
+        )
+        # work conservation: the plane still filled the quota across nodes
+        assert peak >= 5.0, f"peak usage only {peak}"
+    finally:
+        for r in refs:
+            try:
+                ray_tpu.cancel(r, force=True)
+            except Exception:
+                pass
