@@ -1,0 +1,240 @@
+"""Sharded-training bench: tokens/s for the GSPMD batch x model layout
+vs the pure data-parallel layout, plus the MPMD 2-stage pipeline, on
+whatever devices the box has (8 virtual CPU devices on the CI box; a
+real TPU slice when present — provenance() stamps which, so bench_gate
+can never score a CPU capture against a TPU one).
+
+On CPU the sharded number is a CORRECTNESS-scale capture (tiny model,
+collectives over host memory) — the interesting trajectory is
+like-for-like across commits, which is exactly what the embedded
+``bench_gate.py --compare`` run scores: each capture writes a flat
+metric dict (``gate_capture``), and when a previous BENCH_sharded.json
+exists its capture is compared against the fresh one at the gate's
+threshold, with the verdict recorded in the new record.
+
+    JAX_PLATFORMS=cpu python bench_sharded.py        # writes BENCH_sharded.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+BATCH = 16
+SEQ = 129  # 128 tokens + 1 shift
+STEPS = 8
+WARMUP = 2
+BEST_OF = 2
+
+
+def _model_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    return gpt2.GPT2Config(
+        vocab_size=512, n_layer=4, n_head=4, d_model=128, max_seq_len=SEQ,
+        dtype=jnp.bfloat16, remat=False,
+    )
+
+
+def _tokens_per_s(step_fn, params, opt_state, data) -> tuple:
+    import jax
+
+    losses = []
+    for i in range(WARMUP):
+        params, opt_state, loss = step_fn(
+            params, opt_state, data[i][:, :-1], data[i][:, 1:]
+        )
+    jax.block_until_ready(loss)
+    t0 = time.monotonic()
+    for i in range(STEPS):
+        params, opt_state, loss = step_fn(
+            params, opt_state, data[i][:, :-1], data[i][:, 1:]
+        )
+        losses.append(loss)
+    jax.block_until_ready(losses[-1])
+    wall = time.monotonic() - t0
+    return BATCH * (SEQ - 1) * STEPS / wall, wall
+
+
+def bench_mpmd() -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.models import gpt2
+    from ray_tpu.train.sharding import (
+        PipelineConfig,
+        PipelinePlane,
+        gpt2_pipeline_programs,
+    )
+
+    cfg = _model_cfg()
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    data = np.random.default_rng(0).integers(
+        0, 512, (WARMUP + STEPS, BATCH, SEQ)
+    ).astype(np.int32)
+
+    def data_fn(step):
+        toks = data[step % len(data)]
+        return toks[:, :-1], toks[:, 1:]
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    prog = gpt2_pipeline_programs(cfg, n_stages=2, lr=1e-3, seed=0)
+    plane = PipelinePlane(
+        prog,
+        PipelineConfig(
+            stages=2, microbatches=4, step_timeout_s=300.0,
+            ring_capacity=64 * 1024 * 1024,
+        ),
+    )
+    try:
+        plane.start()
+        for i in range(WARMUP):
+            plane.train_step(*data_fn(i))
+        t0 = time.monotonic()
+        for i in range(WARMUP, WARMUP + STEPS):
+            plane.train_step(*data_fn(i))
+        wall = time.monotonic() - t0
+        stats = plane.stage_stats()
+    finally:
+        plane.stop()
+        ray_tpu.shutdown()
+    return {
+        "stages": 2,
+        "microbatches": 4,
+        "tokens_per_s": round(BATCH * (SEQ - 1) * STEPS / wall, 1),
+        "bubble_fraction_per_stage": [
+            round(s["bubble_fraction"], 3) for s in stats
+        ],
+    }
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_common import provenance
+
+    import ray_tpu.train.sharding as sharding
+
+    dp = _bench_with_config(
+        sharding.ShardingConfig(
+            mesh=("batch",), mesh_shape={"batch": -1},
+            partition_rules=[(r".*", ())],
+        )
+    )
+    try:
+        tp = _bench_with_config(
+            sharding.ShardingConfig(mesh_shape={"batch": -1, "model": 2})
+        )
+    except Exception as e:  # noqa: BLE001 — record the failure, not crash
+        tp = {"error": f"{type(e).__name__}: {e}"}
+    pp = bench_mpmd()
+
+    prov = provenance()
+    gate_capture = {
+        "tokens_per_s_dp": {"value": dp["tokens_per_s"], **prov},
+        "tokens_per_s_sharded": {
+            "value": tp.get("tokens_per_s", -1.0), **prov
+        },
+        "tokens_per_s_pipeline": {"value": pp["tokens_per_s"], **prov},
+    }
+    record = {
+        "metric": "sharded_tokens_per_s",
+        "unit": "tokens/s",
+        **provenance(),
+        "loadavg_1m_at_capture": round(os.getloadavg()[0], 2),
+        "data_parallel": dp,
+        "gspmd_batch_x_model": tp,
+        "mpmd_pipeline": pp,
+        "sharded_vs_dp": (
+            round(tp["tokens_per_s"] / dp["tokens_per_s"], 3)
+            if tp.get("tokens_per_s")
+            else None
+        ),
+        "gate_capture": gate_capture,
+    }
+
+    # Like-for-like trajectory: score this capture against the previous
+    # checked-in one with the bench gate's own comparator.
+    here = os.path.dirname(os.path.abspath(__file__))
+    prev_path = os.path.join(here, "BENCH_sharded.json")
+    if os.path.exists(prev_path):
+        try:
+            with open(prev_path) as f:
+                prev = json.load(f)
+            sys.path.insert(0, os.path.join(here, "scripts"))
+            import bench_gate
+
+            if prev.get("platform") == record.get("platform") and prev.get(
+                "gate_capture"
+            ):
+                result = bench_gate.compare_metric_dicts(
+                    prev["gate_capture"], gate_capture,
+                    bench_gate.DEFAULT_THRESHOLD,
+                )
+                record["gate_compare_vs_previous"] = {
+                    "regressions": result.get("regressions", []),
+                    "skips": len(result.get("skips", [])),
+                    "ok": len(result.get("ok", [])),
+                }
+            else:
+                record["gate_compare_vs_previous"] = "skipped: platform mismatch"
+        except Exception as e:  # noqa: BLE001 — the gate is advisory here
+            record["gate_compare_vs_previous"] = f"error: {e}"
+
+    out = json.dumps(record, indent=2)
+    print(out)
+    with open(prev_path, "w") as f:
+        f.write(out + "\n")
+    return 0
+
+
+def _bench_with_config(cfg) -> dict:
+    import numpy as np
+
+    import ray_tpu.train.sharding as sharding
+    from ray_tpu.models import gpt2
+
+    mcfg = _model_cfg()
+    plan = sharding.build_plan(cfg)
+    opt = gpt2.make_adamw(1e-3)
+
+    def init(rng):
+        import jax.numpy as jnp
+
+        return gpt2.GPT2(mcfg).init(
+            rng, jnp.zeros((2, 16), dtype=jnp.int32)
+        )["params"]
+
+    data = np.random.default_rng(0).integers(
+        0, 512, (WARMUP + STEPS, BATCH, SEQ)
+    ).astype(np.int32)
+    best = 0.0
+    runs = []
+    for _ in range(BEST_OF):
+        params, opt_state = plan.shard_init(init, opt)
+        step = plan.jit_train_step(
+            gpt2.make_train_step(mcfg, opt), params, opt_state
+        )
+        tps, _wall = _tokens_per_s(step, params, opt_state, data)
+        runs.append(round(tps, 1))
+        best = max(best, tps)
+    return {
+        "mesh": dict(plan.mesh.shape),
+        "tokens_per_s": round(best, 1),
+        "runs": runs,
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(main())

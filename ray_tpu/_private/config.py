@@ -203,6 +203,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # so the TTL bounds duplicate replacement launches after an
     # autoscaler restart to entries younger than this.
     "lost_capacity_ttl_s": 600.0,
+    # How long an elastic trainer's published grow intent stays in the
+    # autoscaler feed without a refresh.  The executor re-publishes on
+    # every failed grow attempt, so a live shrunken trainer keeps its
+    # hint warm and a dead one ages out within this window.
+    "grow_hint_ttl_s": 300.0,
     # --- gcs ---
     # "file": periodically snapshot GCS state (actors/PGs/KV/jobs) to the
     # session dir so a restarted GCS resumes the cluster (reference: redis

@@ -142,6 +142,13 @@ class GcsServer:
         # autoscaler via its node_id key (get_load_metrics exposes it);
         # entries expire after lost_capacity_ttl_s.
         self.lost_capacity: "deque" = deque(maxlen=256)
+        # Grow-intent signal (PR 4 follow-up): an elastic trainer running
+        # BELOW its target size publishes how much capacity it wants back
+        # so the autoscaler warms replacements BEFORE the epoch-boundary
+        # grow attempt, instead of discovering the gap from task demand
+        # it never queues.  Keyed by experiment name; entries expire
+        # after grow_hint_ttl_s (a dead trainer must not pin launches).
+        self.grow_hints: Dict[str, dict] = {}
 
         self.server.on_disconnect = self._on_disconnect
         self._bg_tasks: List[asyncio.Task] = []
@@ -2168,11 +2175,38 @@ class GcsServer:
         now = time.time()
         while self.lost_capacity and now - self.lost_capacity[0]["time"] > ttl:
             self.lost_capacity.popleft()
+        hint_ttl = float(CONFIG.grow_hint_ttl_s)
+        for name in [
+            n for n, h in self.grow_hints.items()
+            if now - h["time"] > hint_ttl
+        ]:
+            del self.grow_hints[name]
         return {
             "pending_demands": demands,
             "nodes": nodes,
             "lost_capacity": list(self.lost_capacity),
+            "grow_hints": list(self.grow_hints.values()),
         }
+
+    async def rpc_train_grow_hint(self, payload, conn):
+        """Publish/refresh (count > 0) or clear (count == 0) an elastic
+        trainer's pending grow intent.  The autoscaler folds live hints
+        into its demand view so replacement capacity is already booting
+        when the trainer's epoch-boundary try_grow runs."""
+        name = str(payload.get("name") or "")
+        if not name:
+            return False
+        count = max(0, int(payload.get("count") or 0))
+        if count == 0:
+            self.grow_hints.pop(name, None)
+            return True
+        self.grow_hints[name] = {
+            "name": name,
+            "count": count,
+            "resources": dict(payload.get("resources") or {}),
+            "time": time.time(),
+        }
+        return True
 
     # ------------------------------------------------------------------
     # observability (reference: gcs_task_manager.h:86, metric export

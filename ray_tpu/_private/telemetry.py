@@ -331,6 +331,27 @@ class _Metrics:
             "valid environment steps collected by streaming env runners "
             "(counted runner-side per fragment)",
         )
+        # --- sharded training plane (train/sharding/) ---
+        self.pipeline_stage = m.Histogram(
+            "pipeline_stage_seconds",
+            "per-step compute-busy seconds of one MPMD pipeline stage "
+            "(channel wait excluded) — the stage-balance signal",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                        10.0, 30.0, 60.0],
+            tag_keys=("stage",),
+        )
+        self.pipeline_bubble = m.Gauge(
+            "pipeline_bubble_fraction",
+            "fraction of a pipeline stage's step wall time spent idle "
+            "(1 - busy/wall); floor is (S-1)/(S-1+M) under 1F1B",
+            tag_keys=("stage",),
+        )
+        self.grow_hints = m.Counter(
+            "train_grow_hints_total",
+            "elastic-trainer grow intents published to the autoscaler "
+            "feed, by action (publish, clear)",
+            tag_keys=("action",),
+        )
 
 
 def _metrics() -> _Metrics:
@@ -759,3 +780,36 @@ def count_rllib_env_steps(n: int) -> None:
     if not enabled() or n <= 0:
         return
     _metrics().rllib_env_steps.inc(float(n))
+
+
+_pipeline_stage_bound: dict = {}
+_grow_hint_bound: dict = {}
+
+
+def observe_pipeline_stage(stage: int, seconds: float) -> None:
+    """Per-step busy seconds of one MPMD pipeline stage (stage label
+    cardinality is bounded by the pipeline depth)."""
+    if not enabled():
+        return
+    stage_s = str(stage)
+    b = _pipeline_stage_bound.get(stage_s) or _bind(
+        _pipeline_stage_bound, stage_s, "pipeline_stage", {"stage": stage_s}
+    )
+    b.observe(max(0.0, seconds))
+
+
+def set_pipeline_bubble(stage: int, fraction: float) -> None:
+    if not enabled():
+        return
+    _metrics().pipeline_bubble.set(
+        min(1.0, max(0.0, fraction)), tags={"stage": str(stage)}
+    )
+
+
+def count_grow_hint(action: str) -> None:
+    if not enabled():
+        return
+    b = _grow_hint_bound.get(action) or _bind(
+        _grow_hint_bound, action, "grow_hints", {"action": action}
+    )
+    b.inc(1.0)

@@ -57,6 +57,41 @@ def pick_replacement_type(node_types: Dict[str, dict],
     return min(candidates)[2]
 
 
+def fold_grow_hints(demands: List[Dict[str, float]], load_metrics: dict) -> None:
+    """Shared v1/v2: fold elastic trainers' published grow intents (PR 4
+    follow-up) into ``demands`` so replacement capacity is warm before
+    the trainer's epoch-boundary grow attempt — a shrunken trainer
+    queues no task demand while it adapts.
+
+    Deduped against the lost_capacity feed: a preemption that shrank the
+    trainer ALSO logged the node as lost capacity, and
+    :func:`replacement_launches` relaunches it with zero demand.  Each
+    lost entry whose resources cover the hinted shape absorbs one hinted
+    worker; without this, every preemption boots two nodes for one lost
+    worker (hint demand + capacity return)."""
+    lost = [
+        dict(e.get("resources_total", {}) or {})
+        for e in load_metrics.get("lost_capacity", ())
+    ]
+    for hint in load_metrics.get("grow_hints", ()):
+        shape = {
+            k: v for k, v in (hint.get("resources") or {}).items() if v
+        }
+        if not shape:
+            continue
+        count = int(hint.get("count") or 0)
+        remaining = []
+        for total in lost:
+            if count > 0 and all(
+                total.get(k, 0) >= v for k, v in shape.items()
+            ):
+                count -= 1
+            else:
+                remaining.append(total)
+        lost = remaining
+        demands.extend(dict(shape) for _ in range(count))
+
+
 def replacement_launches(node_types: Dict[str, dict], lost_capacity,
                          processed: set, budget: int) -> List[Tuple[str, str]]:
     """Shared v1/v2 capacity-return decision: which node types to launch
@@ -158,6 +193,7 @@ class StandardAutoscaler:
             load_metrics = self.gcs_client.call("get_load_metrics")
         demands: List[Dict[str, float]] = load_metrics.get("pending_demands", [])
         nodes_view: Dict[str, dict] = load_metrics.get("nodes", {})
+        fold_grow_hints(demands, load_metrics)
 
         workers = self.provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
         live_workers = sum(1 for n in nodes_view.values() if not n.get("is_head"))

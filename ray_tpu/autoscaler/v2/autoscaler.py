@@ -16,7 +16,11 @@ import logging
 import time
 from typing import Dict, Optional
 
-from ray_tpu.autoscaler.autoscaler import replacement_launches, request_node_drain
+from ray_tpu.autoscaler.autoscaler import (
+    fold_grow_hints,
+    replacement_launches,
+    request_node_drain,
+)
 from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
 from ray_tpu.autoscaler.v2.instance_manager import InstanceManager
 from ray_tpu.autoscaler.v2.sdk import get_cluster_resource_constraints
@@ -56,6 +60,9 @@ class AutoscalerV2:
                 demands += get_cluster_resource_constraints(self.gcs_client)
             except Exception:  # noqa: BLE001 — constraints are advisory
                 pass
+        # Elastic-trainer grow intents, deduped against the capacity
+        # return path below (shared with v1).
+        fold_grow_hints(demands, load_metrics)
         nodes_view: Dict[str, dict] = load_metrics.get("nodes", {})
 
         # Ray nodes by cloud instance id (provider maps the address);

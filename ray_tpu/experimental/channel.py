@@ -800,6 +800,246 @@ class SocketChannel:
 
 
 # ---------------------------------------------------------------------------
+# Shared-memory fan-out: one writer, N same-node readers
+#
+# Broadcasting one payload to N co-located consumers (pipeline weight
+# restore, activation/weight broadcast) previously cost N duplicate ring
+# writes — N encodes and N payload copies through N rings.  A fan-out
+# ring stores the payload ONCE; each reader owns a consume cursor, and
+# the writer's free space is bounded by the SLOWEST reader (min over
+# cursors), so flow control degrades exactly like a single-reader ring.
+#
+#     [wbytes u64][closed u64][n_readers u64][r0 u64]..[rN-1 u64][pad]
+#     [ring payload: [u64 len][data][pad8] / WRAP markers ...]
+
+
+def _fanout_header(n_readers: int) -> int:
+    return ((24 + 8 * n_readers + 63) // 64) * 64
+
+
+class FanoutChannel:
+    """Writer endpoint of a 1-to-N shm ring: write once, every reader
+    consumes independently (N consume-acks)."""
+
+    kind = "fanout"
+
+    def __init__(self, path: str, n_readers: int,
+                 max_size: int = 8 * 1024 * 1024, create: bool = False):
+        if n_readers < 1:
+            raise ValueError("fan-out channel needs at least one reader")
+        self.path = path
+        self.n_readers = n_readers
+        header = _fanout_header(n_readers)
+        if create:
+            with open(path, "wb") as f:
+                f.truncate(header + max_size)
+        self._f = open(path, "r+b")
+        size = os.fstat(self._f.fileno()).st_size
+        self._header = header
+        cap = size - header
+        self.capacity = cap - (cap % 8)
+        self.max_size = self.capacity - 16
+        self._mm = mmap.mmap(self._f.fileno(), size)
+        if create:
+            _U64.pack_into(self._mm, 16, n_readers)
+        else:
+            stored = _U64.unpack_from(self._mm, 16)[0]
+            if stored != n_readers:
+                raise ValueError(
+                    f"fan-out channel {path} was created for {stored} "
+                    f"readers, opened for {n_readers}"
+                )
+        self.stats = {"writes": 0, "bytes_written": 0, "write_blocked_s": 0.0}
+
+    def _reader_off(self, idx: int) -> int:
+        return 24 + 8 * idx
+
+    def _min_read(self) -> int:
+        return min(
+            _U64.unpack_from(self._mm, self._reader_off(i))[0]
+            for i in range(self.n_readers)
+        )
+
+    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
+        need = 8 + _align8(len(data))
+        if need > self.max_size:
+            raise ChannelCapacityError(
+                f"message of {len(data)} bytes exceeds fan-out channel "
+                f"capacity {self.max_size}; raise the buffer size"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        t_block = 0.0
+        cap = self.capacity
+        hdr = self._header
+        while True:
+            if _U64.unpack_from(self._mm, 8)[0]:
+                raise ChannelClosed(self.path)
+            wb = _U64.unpack_from(self._mm, 0)[0]
+            free = cap - (wb - self._min_read())
+            tail = cap - (wb % cap)
+            if tail < need:
+                if free >= tail:
+                    # Wrap: the tail region is free for EVERY reader.
+                    if tail >= 8:
+                        _U64.pack_into(self._mm, hdr + (wb % cap), WRAP)
+                    _U64.pack_into(self._mm, 0, wb + tail)
+                    continue
+            elif free >= need:
+                break
+            if spins == 0:
+                t_block = time.monotonic()
+            spins += 1
+            if spins < 4000:
+                time.sleep(0)
+            else:
+                time.sleep(min(0.001, 0.00002 * (spins - 3999)))
+            if deadline is not None and time.monotonic() > deadline:
+                self.stats["write_blocked_s"] += time.monotonic() - t_block
+                raise ChannelTimeout(
+                    f"slowest of {self.n_readers} fan-out readers of "
+                    f"{self.path} did not free ring space in time"
+                )
+        wpos = wb % cap
+        self._mm[hdr + wpos + 8: hdr + wpos + 8 + len(data)] = data
+        _U64.pack_into(self._mm, hdr + wpos, len(data))
+        _U64.pack_into(self._mm, 0, wb + need)
+        if spins:
+            self.stats["write_blocked_s"] += time.monotonic() - t_block
+        self.stats["writes"] += 1
+        self.stats["bytes_written"] += len(data)
+
+    def write_value(self, value: Any, tag: int = 0,
+                    timeout: Optional[float] = 30.0) -> None:
+        """One encode, N consumers.  The broadcast path is not the
+        per-microbatch hot loop, so the simple encode-then-copy beats
+        duplicating the ring's in-place encoder for a third layout."""
+        from ray_tpu._private import wire
+
+        self.write(wire.encode(value, tag), timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            _U64.pack_into(self._mm, 8, 1)
+        except ValueError:
+            pass
+        try:
+            self._mm.close()
+            self._f.close()
+        except Exception:
+            pass
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class FanoutReader:
+    """Reader endpoint ``index`` of a :class:`FanoutChannel`: consumes
+    every message exactly once at its own pace; advancing its cursor IS
+    its consume-ack."""
+
+    kind = "fanout"
+
+    def __init__(self, path: str, index: int):
+        self.path = path
+        self.index = index
+        self._f = open(path, "r+b")
+        size = os.fstat(self._f.fileno()).st_size
+        self._mm = mmap.mmap(self._f.fileno(), size)
+        n = _U64.unpack_from(self._mm, 16)[0]
+        if not 0 <= index < n:
+            raise ValueError(f"reader index {index} out of range (n={n})")
+        self.n_readers = n
+        self._header = _fanout_header(n)
+        cap = size - self._header
+        self.capacity = cap - (cap % 8)
+        self._off = 24 + 8 * index
+        self.stats = {"reads": 0, "bytes_read": 0, "read_blocked_s": 0.0}
+
+    def pending(self) -> bool:
+        try:
+            return (
+                _U64.unpack_from(self._mm, 0)[0]
+                != _U64.unpack_from(self._mm, self._off)[0]
+            )
+        except ValueError:
+            return False
+
+    def _next_slot(self) -> Optional[Tuple[int, int]]:
+        cap = self.capacity
+        while True:
+            rb = _U64.unpack_from(self._mm, self._off)[0]
+            if _U64.unpack_from(self._mm, 0)[0] == rb:
+                return None
+            rpos = rb % cap
+            tail = cap - rpos
+            if tail < 8:
+                _U64.pack_into(self._mm, self._off, rb + tail)
+                continue
+            n = _U64.unpack_from(self._mm, self._header + rpos)[0]
+            if n == WRAP:
+                _U64.pack_into(self._mm, self._off, rb + tail)
+                continue
+            return rpos, n
+
+    def read(self, timeout: Optional[float] = 30.0) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        t_block = 0.0
+        while True:
+            slot = self._next_slot()
+            if slot is not None:
+                rpos, n = slot
+                data = bytes(
+                    self._mm[self._header + rpos + 8: self._header + rpos + 8 + n]
+                )
+                rb = _U64.unpack_from(self._mm, self._off)[0]
+                _U64.pack_into(self._mm, self._off, rb + 8 + _align8(n))
+                self.stats["reads"] += 1
+                self.stats["bytes_read"] += n
+                if spins:
+                    self.stats["read_blocked_s"] += time.monotonic() - t_block
+                return data
+            if _U64.unpack_from(self._mm, 8)[0]:
+                raise ChannelClosed(self.path)
+            if spins == 0:
+                t_block = time.monotonic()
+            spins += 1
+            if spins < 4000:
+                time.sleep(0)
+            else:
+                time.sleep(min(0.001, 0.00002 * (spins - 3999)))
+            if deadline is not None and time.monotonic() > deadline:
+                self.stats["read_blocked_s"] += time.monotonic() - t_block
+                raise ChannelTimeout(
+                    f"no fan-out message on {self.path} within {timeout}s"
+                )
+
+    def read_value(self, timeout: Optional[float] = 30.0) -> Tuple[int, Any]:
+        from ray_tpu._private import wire
+
+        # The frame was copied out of the ring by read(); arrays may
+        # alias the private copy.
+        return wire.decode(memoryview(self.read(timeout)), copy_arrays=False)
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+            self._f.close()
+        except Exception:
+            pass
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # Compile-time endpoint plumbing
 
 

@@ -44,6 +44,8 @@ __all__ = [
     "report",
     "JaxTrainer",
     "JaxConfig",
+    "ShardingConfig",
+    "PipelineConfig",
 ]
 
 
@@ -52,8 +54,12 @@ def __getattr__(name):
         from ray_tpu.train import jax as _jax
 
         return getattr(_jax, name)
-    if name == "jax":
+    if name in ("jax", "sharding"):
         import importlib
 
-        return importlib.import_module("ray_tpu.train.jax")
+        return importlib.import_module(f"ray_tpu.train.{name}")
+    if name in ("ShardingConfig", "PipelineConfig"):
+        from ray_tpu.train import sharding as _sharding
+
+        return getattr(_sharding, name)
     raise AttributeError(name)

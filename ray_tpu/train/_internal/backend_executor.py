@@ -45,8 +45,12 @@ class BackendExecutor:
         scaling_config: ScalingConfig,
         run_config: RunConfig,
         experiment_name: str,
+        sharding_config=None,
     ):
         self.backend_config = backend_config
+        # GSPMD layout declaration (train/sharding): forwarded into every
+        # session so the loop can bind it to the live device view.
+        self.sharding_config = sharding_config
         self.backend = backend_config.backend_cls()()
         self.scaling = scaling_config
         self.run_config = run_config
@@ -298,6 +302,7 @@ class BackendExecutor:
                 dataset_shards=(dataset_shards[rank] if dataset_shards else None),
                 generation=self.generation,
                 collective_group_name=self.collective_group_name,
+                sharding_config=self.sharding_config,
             )
             refs.append(w.start_session.remote(self._train_fn, session_kwargs))
         ray_tpu.get(refs)
@@ -358,6 +363,10 @@ class BackendExecutor:
         elapsed = time.monotonic() - t0
         telemetry.count_resize_event(direction, trigger)
         telemetry.observe_resize(direction, elapsed)
+        # Publish (or clear) the pending grow intent NOW, not at the
+        # epoch boundary: the autoscaler needs the lead time to have
+        # replacement capacity warm when try_grow runs (PR 4 follow-up).
+        self._update_grow_hint()
         logger.warning(
             "elastic %s (%s): worker group %d -> %d (generation %d) in %.2fs",
             direction, trigger, from_size, to_size, self.generation, elapsed,
@@ -433,6 +442,29 @@ class BackendExecutor:
         self._reform(resume_checkpoint, "shrink", trigger, from_size)
         return True
 
+    def _update_grow_hint(self):
+        """Tell the autoscaler how many worker shapes this (elastic)
+        group still wants back; count 0 clears the hint.  Advisory:
+        failures never affect the resize path."""
+        if not self.elastic or self.worker_group is None:
+            return
+        want = self.scaling.num_workers - len(self.worker_group.workers)
+        try:
+            from ray_tpu._private import telemetry
+            from ray_tpu._private.worker import get_global_worker
+
+            get_global_worker().gcs_client.call(
+                "train_grow_hint",
+                {
+                    "name": self.experiment_name,
+                    "count": max(0, want),
+                    "resources": self.scaling._worker_resources(),
+                },
+            )
+            telemetry.count_grow_hint("publish" if want > 0 else "clear")
+        except Exception:
+            logger.debug("grow hint publish failed", exc_info=True)
+
     def try_grow(self, resume_checkpoint) -> bool:
         """Epoch-boundary grow: lease workers back toward num_workers.
         Each candidate must answer a ping within the lease timeout —
@@ -463,6 +495,9 @@ class BackendExecutor:
                 300.0,
             )
             self._next_grow_attempt = time.monotonic() + backoff
+            # Refresh the grow intent's TTL: the want is still unmet and
+            # the autoscaler should keep a replacement warm.
+            self._update_grow_hint()
             return False
         self._grow_failures = 0
         if len(group.workers) >= self.scaling.num_workers:
@@ -487,6 +522,19 @@ class BackendExecutor:
         return results
 
     def shutdown(self):
+        # A finished/abandoned run must not pin replacement launches.
+        if self.elastic and self.worker_group is not None:
+            try:
+                from ray_tpu._private import telemetry
+                from ray_tpu._private.worker import get_global_worker
+
+                get_global_worker().gcs_client.call(
+                    "train_grow_hint",
+                    {"name": self.experiment_name, "count": 0},
+                )
+                telemetry.count_grow_hint("clear")
+            except Exception:
+                pass
         if self._node_listener is not None:
             from ray_tpu._private.worker import get_global_worker
 

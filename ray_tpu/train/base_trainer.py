@@ -286,7 +286,8 @@ class DataParallelTrainer(BaseTrainer):
 
         while True:
             executor = BackendExecutor(
-                self.backend_config, self.scaling_config, self.run_config, name
+                self.backend_config, self.scaling_config, self.run_config, name,
+                sharding_config=getattr(self, "sharding_config", None),
             )
             proactive = False
             try:

@@ -31,7 +31,16 @@ class JaxTrainer(DataParallelTrainer):
         run_config: Optional[RunConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        sharding_config: Optional[Any] = None,
     ):
+        """``sharding_config`` (a
+        :class:`ray_tpu.train.sharding.ShardingConfig`) declares the
+        GSPMD layout for this run: a batch x model device mesh over the
+        worker group plus regex partition rules.  It travels to every
+        rank's session — inside the loop,
+        ``train.get_context().get_sharding_config()`` /
+        ``sharding.plan_from_context()`` bind it to the live global
+        device view (docs/sharded_training.md)."""
         super().__init__(
             train_loop_per_worker,
             train_loop_config=train_loop_config,
@@ -41,9 +50,11 @@ class JaxTrainer(DataParallelTrainer):
             datasets=datasets,
             resume_from_checkpoint=resume_from_checkpoint,
         )
+        self.sharding_config = sharding_config
 
     def _constructor_state(self):
         state = super()._constructor_state()
         # This constructor names the backend config `jax_config`.
         state["jax_config"] = state.pop("backend_config")
+        state["sharding_config"] = self.sharding_config
         return state

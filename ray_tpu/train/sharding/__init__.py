@@ -1,0 +1,57 @@
+"""ray_tpu.train.sharding — the sharded training plane.
+
+Two halves (ROADMAP item 2; PAPERS.md "Scalable Training of Language
+Models using JAX pjit and TPUv4" and "Scaling Deep Learning Training
+with MPMD Pipeline Parallelism"):
+
+* **GSPMD** (`rules.py`, `gspmd.py`, `checkpoint.py`): a
+  ``ShardingConfig(mesh=("batch", "model"), partition_rules=[...])``
+  declares a 2-D device mesh over the worker group and regex partition
+  rules over flattened parameter paths (fmengine's
+  ``match_partition_rules`` shape — SNIPPETS.md [1][3]).  ``GspmdPlan``
+  jits the train step with explicit ``NamedSharding`` in/out shardings
+  so params + optimizer state shard over the ``model`` axis while data
+  parallelism rides ``batch``; checkpoints save per-shard and re-shard
+  onto a different mesh on elastic resize.
+* **MPMD** (`pipeline_plane.py`): ``PipelineConfig(stages, microbatches)``
+  splits the model into stage ACTOR groups placed via placement groups;
+  activations/grads flow stage-to-stage as wire frames over the
+  compiled-channel dataplane (shm rings same-node, sockets cross-node —
+  no object store on the steady-state path) under a 1F1B microbatch
+  schedule, with per-stage timing and bubble-fraction telemetry.
+"""
+
+from ray_tpu.train.sharding.rules import (
+    ShardingConfig,
+    UnmatchedParamError,
+    gpt2_partition_rules,
+    match_partition_rules,
+)
+from ray_tpu.train.sharding.gspmd import (
+    GspmdPlan,
+    build_mesh,
+    build_plan,
+    plan_from_context,
+)
+from ray_tpu.train.sharding.checkpoint import load_sharded, save_sharded
+from ray_tpu.train.sharding.pipeline_plane import (
+    PipelineConfig,
+    PipelinePlane,
+    gpt2_pipeline_programs,
+)
+
+__all__ = [
+    "ShardingConfig",
+    "UnmatchedParamError",
+    "match_partition_rules",
+    "gpt2_partition_rules",
+    "GspmdPlan",
+    "build_mesh",
+    "build_plan",
+    "plan_from_context",
+    "save_sharded",
+    "load_sharded",
+    "PipelineConfig",
+    "PipelinePlane",
+    "gpt2_pipeline_programs",
+]

@@ -140,6 +140,18 @@ def main() -> int:
             and ("direction", "shrink") in tuple(tags)
         )
         assert shrinks >= 1, "train_resize_events_total{shrink} never incremented"
+        # PR 4 follow-up: the shrink must have PUBLISHED a grow intent to
+        # the autoscaler feed (and the finished run must have cleared it).
+        hint_actions = {
+            dict(tags).get("action")
+            for (name, tags), rec in metrics_mod._registry.items()
+            if name == "train_grow_hints_total" and rec.get("value", 0.0) > 0
+        }
+        assert "publish" in hint_actions, (
+            "shrunken trainer never published a grow hint"
+        )
+        hints_after = worker.gcs_client.call("get_load_metrics")["grow_hints"]
+        assert hints_after == [], f"grow hint not cleared at shutdown: {hints_after}"
         span_names = [s.get("name") for s in tracing._finished_spans]
         assert "train.resize" in span_names, "no train.resize span recorded"
 

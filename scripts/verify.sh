@@ -118,6 +118,19 @@ if [ "${RAY_TPU_SKIP_BENCH_GATE:-0}" != "1" ]; then
   fi
 fi
 
+# Sharded train smoke (GSPMD + MPMD planes end-to-end on CPU devices):
+# batch x model mesh loss parity vs data parallel, per-shard checkpoint
+# re-shard across a mesh resize, and a 2-stage pipeline over real
+# channels matching single-process loss.  Skippable via
+# RAY_TPU_SKIP_SHARDED_SMOKE=1.
+if [ "${RAY_TPU_SKIP_SHARDED_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/sharded_train_smoke.py; then
+    echo "sharded train smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Elastic smoke (resize-on-preemption end-to-end): 2-node local cluster,
 # elastic JaxTrainer (min_workers=1), preempt one rank's node mid-run,
 # assert shrink -> resume -> completion with zero failure charges and

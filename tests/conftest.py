@@ -51,6 +51,7 @@ _ENV_LIMITED = {
     "test_ops.py::test_ring_attention_matches_reference": "shard_map",
     "test_ops.py::test_ring_attention_composes_with_dp": "shard_map",
     "test_pipeline.py::test_gpt2_pp_interleaved_matches_unpipelined": "shard_map",
+    "test_sharded_train.py::test_jax_trainer_carries_sharding_config": "multiprocess_backend",
     "test_train.py::test_jax_trainer_distributed_mlp": "multiprocess_backend",
     "test_train.py::test_jax_trainer_resume_from_checkpoint": "multiprocess_backend",
     "test_train.py::test_trainer_restore_from_experiment_dir": "multiprocess_backend",

@@ -337,3 +337,128 @@ def test_replacement_launches_prune_survives_budget_break():
     # Aged-out entries DO get pruned once the GCS TTL drops them.
     assert replacement_launches(types, [], processed, budget=2) == []
     assert processed == set()
+
+
+def test_grow_hint_rpc_roundtrip(ray_cluster):
+    """train_grow_hint publishes into the load-metrics feed; count 0
+    clears; stale hints age out by TTL at read time."""
+    worker = ray_tpu._private.worker.get_global_worker()
+    gcs = worker.gcs_client
+    assert gcs.call(
+        "train_grow_hint",
+        {"name": "exp_grow", "count": 2, "resources": {"CPU": 1.0}},
+    )
+    hints = gcs.call("get_load_metrics")["grow_hints"]
+    assert [h["name"] for h in hints] == ["exp_grow"]
+    assert hints[0]["count"] == 2
+    assert hints[0]["resources"] == {"CPU": 1.0}
+    # refresh replaces in place (no duplicates)
+    gcs.call(
+        "train_grow_hint",
+        {"name": "exp_grow", "count": 1, "resources": {"CPU": 1.0}},
+    )
+    hints = gcs.call("get_load_metrics")["grow_hints"]
+    assert len(hints) == 1 and hints[0]["count"] == 1
+    gcs.call("train_grow_hint", {"name": "exp_grow", "count": 0})
+    assert gcs.call("get_load_metrics")["grow_hints"] == []
+    # nameless publishes are refused, not stored
+    assert gcs.call("train_grow_hint", {"count": 3}) is False
+
+
+def test_autoscaler_launches_for_grow_hints():
+    """A grow hint alone — zero pending task demand — pulls up worker
+    capacity sized to the hinted shape, so the elastic trainer's
+    epoch-boundary grow finds it warm."""
+    provider = _RecordingProvider()
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=4,
+    )
+    feed = {
+        "pending_demands": [],
+        "nodes": {},
+        "lost_capacity": [],
+        "grow_hints": [
+            {"name": "exp", "count": 2, "resources": {"CPU": 1.0},
+             "time": 0.0}
+        ],
+    }
+    autoscaler.update(load_metrics=feed)
+    assert len(provider.created) == 1
+    # one 2-CPU worker covers both hinted 1-CPU shapes
+    assert provider.created[0][1] == 1
+    # empty shapes are ignored rather than minting zero-resource demand
+    provider.created.clear()
+    autoscaler2 = StandardAutoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=4,
+    )
+    autoscaler2.update(load_metrics={
+        "pending_demands": [], "nodes": {}, "lost_capacity": [],
+        "grow_hints": [{"name": "e", "count": 2, "resources": {}}],
+    })
+    assert provider.created == []
+
+
+def test_grow_hint_deduped_against_capacity_return():
+    """A preemption that shrank an elastic trainer logs BOTH a
+    lost_capacity entry and a grow hint for the same worker — the
+    replacement launch must not be doubled by the hint."""
+    provider = _RecordingProvider()
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=8,
+    )
+    feed = {
+        "pending_demands": [],
+        "nodes": {},
+        "lost_capacity": [
+            {"node_id": "deadbeef03", "resources_total": {"CPU": 2},
+             "reason": "PREEMPTION", "time": 0.0}
+        ],
+        "grow_hints": [
+            {"name": "exp", "count": 1, "resources": {"CPU": 1.0},
+             "time": 0.0}
+        ],
+    }
+    autoscaler.update(load_metrics=feed)
+    # one node total: the capacity return already covers the hinted worker
+    assert autoscaler.num_capacity_returns == 1
+    assert len(provider.created) == 1
+    assert provider.created[0][1] == 1
+    # Hint demand BEYOND what the lost entry covers still launches: 3
+    # hinted 1-CPU workers minus the one absorbed leaves 2, bin-packed
+    # onto one 2-CPU node alongside the replacement.
+    provider = _RecordingProvider()
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=8,
+    )
+    feed["grow_hints"][0]["count"] = 3
+    autoscaler.update(load_metrics=feed)
+    assert autoscaler.num_capacity_returns == 1
+    assert sum(c for _, c in provider.created) == 2
+
+
+def test_autoscaler_v2_launches_for_grow_hints():
+    """v2 folds hints through the same shared helper as v1."""
+    from ray_tpu.autoscaler.v2.autoscaler import AutoscalerV2
+
+    provider = _RecordingProvider()
+    autoscaler = AutoscalerV2(
+        provider,
+        node_types={"cpu_worker": {"resources": {"CPU": 2}}},
+        max_workers=4,
+    )
+    autoscaler.update(load_metrics={
+        "pending_demands": [], "nodes": {}, "lost_capacity": [],
+        "grow_hints": [
+            {"name": "exp", "count": 2, "resources": {"CPU": 1.0},
+             "time": 0.0}
+        ],
+    })
+    assert len(provider.created) == 1

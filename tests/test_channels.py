@@ -538,3 +538,104 @@ def test_wire_error_tag_roundtrip():
     assert tag == serialization.TAG_ERROR
     with pytest.raises(ValueError, match="original"):
         raise out.as_instanceof_cause()
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory fan-out (one writer, N same-node readers)
+
+
+def test_fanout_every_reader_sees_every_message_once(tmp_path):
+    from ray_tpu.experimental.channel import FanoutChannel, FanoutReader
+
+    p = str(tmp_path / "f1")
+    ch = FanoutChannel(p, 3, max_size=1 << 16, create=True)
+    readers = [FanoutReader(p, i) for i in range(3)]
+    import numpy as np
+
+    for k in range(5):
+        ch.write_value({"k": k, "arr": np.arange(4) + k})
+    for r in readers:
+        for k in range(5):
+            _tag, v = r.read_value(timeout=5)
+            assert v["k"] == k
+            assert int(v["arr"][0]) == k
+        assert not r.pending()
+    assert ch.stats["writes"] == 5  # one write serves all three readers
+    ch.close()
+    for r in readers:
+        r.close()
+
+
+def test_fanout_flow_control_bounded_by_slowest_reader(tmp_path):
+    """The writer's free space is min over reader cursors: two fast
+    readers can't unblock a ring the slow third still holds."""
+    from ray_tpu.experimental.channel import (
+        ChannelTimeout as CT,
+        FanoutChannel,
+        FanoutReader,
+    )
+
+    p = str(tmp_path / "f2")
+    ch = FanoutChannel(p, 3, max_size=1 << 14, create=True)
+    readers = [FanoutReader(p, i) for i in range(3)]
+    payload = b"x" * 3000
+    wrote = 0
+    with pytest.raises(CT):
+        for _ in range(50):
+            ch.write(payload, timeout=0.2)
+            wrote += 1
+    assert 0 < wrote < 50
+    for r in readers[:2]:
+        for _ in range(wrote):
+            r.read(timeout=5)
+    with pytest.raises(CT):  # slowest reader still pins the ring
+        ch.write(payload, timeout=0.2)
+    for _ in range(wrote):
+        readers[2].read(timeout=5)
+    ch.write(payload, timeout=5)  # now it fits
+    for r in readers:
+        assert r.read(timeout=5) == payload
+        r.close()
+    ch.close()
+
+
+def test_fanout_wraps_and_drains_before_close(tmp_path):
+    from ray_tpu.experimental.channel import (
+        ChannelClosed as CC,
+        FanoutChannel,
+        FanoutReader,
+    )
+
+    p = str(tmp_path / "f3")
+    ch = FanoutChannel(p, 2, max_size=1 << 12, create=True)
+    readers = [FanoutReader(p, i) for i in range(2)]
+    # force several wraps while readers keep pace
+    for k in range(40):
+        ch.write(bytes([k]) * 900, timeout=5)
+        for r in readers:
+            assert r.read(timeout=5) == bytes([k]) * 900
+    ch.write(b"final")
+    ch.close()
+    for r in readers:
+        assert r.read(timeout=5) == b"final"  # backlog drains first
+        with pytest.raises(CC):
+            r.read(timeout=1)
+        r.close()
+
+
+def test_fanout_capacity_and_index_validation(tmp_path):
+    from ray_tpu.experimental.channel import (
+        ChannelCapacityError,
+        FanoutChannel,
+        FanoutReader,
+    )
+
+    p = str(tmp_path / "f4")
+    ch = FanoutChannel(p, 2, max_size=1 << 12, create=True)
+    with pytest.raises(ChannelCapacityError):
+        ch.write(b"x" * (1 << 13))
+    with pytest.raises(ValueError, match="out of range"):
+        FanoutReader(p, 2)
+    with pytest.raises(ValueError, match="created for"):
+        FanoutChannel(p, 3)
+    ch.close()

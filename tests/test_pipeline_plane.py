@@ -1,0 +1,241 @@
+"""MPMD pipeline plane (train/sharding/pipeline_plane.py): stage actors
+over real compiled channels match single-process loss to fixed-seed
+parity, per-stage timing/bubble metrics surface, and a chaos kill
+mid-epoch recovers by whole-pipeline checkpoint-restart."""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.models import gpt2  # noqa: E402
+from ray_tpu.train.sharding import (  # noqa: E402
+    PipelineConfig,
+    PipelinePlane,
+    gpt2_pipeline_programs,
+)
+from ray_tpu.train.sharding.pipeline_plane import schedule_ops  # noqa: E402
+
+
+def _cfg():
+    return gpt2.GPT2Config(
+        vocab_size=128, n_layer=2, n_head=2, d_model=32, max_seq_len=32,
+        dtype=jnp.float32, remat=False,
+    )
+
+
+def _data(steps, batch=4, seq=17, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 128, (steps, batch, seq)).astype(np.int32)
+
+    def data_fn(step):
+        toks = data[step]
+        return toks[:, :-1], toks[:, 1:]
+
+    return data_fn
+
+
+def _reference_losses(cfg, data_fn, steps, lr=1e-3, seed=0):
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(seed))
+    opt = gpt2.make_adamw(lr)
+    opt_state = opt.init(params)
+    step_fn = jax.jit(gpt2.make_train_step(cfg, opt))
+    out = []
+    for s in range(steps):
+        toks, tgts = data_fn(s)
+        params, opt_state, loss = step_fn(
+            params, opt_state, jnp.asarray(toks), jnp.asarray(tgts)
+        )
+        out.append(float(loss))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# schedule unit tests (no cluster)
+
+
+def test_schedule_ops_1f1b_shape():
+    # stage 0 of 3, M=4: 2 warmup F, 2 (F,B) pairs, 2 cooldown B
+    assert schedule_ops(0, 3, 4) == ["F", "F", "F", "B", "F", "B", "B", "B"]
+    # last stage: pure alternation
+    assert schedule_ops(2, 3, 4) == ["F", "B"] * 4
+    for s in range(3):
+        ops = schedule_ops(s, 3, 4)
+        assert ops.count("F") == 4 and ops.count("B") == 4
+    # degenerate M < warmup window
+    assert schedule_ops(0, 4, 2) == ["F", "F", "B", "B"]
+
+
+def test_pipeline_config_validation():
+    with pytest.raises(ValueError, match="2 stages"):
+        PipelineConfig(stages=1)
+    with pytest.raises(ValueError, match="microbatches"):
+        PipelineConfig(stages=2, microbatches=0)
+
+
+def test_gpt2_program_split_merge_roundtrip():
+    cfg = _cfg()
+    prog = gpt2_pipeline_programs(cfg, n_stages=2)
+    params = prog.init_params()
+    stages = [prog.split(params, s) for s in range(2)]
+    assert "wte" in stages[0] and "lm_head" in stages[1]
+    assert "h_0" in stages[0] and "h_1" in stages[1]
+    merged = prog.merge(stages)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(merged), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gpt2_program_rejects_indivisible_layers():
+    cfg = _cfg()  # n_layer=2
+    prog = gpt2_pipeline_programs(cfg, n_stages=3)
+    with pytest.raises(ValueError, match="divisible"):
+        prog.split(gpt2.init_params(cfg), 0)
+
+
+# ---------------------------------------------------------------------------
+# cluster tests
+
+
+@pytest.mark.parametrize("n_micro", [2, 4])
+def test_pipeline_matches_single_process_loss(ray_cluster, n_micro):
+    """Acceptance bar: an N-stage pipeline over real channels matches
+    single-process loss to fixed-seed parity for M microbatches."""
+    cfg = _cfg()
+    steps = 3
+    data_fn = _data(steps)
+    prog = gpt2_pipeline_programs(cfg, n_stages=2, lr=1e-3, seed=0)
+    plane = PipelinePlane(
+        prog,
+        PipelineConfig(stages=2, microbatches=n_micro, step_timeout_s=120.0),
+    )
+    try:
+        losses = plane.run(data_fn, steps)
+        stats = plane.stage_stats()
+    finally:
+        plane.stop()
+    ref = _reference_losses(cfg, data_fn, steps)
+    assert losses == pytest.approx(ref, abs=2e-5)
+    # per-stage timing + bubble metrics exist and are sane
+    assert len(stats) == 2
+    for s in stats:
+        assert s["steps"] == steps
+        assert s["microbatches"] == steps * n_micro
+        assert s["busy_s"] > 0
+        assert 0.0 <= s["bubble_fraction"] <= 1.0
+
+
+def test_pipeline_metrics_reach_cluster_state(ray_cluster):
+    """pipeline_stage_seconds / pipeline_bubble_fraction surface via
+    util.state.metrics() — the PR 10 profiling plane sees the stages."""
+    from ray_tpu.util import state
+
+    cfg = _cfg()
+    data_fn = _data(2)
+    prog = gpt2_pipeline_programs(cfg, n_stages=2, lr=1e-3, seed=0)
+    plane = PipelinePlane(
+        prog, PipelineConfig(stages=2, microbatches=2, step_timeout_s=120.0)
+    )
+
+    def _names():
+        return {m.get("name") for m in state.metrics()}
+
+    try:
+        plane.run(data_fn, 2)
+        # Stage actors stay alive here so their 2 s metric flusher ships
+        # the series; only then tear the plane down.
+        deadline = time.monotonic() + 30.0
+        poll = 0.3
+        names = _names()
+        while (
+            "pipeline_stage_seconds" not in names
+            and time.monotonic() < deadline
+        ):
+            time.sleep(poll)
+            names = _names()
+    finally:
+        plane.stop()
+    assert "pipeline_stage_seconds" in names
+    assert "pipeline_bubble_fraction" in names
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # ~25 s kill/restart drill: runs under `-m chaos`
+def test_pipeline_chaos_kill_recovers_with_parity(ray_cluster):
+    """Chaos drill: kill one stage actor mid-epoch (past the last
+    checkpoint).  The plane restarts the WHOLE pipeline from its
+    checkpoint, replays the uncheckpointed steps, and lands on the same
+    losses as an undisturbed run — and the recovery is a restart, never
+    a silent skip (restarts == 1).  The kill path must also reap the
+    stage-side shm ring dirs (tmpfs is RAM; stop_loop never ran)."""
+    import glob
+    import os
+
+    from ray_tpu.experimental.channel import ring_base_dir
+
+    cfg = _cfg()
+    steps = 5
+    data_fn = _data(steps)
+    rings_before = set(
+        glob.glob(os.path.join(ring_base_dir(), "ray_tpu_pp*"))
+    )
+
+    def make_plane():
+        prog = gpt2_pipeline_programs(cfg, n_stages=2, lr=1e-3, seed=0)
+        return PipelinePlane(
+            prog,
+            PipelineConfig(
+                stages=2, microbatches=2, step_timeout_s=8.0,
+                checkpoint_every=2, max_restarts=1,
+            ),
+        )
+
+    plane = make_plane()
+    try:
+        clean = plane.run(data_fn, steps)
+    finally:
+        plane.stop()
+
+    plane = make_plane()
+    try:
+        part = plane.run(data_fn, 3)  # checkpoint landed at step 2
+        ray_tpu.kill(plane.actors[1])  # step 3 is NOT checkpointed
+        rest = plane.run(data_fn, steps)  # recovers + replays 2..4
+        chaos = [part[i] if i < 2 else rest[i] for i in range(steps)]
+        assert plane.restarts == 1
+    finally:
+        plane.stop()
+    assert chaos == pytest.approx(clean, abs=2e-5)
+    rings_after = set(
+        glob.glob(os.path.join(ring_base_dir(), "ray_tpu_pp*"))
+    )
+    assert rings_after <= rings_before
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # ~10 s kill-past-budget drill: runs under `-m chaos`
+def test_pipeline_restart_budget_exhausts_typed(ray_cluster):
+    """Past max_restarts the failure propagates typed, not as a hang."""
+    from ray_tpu.train.sharding.pipeline_plane import StageFailedError
+
+    cfg = _cfg()
+    data_fn = _data(4)
+    prog = gpt2_pipeline_programs(cfg, n_stages=2, lr=1e-3, seed=0)
+    plane = PipelinePlane(
+        prog,
+        PipelineConfig(
+            stages=2, microbatches=2, step_timeout_s=4.0, max_restarts=0
+        ),
+    )
+    try:
+        plane.run(data_fn, 1)
+        ray_tpu.kill(plane.actors[0])
+        with pytest.raises(StageFailedError):
+            plane.run(data_fn, 2)
+    finally:
+        plane.stop()

@@ -1,0 +1,126 @@
+"""Partition-rule matching (train/sharding/rules.py): regex precedence,
+unmatched-leaf typed error, scalar replication, mesh-divisibility
+clipping, and the tested GPT-2 rule set."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from ray_tpu.train.sharding import (  # noqa: E402
+    ShardingConfig,
+    UnmatchedParamError,
+    gpt2_partition_rules,
+    match_partition_rules,
+)
+
+
+def _leaf(*shape):
+    return jnp.zeros(shape, dtype=jnp.float32)
+
+
+def test_first_match_wins_precedence():
+    """Rules are ORDERED: an earlier, broader rule shadows a later,
+    more specific one — precedence is the list order, not specificity."""
+    params = {"attn": {"qkv": {"kernel": _leaf(8, 24)}}}
+    spec = match_partition_rules(
+        [(r"kernel", ("model", None)), (r"qkv/kernel", (None, "model"))], params
+    )
+    assert spec["attn"]["qkv"]["kernel"] == P("model", None)
+    # Reversed order: the specific rule now wins.
+    spec = match_partition_rules(
+        [(r"qkv/kernel", (None, "model")), (r"kernel", ("model", None))], params
+    )
+    assert spec["attn"]["qkv"]["kernel"] == P(None, "model")
+
+
+def test_unmatched_leaf_raises_typed_error_naming_all_gaps():
+    params = {
+        "a": {"kernel": _leaf(4, 4)},
+        "b": {"mystery": _leaf(4, 4)},
+        "c": {"enigma": _leaf(4,)},
+    }
+    with pytest.raises(UnmatchedParamError) as ei:
+        match_partition_rules([(r"kernel", (None, "model"))], params)
+    # One failure names EVERY gap, with paths.
+    assert sorted(ei.value.paths) == ["b/mystery", "c/enigma"]
+    assert "b/mystery" in str(ei.value)
+
+
+def test_scalars_and_size_one_replicate_without_rules():
+    params = {"count": _leaf(), "one": _leaf(1)}
+    spec = match_partition_rules([], params)
+    assert spec["count"] == P()
+    assert spec["one"] == P()
+
+
+def test_non_strict_replicates_unmatched():
+    params = {"mystery": _leaf(4, 4)}
+    spec = match_partition_rules([], params, strict=False)
+    assert spec["mystery"] == P()
+
+
+def test_spec_clipped_to_rank_and_mesh_divisibility():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("batch", "model"))
+    params = {
+        "v": {"kernel": _leaf(6)},          # rank 1 < spec rank 2
+        "odd": {"kernel": _leaf(7, 8)},     # 7 % 2 != 0 -> dim replicates
+        "ghost": {"kernel": _leaf(8, 8)},   # axis not in mesh -> dropped
+    }
+    spec = match_partition_rules(
+        [
+            (r"v/kernel", (None, "model")),
+            (r"odd/kernel", ("model", "model")),
+            (r"ghost/kernel", ("expert", "model")),
+        ],
+        params,
+        mesh,
+    )
+    assert spec["v"]["kernel"] == P(None)
+    assert spec["odd"]["kernel"] == P(None, "model")
+    assert spec["ghost"]["kernel"] == P(None, "model")
+
+
+def test_gpt2_rule_set_covers_and_shards_gpt2_tiny():
+    """The shipped rule set must cover EVERY gpt2 leaf (no
+    UnmatchedParamError) and produce the Megatron pairing."""
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny(remat=False)
+    params = jax.eval_shape(lambda: gpt2.init_params(cfg))
+    spec = match_partition_rules(gpt2_partition_rules(), params)
+    assert spec["wte"]["embedding"] == P("model", None)
+    assert spec["wpe"]["embedding"] == P(None, None)
+    blk = spec["h_0"]
+    assert blk["attn"]["qkv"]["kernel"] == P(None, "model")
+    assert blk["attn"]["attn_out"]["kernel"] == P("model", None)
+    assert blk["mlp"]["mlp_up"]["kernel"] == P(None, "model")
+    assert blk["mlp"]["mlp_down"]["kernel"] == P("model", None)
+    assert spec["lm_head"]["kernel"] == P(None, "model")
+    # norms/biases replicate (specs pad to rank: P(None) == replicated)
+    assert all(a is None for a in blk["ln_1"]["scale"])
+    assert all(a is None for a in blk["attn"]["qkv"]["bias"])
+    assert all(a is None for a in spec["ln_f"]["bias"])
+
+
+def test_sharding_config_validation_and_defaults():
+    with pytest.raises(ValueError, match="batch_axis"):
+        ShardingConfig(mesh=("data", "model"), batch_axis="batch")
+    with pytest.raises(ValueError, match="mesh_shape"):
+        ShardingConfig(mesh_shape={"expert": 2})
+    cfg = ShardingConfig()
+    shape = cfg.resolve_shape(8)
+    assert shape == {"batch": -1, "model": 8} or shape["model"] in (2, 4, 8)
+    # A partial shape must not silently idle devices: the unpinned
+    # batch axis absorbs the remainder ({"model": 2} on 8 devices is a
+    # 4x2 mesh, not 1x2 with 6 chips dark).
+    cfg = ShardingConfig(mesh_shape={"model": 2})
+    assert cfg.resolve_shape(8) == {"model": 2, "batch": -1}
+    # ... unless the batch axis is pinned, or another axis already
+    # carries the -1 (at most one absorber).
+    cfg = ShardingConfig(mesh_shape={"batch": 4})
+    assert cfg.resolve_shape(8) == {"batch": 4, "model": 1}
+    cfg = ShardingConfig(mesh_shape={"model": -1})
+    assert cfg.resolve_shape(8) == {"model": -1, "batch": 1}
