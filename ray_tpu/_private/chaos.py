@@ -62,7 +62,16 @@ from typing import List, NamedTuple, Optional, Tuple
 from ray_tpu._private.config import CONFIG
 
 _ACTIONS = ("drop_req", "drop_rep", "delay_req", "delay_rep", "dup_req", "kill",
-            "preempt")
+            "preempt",
+            # channel-level dataplane faults (pattern "chan:<path-glob>",
+            # consulted in the write paths of experimental/channel.py)
+            "drop_frame", "delay_frame", "corrupt_frame", "torn_write",
+            "close")
+
+# The dataplane subset of _ACTIONS: rules carrying one of these only
+# ever match channel writes (decide() skips them and they skip RPCs).
+_CHANNEL_ACTIONS = ("drop_frame", "delay_frame", "corrupt_frame",
+                    "torn_write", "close")
 
 # Bound on the in-memory schedule log; fired entries past this are
 # counted but not stored.
@@ -80,6 +89,32 @@ class Decision(NamedTuple):
 
 
 _CLEAN = Decision(False, 0.0, False)
+
+
+class ChannelDecision(NamedTuple):
+    """Fault verdict for one channel frame write (experimental/channel.py
+    consults this at every ``write``/``write_value`` when the plane is
+    active).  ``corrupt`` flips payload bytes after the CRC trailer is
+    computed (the reader's CRC check must catch it); ``torn`` publishes
+    a half-written record (ring) or cuts the connection mid-frame
+    (socket) — the SIGKILLed-writer model; ``close`` closes the channel
+    out from under both peers (ring: closed flag; socket: abrupt TCP
+    close, no poison — the transient-drop model the reattach path
+    recovers from)."""
+
+    drop: bool
+    delay_s: float
+    corrupt: bool
+    torn: bool
+    close: bool
+
+    @property
+    def clean(self) -> bool:
+        return not (self.drop or self.corrupt or self.torn or self.close
+                    or self.delay_s > 0)
+
+
+_CHAN_CLEAN = ChannelDecision(False, 0.0, False, False, False)
 
 
 class _Rule:
@@ -159,6 +194,14 @@ class ChaosPlane:
         self.schedule_len = 0
         self._active = False
         self._last_check = 0.0
+        # Bumped by reset(): per-frame dataplane callers cache `active`
+        # keyed on this so their no-chaos fast path is one int compare,
+        # not a time.monotonic() throttle check per frame.
+        self.rev = 0
+        # True only when the parsed spec contains chan:* rules — an
+        # RPC-only drill must not make every dataplane frame write take
+        # the plane lock and scan the rule list just to skip it.
+        self.has_channel_rules = False
 
     # ------------------------------------------------------------------
     def _ensure(self):
@@ -207,9 +250,15 @@ class ChaosPlane:
                 rules = []
             self._rules = rules
             self._active = bool(rules)
+            self.has_channel_rules = any(
+                r.action in _CHANNEL_ACTIONS for r in rules
+            )
             self.schedule = []
             self.schedule_len = 0
             self._parsed_for = key
+            # a spec picked up from the env mid-process (no reset())
+            # must also invalidate the dataplane's rev-keyed cache
+            self.rev += 1
 
     @property
     def active(self) -> bool:
@@ -221,6 +270,7 @@ class ChaosPlane:
         with self._lock:
             self._parsed_for = None
             self._last_check = 0.0
+            self.rev += 1
 
     # ------------------------------------------------------------------
     def _log(self, rule: _Rule, verdict: str):
@@ -263,6 +313,47 @@ class ChaosPlane:
     def should_drop(self, method: str, kind: str) -> bool:
         """Legacy hook-compatible view (reference: rpc_chaos.h)."""
         return self.decide(method, kind).drop
+
+    def decide_channel(self, path: str) -> ChannelDecision:
+        """Fault decision for one frame written to the channel at
+        ``path`` (ring file path, ``socket:<peer>``, or a fan-out
+        path).  Rules match with pattern ``chan:<path-glob>`` and one of
+        the ``_CHANNEL_ACTIONS``; verdicts are deterministic in each
+        rule's match ordinal exactly like the RPC rules, so a seeded
+        dataplane fault schedule replays."""
+        if not self.active or not self.has_channel_rules:
+            return _CHAN_CLEAN
+        drop = corrupt = torn = close = False
+        delay_s = 0.0
+        fired_rules = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.action not in _CHANNEL_ACTIONS:
+                    continue
+                if not rule.pattern.startswith("chan:"):
+                    continue
+                if not fnmatch.fnmatchcase(path, rule.pattern[5:]):
+                    continue
+                fired = rule.evaluate()
+                self._log(rule, "fire" if fired else "skip")
+                if not fired:
+                    continue
+                fired_rules.append(rule)
+                if rule.action == "drop_frame":
+                    drop = True
+                elif rule.action == "delay_frame":
+                    delay_s += rule.delay_s
+                elif rule.action == "corrupt_frame":
+                    corrupt = True
+                elif rule.action == "torn_write":
+                    torn = True
+                else:  # close
+                    close = True
+        for rule in fired_rules:  # outside the lock: metric writes lock too
+            _count_injection(rule)
+        if not fired_rules:
+            return _CHAN_CLEAN
+        return ChannelDecision(drop, delay_s, corrupt, torn, close)
 
     # ------------------------------------------------------------------
     def maybe_kill(self, point: str) -> bool:

@@ -290,6 +290,33 @@ class _Metrics:
             "compiled-DAG executions in flight (submitted, result not yet "
             "read) — channel-plane occupancy as seen by the driver",
         )
+        self.channel_corruption = m.Counter(
+            "channel_corruption_total",
+            "frames whose CRC32 trailer (or record framing) failed "
+            "validation on read — the frame is consumed and the typed "
+            "ChannelCorruptionError raised; user code never sees the "
+            "payload.  Nonzero outside chaos drills means shm/network "
+            "corruption or a torn writer",
+        )
+        self.channel_reattach = m.Counter(
+            "channel_reattach_total",
+            "epoch-bumped channel reattach attempts after a peer-death "
+            "signal (result = ok, failed); ok means the edge resumed "
+            "with seq-replay instead of tearing down its consumer",
+            tag_keys=("result",),
+        )
+        self.channel_shm_reclaimed = m.Counter(
+            "channel_shm_reclaimed_total",
+            "orphaned ring/fan-out shm files reclaimed by the raylet "
+            "sweeper because every registered owner PID was dead — the "
+            "tmpfs-leak-after-SIGKILL backstop",
+        )
+        self.channel_fanout_evictions = m.Counter(
+            "channel_fanout_evictions_total",
+            "fan-out reader cursors evicted because the reader's "
+            "registered PID was dead — a SIGKILLed reader no longer "
+            "wedges the broadcast writer",
+        )
         self.socket_connects = m.Counter(
             "socket_channel_connects_total",
             "cross-host socket-channel dial outcomes (result = ok, "
@@ -699,6 +726,36 @@ def count_channel_timeout(op: str, n: int = 1) -> None:
         _chan_timeout_bound, op, "channel_timeouts", {"op": op}
     )
     b.inc(float(n))
+
+
+def count_channel_corruption(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _metrics().channel_corruption.inc(float(n))
+
+
+_chan_reattach_bound: dict = {}
+
+
+def count_channel_reattach(result: str) -> None:
+    if not enabled():
+        return
+    b = _chan_reattach_bound.get(result) or _bind(
+        _chan_reattach_bound, result, "channel_reattach", {"result": result}
+    )
+    b.inc(1.0)
+
+
+def count_shm_reclaimed(n: int) -> None:
+    if not enabled() or n <= 0:
+        return
+    _metrics().channel_shm_reclaimed.inc(float(n))
+
+
+def count_fanout_eviction(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _metrics().channel_fanout_evictions.inc(float(n))
 
 
 def count_socket_connect(result: str) -> None:

@@ -60,6 +60,15 @@ MAX_DICT = 1024
 MAX_DEPTH = 4
 
 
+class WireFormatError(ValueError):
+    """A buffer handed to ``decode`` is not a well-formed wire encoding
+    (truncated, bit-flipped, or unknown type code).  Decoding NEVER
+    returns a partial/garbage value and never hangs: every malformed
+    input surfaces as this one typed error, so channel readers can
+    translate it into their corruption error instead of delivering
+    wrong data."""
+
+
 class _Unencodable(Exception):
     """Internal signal: this value needs the pickle fallback."""
 
@@ -201,6 +210,17 @@ def encode(value: Any, tag: int = 0) -> bytes:
                 raise
 
 
+def _need(view: memoryview, off: int, n: int) -> None:
+    """Bounds check BEFORE slicing: ``view[off:off+n]`` silently
+    truncates past the end, which would turn a truncated encoding into a
+    wrong (shorter) value instead of a typed error."""
+    if off + n > len(view):
+        raise WireFormatError(
+            f"truncated wire payload: need {n} bytes at offset {off}, "
+            f"have {len(view) - off}"
+        )
+
+
 def _dec(view: memoryview, off: int, copy_arrays: bool) -> Tuple[Any, int]:
     code = view[off]
     off += 1
@@ -215,16 +235,19 @@ def _dec(view: memoryview, off: int, copy_arrays: bool) -> Tuple[Any, int]:
     if code == BIGINT:
         (n,) = _U32.unpack_from(view, off)
         off += 4
+        _need(view, off, n)
         return int.from_bytes(view[off : off + n], "little", signed=True), off + n
     if code == F64:
         return _F64.unpack_from(view, off)[0], off + 8
     if code == BYTES:
         (n,) = _U32.unpack_from(view, off)
         off += 4
+        _need(view, off, n)
         return bytes(view[off : off + n]), off + n
     if code == STR:
         (n,) = _U32.unpack_from(view, off)
         off += 4
+        _need(view, off, n)
         return str(view[off : off + n], "utf-8"), off + n
     if code == TUPLE or code == LIST:
         n = view[off]
@@ -248,6 +271,7 @@ def _dec(view: memoryview, off: int, copy_arrays: bool) -> Tuple[Any, int]:
 
         ds_len = view[off]
         off += 1
+        _need(view, off, ds_len)
         dt = np.dtype(str(view[off : off + ds_len], "ascii"))
         off += ds_len
         ndim = view[off]
@@ -258,11 +282,12 @@ def _dec(view: memoryview, off: int, copy_arrays: bool) -> Tuple[Any, int]:
             off += 8
         (nb,) = _U64.unpack_from(view, off)
         off += 8
+        _need(view, off, nb)
         arr = np.frombuffer(view[off : off + nb], dtype=dt).reshape(shape)
         if copy_arrays:
             arr = arr.copy()
         return arr, off + nb
-    raise ValueError(f"unknown wire type code {code}")
+    raise WireFormatError(f"unknown wire type code {code}")
 
 
 def decode(view: memoryview, copy_arrays: bool = True) -> Tuple[int, Any]:
@@ -272,11 +297,37 @@ def decode(view: memoryview, copy_arrays: bool = True) -> Tuple[int, Any]:
     ``view`` is a reusable ring that the writer will overwrite after the
     ack); ``False`` lets arrays alias ``view`` (safe for one-shot socket
     frames the receiver owns).
+
+    Malformed input (truncated / bit-flipped / unknown type code) raises
+    the typed ``WireFormatError`` — never a partial value, never a raw
+    struct/index error, never a hang (every decode loop is bounded by a
+    length field that is bounds-checked before use).
     """
     view = view.cast("B") if view.format != "B" else view
-    tag = view[0]
-    if view[1] == PICKLE:
-        inner_tag, value = serialization.deserialize(view[2:])
+    try:
+        tag = view[0]
+        is_pickle = view[1] == PICKLE
+    except IndexError as e:
+        raise WireFormatError(f"truncated wire header: {e}") from e
+    if is_pickle:
+        # The embedded pickle rides a CRC-validated frame in production,
+        # so a failure here is usually APPLICATION-level (an unimportable
+        # class on the reader, a failing __setstate__) — those propagate
+        # as themselves; labeling them corruption would fail-close a
+        # healthy edge and raise a false corruption alarm.  Structural
+        # failures (truncated/flipped pickle in direct or fuzz use)
+        # still surface as the typed error.
+        try:
+            _inner_tag, value = serialization.deserialize(view[2:])
+            return tag, value
+        except (ImportError, AttributeError, NameError):
+            raise  # class-resolution / app-level: not a framing problem
+        except Exception as e:  # noqa: BLE001 — structural: typed
+            raise WireFormatError(f"malformed pickle payload: {e}") from e
+    try:
+        value, _ = _dec(view, 1, copy_arrays)
         return tag, value
-    value, _ = _dec(view, 1, copy_arrays)
-    return tag, value
+    except WireFormatError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any escape = malformed input
+        raise WireFormatError(f"malformed wire payload: {e}") from e

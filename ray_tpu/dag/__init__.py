@@ -283,6 +283,22 @@ def _actor_channel_loop(self, ops, descs, token):
     TAG_ERROR = serialization.TAG_ERROR
     TAG_BATCH = serialization.TAG_BATCH
 
+    def read_arg(cid):
+        """One channel-arg read with the dataplane fault contract: a
+        connection-level death takes one shared reattach() attempt
+        before tearing the loop down.  A corrupted frame FAILS CLOSED
+        (loop teardown, driver sees typed ChannelClosed): its
+        multiplicity is unknowable — it may have been a TAG_BATCH frame
+        carrying K executions — so emitting any fixed number of error
+        values would desync the per-edge FIFO and deliver later
+        executions' results to the wrong refs."""
+        while True:
+            try:
+                return chans[cid].read_value(timeout=None)
+            except ChannelClosed:
+                if not channel_mod.reattach(chans[cid]):
+                    raise
+
     def run_op(op, args):
         """One op execution; returns (result, tag) — errors become
         values that flow downstream like results."""
@@ -314,7 +330,7 @@ def _actor_channel_loop(self, ops, descs, token):
                 batch_k = None  # execute_many: K executions in one frame
                 for kind, val in op["args"]:
                     if kind == "chan":
-                        tag, v = chans[val].read_value(timeout=None)
+                        tag, v = read_arg(val)
                         if tag == TAG_BATCH:
                             batch_k = len(v)
                         elif tag == TAG_ERROR:
@@ -369,9 +385,10 @@ def _actor_channel_loop(self, ops, descs, token):
                         [(chans[o], result, tag) for o in op["outs"]],
                         timeout=None,
                     )
-    except ChannelClosed:
-        # Teardown: propagate the poison downstream so every consumer
-        # (other actor loops, the driver) unblocks, then reclaim local
+    except (ChannelClosed, channel_mod.ChannelCorruptionError):
+        # Teardown (orderly close, or fail-closed frame corruption):
+        # propagate the poison downstream so every consumer (other
+        # actor loops, the driver) unblocks, then reclaim local
         # endpoints + this node's ring directory.
         for c in chans.values():
             try:
@@ -701,7 +718,7 @@ class CompiledDAG:
                 for cid, key in self._input_chans
             ]
             self._driver_out = [
-                channel_mod.open_channel(descs[cid], "read", timeout=30.0)
+                channel_mod.open_channel(descs[cid], "read", timeout=connect_t)
                 for cid in self._output_chans
             ]
             import collections
@@ -709,6 +726,18 @@ class CompiledDAG:
             # Per-output-channel pending per-execution entries: a batched
             # frame (execute_many) expands to K entries here.
             self._out_pending = [collections.deque() for _ in self._driver_out]
+            # fail-closed flags: an output edge that delivered a
+            # corrupted frame can never deliver a trustworthy SEQUENCE
+            # again (see _pump_output); the graph-level flag also stops
+            # new executions (they could never be associated with a
+            # result) with the typed error instead of bleeding the
+            # in-flight budget dry into an opaque cap error
+            self._out_poisoned = [False for _ in self._driver_out]
+            # "corruption" | "closed" once an output edge can never
+            # deliver again: execute() refuses typed instead of writing
+            # into a dead ring until the in-flight cap throws an opaque
+            # RuntimeError
+            self._fail_closed = None
         except Exception:
             channel_mod.drop_listeners(token)
             raise
@@ -725,10 +754,25 @@ class CompiledDAG:
             return input_val[key]
         return getattr(input_val, key)
 
+    def _raise_fail_closed(self):
+        from ray_tpu.experimental import channel as channel_mod
+
+        if self._fail_closed == "corruption":
+            raise channel_mod.ChannelCorruptionError(
+                "compiled DAG is fail-closed after frame corruption; "
+                "teardown and recompile"
+            )
+        raise channel_mod.ChannelClosed(
+            "compiled DAG output edge is closed; teardown and recompile"
+        )
+
     def execute(self, *input_vals):
         input_val = input_vals[0] if len(input_vals) == 1 else (input_vals if input_vals else None)
         if self._channels_on:
             from ray_tpu.experimental import channel as channel_mod
+
+            if self._fail_closed is not None:
+                self._raise_fail_closed()
 
             def extract(key):
                 return self._extract(input_val, key)
@@ -746,7 +790,6 @@ class CompiledDAG:
                 # independent branches start in parallel.
                 channel_mod.write_value_fanout(
                     [(chan, extract(key), 0) for chan, key in self._driver_in],
-                    timeout=30.0,
                 )
                 from ray_tpu._private import telemetry
 
@@ -780,6 +823,8 @@ class CompiledDAG:
         from ray_tpu._private import serialization, telemetry
         from ray_tpu.experimental import channel as channel_mod
 
+        if self._fail_closed is not None:
+            self._raise_fail_closed()
         with self._lock:
             if self._seq - self._next_result + k >= self._max_inflight:
                 raise RuntimeError(
@@ -797,7 +842,6 @@ class CompiledDAG:
                     )
                     for chan, key in self._driver_in
                 ],
-                timeout=30.0,
             )
             telemetry.count_dag_execution(k)
             refs = []
@@ -810,15 +854,41 @@ class CompiledDAG:
 
     def _pump_output(self, idx: int, timeout: Optional[float]) -> None:
         """Ensure output channel ``idx`` has at least one pending
-        per-execution entry (expands batched frames to K entries)."""
+        per-execution entry (expands batched frames to K entries).
+
+        Dataplane faults surface typed, never as wrong data or a stuck
+        driver: a corrupted result frame fail-closes the edge (its
+        multiplicity is unknowable — see the inline comment), and a
+        closed edge takes one shared reattach() attempt before
+        propagating."""
         import collections
 
         from ray_tpu import exceptions
         from ray_tpu._private import serialization
+        from ray_tpu.experimental import channel as channel_mod
 
+        if self._out_poisoned[idx]:
+            self._raise_fail_closed()
         pending = self._out_pending[idx]
         while not pending:
-            tag, value = self._driver_out[idx].read_value(timeout)
+            try:
+                tag, value = self._driver_out[idx].read_value(timeout)
+            except channel_mod.ChannelCorruptionError:
+                # The corrupted frame may have been a TAG_BATCH of K
+                # results: any guess at multiplicity would mis-associate
+                # every later result with the wrong ref.  Fail closed —
+                # this edge delivers nothing further, every pending and
+                # future get() on it raises typed.
+                self._out_poisoned[idx] = True
+                self._fail_closed = "corruption"
+                raise
+            except channel_mod.ChannelClosed:
+                if channel_mod.reattach(self._driver_out[idx]):
+                    continue
+                # the edge is dead for good: no submitted or future
+                # execution can ever resolve on it
+                self._fail_closed = "closed"
+                raise
             if tag == serialization.TAG_BATCH:
                 for item in value:
                     if isinstance(item, exceptions.RayTaskError):

@@ -10,24 +10,48 @@ over an mmap'd file:
 
     [wbytes u64][rbytes u64][closed u64][pad..64][ring payload ...]
 
-Records are ``[u64 len][payload][pad to 8]`` appended at ``wbytes %
-capacity``; a len of 2**64-2 is a wrap marker (the rest of the region is
-skipped), and the writer publishes ``wbytes`` only after the payload is
-in place.  ``rbytes`` advancing IS the consume-ack: free space is
-``capacity - (wbytes - rbytes)``, so the writer blocks only when the
-ring is genuinely full — multiple messages ride in flight per edge
-(pipelined compiled executions), unlike the previous one-slot seqlock
-design which deadlocked any pipeline deeper than the edge count.
-``closed`` is a drain-then-close flag: readers see ChannelClosed only
-after consuming the backlog; blocked writers see it immediately.
+Records are ``[u64 len][payload][u32 crc32][pad to 8]`` appended at
+``wbytes % capacity``; a len of 2**64-2 is a wrap marker (the rest of
+the region is skipped), and the writer publishes ``wbytes`` only after
+the payload AND its CRC trailer are in place.  ``rbytes`` advancing IS
+the consume-ack: free space is ``capacity - (wbytes - rbytes)``, so the
+writer blocks only when the ring is genuinely full — multiple messages
+ride in flight per edge (pipelined compiled executions).  ``closed`` is
+a drain-then-close flag: readers see ChannelClosed only after consuming
+the backlog; blocked writers see it immediately.
+
+Frame integrity: every record carries a CRC32 trailer validated on
+read.  A mismatch (bit rot, a torn write from a SIGKILLed writer, a
+chaos ``corrupt_frame``/``torn_write`` injection) consumes the garbage
+record and raises the typed ``ChannelCorruptionError`` — a corrupted
+frame is NEVER delivered as data.  An implausible record length (torn
+header) raises the same error without advancing (the ring framing is
+unrecoverable from that position; the consumer's heavy recovery path
+owns it).
 
 ``SocketChannel`` carries the same write/read/pending contract over one
 long-lived TCP connection for compiled edges whose endpoints live on
-different nodes: framed messages one way, consume-acks the other, a
-bounded unacked window as flow control.  Either transport moves values
-via the binary wire format (``_private/wire.py``) with ``write_value``
-/ ``read_value`` — encoded straight into the ring / scratch frame, no
-pickling and no intermediate copies for the fast-path types.
+different nodes: framed messages one way (``[u64 len][u64 seq][payload]
+[u32 crc]``), consume-acks the other, a bounded unacked window as flow
+control.  Channels carry an **epoch**: after a connection-level death
+the writer may re-dial its reader's still-open listener with the
+listener's pairing token at a bumped epoch, and frames the reader never
+received are replayed from the writer's bounded unacked-frame buffer
+(seq-resume; duplicates are dropped by seq).  ``reattach(chan)`` is the
+one shared recovery helper the DAG / serve / stream attach paths call
+on ``ChannelClosed`` before falling back to their heavy per-consumer
+recovery.
+
+Chaos: when the fault plane (``_private/chaos.py``) is active, every
+write consults ``chan:<path-glob>:<action>`` rules — ``drop_frame``,
+``delay_frame``, ``corrupt_frame``, ``torn_write``, ``close`` — so the
+layer that carries all dataplane traffic is drillable with the same
+seeded, replayable schedule as the RPC plane.
+
+Orphan reclamation: every endpoint opened under a managed ring
+directory registers its PID in ``<dir>/.pids``;
+``sweep_orphan_ring_dirs()`` (run by the raylet) reclaims directories
+whose registered owners are ALL dead — the tmpfs leak after SIGKILL.
 """
 
 from __future__ import annotations
@@ -36,9 +60,11 @@ import mmap
 import os
 import struct
 import time
+import zlib
 from typing import Any, List, Optional, Sequence, Tuple
 
 _U64 = struct.Struct("<Q")
+_U32C = struct.Struct("<I")
 HEADER = 64
 POISON = (1 << 64) - 1  # socket framing: orderly close
 WRAP = (1 << 64) - 2  # ring: rest of region is skipped
@@ -62,9 +88,174 @@ class ChannelCapacityError(ValueError):
 
 
 class ChannelConnectionError(ConnectionError):
-    """A socket channel could not (re)connect: the listener accepts
-    exactly one peer for its lifetime (single-writer/single-reader
-    contract), so dialing a consumed or dead endpoint is refused."""
+    """A socket channel could not (re)connect: the endpoint is dead,
+    or a reconnect handshake was refused (bad pairing token / stale
+    epoch)."""
+
+
+class ChannelCorruptionError(Exception):
+    """A frame failed integrity validation (CRC32 trailer mismatch,
+    torn record, undecodable payload).  The garbage is consumed where
+    the framing allows it and NEVER delivered as data.
+
+    ``advanced`` tells the consumer whether the read cursor moved past
+    the garbage: True (the default) means the next read returns the
+    next frame, so skip-and-continue is safe; False (torn/implausible
+    record LENGTH — the framing itself is broken) means a retry re-reads
+    the same garbage forever, so the consumer must run its heavy
+    recovery instead of retrying."""
+
+    advanced = True
+
+
+class _DefaultTimeout:
+    def __repr__(self):  # shows up in signatures/help
+        return "<channel_default_timeout_s>"
+
+
+#: Sentinel default for every channel read/write timeout: resolved at
+#: call time from CONFIG.channel_default_timeout_s (one knob, so drills
+#: can tighten every edge uniformly).  Pass None to block forever.
+DEFAULT_TIMEOUT = _DefaultTimeout()
+
+
+# ((env string, override value), resolved float) — CONFIG.get does a
+# live os.environ read per access (~1.6 us), far too hot for a per-frame
+# path; keying the cache on the raw env value AND the system_config
+# override keeps the knob live through both routes (tests flip the env
+# between ops; init(system_config=...) may land after early channel
+# ops) at dict-lookup cost.  Only consulted when an op actually blocks.
+_timeout_cache: Tuple[Any, Optional[float]] = (None, None)
+
+_wire = None  # lazy module ref: the per-frame paths skip the import dance
+
+
+def _wire_mod():
+    global _wire
+    if _wire is None:
+        from ray_tpu._private import wire
+
+        _wire = wire
+    return _wire
+
+
+def _resolve_timeout(timeout) -> Optional[float]:
+    if timeout is not DEFAULT_TIMEOUT:
+        return timeout
+    global _timeout_cache
+    from ray_tpu._private.config import CONFIG
+
+    key = (
+        os.environ.get("RAY_TPU_channel_default_timeout_s"),
+        CONFIG._overrides.get("channel_default_timeout_s"),
+    )
+    cached_key, val = _timeout_cache
+    if key == cached_key and val is not None:
+        return val
+    val = float(CONFIG.channel_default_timeout_s)
+    _timeout_cache = (key, val)
+    return val
+
+
+# (plane, plane.rev at last check, active at last check): the no-chaos
+# fast path is one int compare per frame instead of the plane's
+# monotonic-throttled revalidation.  CHAOS.reset() bumps rev, so tests
+# that flip the spec in-process are picked up on the very next frame;
+# worker processes get their spec from the env at spawn (first check).
+_chaos_cache = (None, -1, False)
+
+#: "not decided yet" sentinel for try_write_value's ``cd`` parameter —
+#: distinct from None, which means "decided: clean".
+_CHAOS_UNDECIDED = object()
+
+
+def _mutate_payload(mm, base: int, n: int, crc: int, cd) -> int:
+    """Post-CRC payload mutation for corrupt_frame / torn_write, shared
+    by the ring and fan-out writers (ONE fault model, not per-transport
+    copies).  Both actions guarantee a CRC mismatch on read: corrupt
+    flips a payload byte after the trailer was computed; torn models a
+    writer killed mid-record (latter half never written, trailer
+    stale).  The socket writer models torn differently by design — a
+    mid-frame connection cut (see SocketChannel._write_payload).
+    ``base`` is the absolute offset of the payload's first byte."""
+    if cd.corrupt:
+        if n > 0:
+            mm[base] ^= 0xFF
+        else:
+            crc ^= 0xFFFFFFFF
+    if cd.torn:
+        half = n // 2
+        if n - half > 0:
+            mm[base + half : base + n] = b"\x00" * (n - half)
+        crc ^= 0xA5A5A5A5
+    return crc & 0xFFFFFFFF
+
+
+def _chaos_decide(path: str):
+    """Per-frame fault verdict (None on the no-chaos fast path)."""
+    global _chaos_cache
+    c, rev, active = _chaos_cache
+    if c is None:
+        from ray_tpu._private.chaos import CHAOS as c0
+
+        c = c0
+        rev = -1
+    if rev != c.rev:
+        # full (throttled) spec revalidation; an RPC-only spec leaves
+        # the dataplane fast path untouched
+        active = c.active and c.has_channel_rules
+        _chaos_cache = (c, c.rev, active)
+    if not active:
+        return None
+    d = c.decide_channel(path)
+    return None if d.clean else d
+
+
+def _count_corruption() -> None:
+    try:
+        from ray_tpu._private import telemetry
+
+        telemetry.count_channel_corruption()
+    except Exception:
+        pass
+
+
+def _count_reattach(ok: bool) -> None:
+    try:
+        from ray_tpu._private import telemetry
+
+        telemetry.count_channel_reattach("ok" if ok else "failed")
+    except Exception:
+        pass
+
+
+def _register_shm_pid(path: str) -> None:
+    """Record this process as an owner of the ring directory holding
+    ``path`` (sweep registry; see sweep_orphan_ring_dirs).  Only
+    sweep-managed dirs (ray_tpu_* directly under ring_base_dir) are
+    registered — test channels in tmp dirs are untouched."""
+    d = os.path.dirname(path)
+    if not os.path.basename(d).startswith("ray_tpu_"):
+        return
+    if os.path.dirname(d) != ring_base_dir():
+        return
+    try:
+        with open(os.path.join(d, ".pids"), "a") as f:
+            f.write(f"{os.getpid()}\n")
+    except OSError:
+        pass
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM etc: it exists
+    return True
 
 
 class Channel:
@@ -87,10 +278,11 @@ class Channel:
         size = os.fstat(self._f.fileno()).st_size
         cap = size - HEADER
         self.capacity = cap - (cap % 8)
-        # Largest single record (header + aligned payload) the ring can
-        # carry: one wrap marker must always fit beside it.
-        self.max_size = self.capacity - 16
+        # Largest single payload (header + aligned payload + CRC) the
+        # ring can carry: one wrap marker must always fit beside it.
+        self.max_size = self.capacity - 24
         self._mm = mmap.mmap(self._f.fileno(), size)
+        _register_shm_pid(path)
         # Dataplane counters (item-2 hot path must land measurable):
         # plain dict increments on the fast path (~100 ns), folded into
         # telemetry in batches of _TELE_FLUSH_OPS so per-op cost stays
@@ -104,6 +296,7 @@ class Channel:
             "read_blocked_s": 0.0,
             "write_timeouts": 0,
             "read_timeouts": 0,
+            "corruptions": 0,
         }
         self._tele_ops = 0
         self._tele_flushed = dict(self.stats)
@@ -181,6 +374,10 @@ class Channel:
         if self._tele_ops >= self._TELE_FLUSH_OPS:
             self._tele_flush()
 
+    def _record_corruption(self) -> None:
+        self.stats["corruptions"] += 1
+        _count_corruption()
+
     def _write_wait(self, spins: int, t_block: float, deadline: Optional[float]) -> float:
         """One blocked-writer backoff step (shared by write paths)."""
         if self._closed_flag():
@@ -210,15 +407,35 @@ class Channel:
         self._set(_WOFF, wb)
         return wb
 
-    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
-        need = 8 + _align8(len(data))
+    def _apply_write_chaos(self, cd, nbytes: int):
+        """Pre-publish actions of one frame's fault verdict.  Returns
+        True when the frame must be silently dropped; raises for close.
+        corrupt/torn mutate at publish time (the caller passes cd down)."""
+        if cd.delay_s > 0:
+            time.sleep(cd.delay_s)
+        if cd.close:
+            self.close()
+            raise ChannelClosed(f"{self.path}: chaos close")
+        if cd.drop:
+            self._count_write(nbytes)
+            return True
+        return False
+
+    def _chaos_mutate(self, cd, wpos: int, n: int, crc: int) -> int:
+        return _mutate_payload(self._mm, HEADER + wpos + 8, n, crc, cd)
+
+    def write(self, data: bytes, timeout=DEFAULT_TIMEOUT) -> None:
+        cd = _chaos_decide(self.path)
+        if cd is not None and self._apply_write_chaos(cd, len(data)):
+            return
+        need = 8 + _align8(len(data) + 4)
         if need > self.max_size:
             raise ChannelCapacityError(
                 f"message of {len(data)} bytes exceeds channel capacity "
                 f"{self.max_size}; raise the buffer size at compile time"
             )
-        deadline = None if timeout is None else time.monotonic() + timeout
-        spins = 0
+        deadline = None  # resolved at first block: the happy path never
+        spins = 0        # pays the timeout-knob lookup
         t_block = 0.0
         cap = self.capacity
         while True:
@@ -234,25 +451,30 @@ class Channel:
                 break
             if spins == 0:
                 t_block = time.monotonic()
+                timeout = _resolve_timeout(timeout)
+                deadline = None if timeout is None else t_block + timeout
             spins += 1
             self._write_wait(spins, t_block, deadline)
         wpos = wb % cap
         self._mm[HEADER + wpos + 8 : HEADER + wpos + 8 + len(data)] = data
+        crc = zlib.crc32(data)
+        if cd is not None:
+            crc = self._chaos_mutate(cd, wpos, len(data), crc)
+        _U32C.pack_into(self._mm, HEADER + wpos + 8 + len(data), crc)
         _U64.pack_into(self._mm, HEADER + wpos, len(data))
         self._set(_WOFF, wb + need)
         if spins:
             self.stats["write_blocked_s"] += time.monotonic() - t_block
         self._count_write(len(data))
 
-    def _try_publish_value(self, value: Any, tag: int) -> Tuple[bool, bool]:
+    def _try_publish_value(self, value: Any, tag: int, cd=None) -> Tuple[bool, bool]:
         """One encode attempt at the current write position.  Returns
         (published, blocked_on_reader): encoding straight into the ring
         means the payload size is unknown up front, so an overflow is
         disambiguated by WHAT bounded the window — the region tail
         (fixable by wrapping), the reader's position (fixable by
         waiting), or the whole ring (typed capacity error)."""
-        from ray_tpu._private import wire
-
+        wire = _wire_mod()
         cap = self.capacity
         wb = self._get(_WOFF)
         free = cap - (wb - self._get(_ROFF))
@@ -263,16 +485,22 @@ class Channel:
             try:
                 n = wire.encode_into(
                     memoryview(self._mm)[
-                        HEADER + wpos + 8 : HEADER + wpos + window
+                        HEADER + wpos + 8 : HEADER + wpos + window - 4
                     ],
                     value,
                     tag,
                 )
             except (struct.error, ValueError, IndexError):
                 n = -1
-            if n >= 0 and 8 + _align8(n) <= window:
+            if n >= 0 and 8 + _align8(n + 4) <= window:
+                crc = zlib.crc32(
+                    memoryview(self._mm)[HEADER + wpos + 8 : HEADER + wpos + 8 + n]
+                )
+                if cd is not None:
+                    crc = self._chaos_mutate(cd, wpos, n, crc)
+                _U32C.pack_into(self._mm, HEADER + wpos + 8 + n, crc)
                 _U64.pack_into(self._mm, HEADER + wpos, n)
-                self._set(_WOFF, wb + 8 + _align8(n))
+                self._set(_WOFF, wb + 8 + _align8(n + 4))
                 self._count_write(n)
                 return True, False
         if window >= tail:
@@ -287,7 +515,7 @@ class Channel:
             return False, False
         return False, True  # reader-bounded: wait for consumption
 
-    def write_value(self, value: Any, tag: int = 0, timeout: Optional[float] = 30.0) -> None:
+    def write_value(self, value: Any, tag: int = 0, timeout=DEFAULT_TIMEOUT) -> None:
         """Fast-path write: wire-encode ``value`` directly into the ring.
 
         A reader-bounded attempt partially ENCODES into the free window
@@ -296,7 +524,10 @@ class Channel:
         parked writer of a large payload would otherwise burn a core
         re-encoding the same prefix every backoff wakeup (the podracer
         profile found runners spending >90% of parked CPU there)."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        cd = _chaos_decide(self.path)
+        if cd is not None and self._apply_write_chaos(cd, 0):
+            return
+        deadline = None  # resolved at first block (see write())
         spins = 0
         t_block = 0.0
         blocked_at_rb = None  # _ROFF snapshot taken BEFORE the blocked attempt
@@ -308,7 +539,7 @@ class Channel:
                     self._write_wait(spins, t_block, deadline)
                     continue
                 blocked_at_rb = None
-            published, blocked = self._try_publish_value(value, tag)
+            published, blocked = self._try_publish_value(value, tag, cd)
             if published:
                 if spins:
                     self.stats["write_blocked_s"] += time.monotonic() - t_block
@@ -316,6 +547,8 @@ class Channel:
             if blocked:
                 if spins == 0:
                     t_block = time.monotonic()
+                    timeout = _resolve_timeout(timeout)
+                    deadline = None if timeout is None else t_block + timeout
                 # The pre-attempt snapshot is the race-safe anchor: a
                 # reader advance DURING the attempt leaves _ROFF !=
                 # rb_before, so the gate above retries immediately
@@ -325,13 +558,24 @@ class Channel:
                 spins += 1
                 self._write_wait(spins, t_block, deadline)
 
-    def try_write_value(self, value: Any, tag: int = 0) -> bool:
+    def try_write_value(self, value: Any, tag: int = 0,
+                        cd=_CHAOS_UNDECIDED) -> bool:
         """Non-blocking write attempt (fan-out scheduling): False when
-        the ring lacks free space right now."""
+        the ring lacks free space right now.
+
+        ``cd`` lets a fan-out scheduler pre-decide this frame's chaos
+        verdict ONCE (pre-actions already applied) so blocked retries of
+        the same frame don't consume extra rule match-ordinals — the
+        seeded schedule must be deterministic per FRAME, not per retry
+        (retry counts are timing-dependent)."""
         if self._closed_flag():
             raise ChannelClosed(self.path)
+        if cd is _CHAOS_UNDECIDED:
+            cd = _chaos_decide(self.path)
+            if cd is not None and self._apply_write_chaos(cd, 0):
+                return True
         while True:
-            published, blocked = self._try_publish_value(value, tag)
+            published, blocked = self._try_publish_value(value, tag, cd)
             if published:
                 return True
             if blocked:
@@ -359,7 +603,11 @@ class Channel:
     # -- reader ---------------------------------------------------------
     def _read_slot(self) -> Optional[Tuple[int, int]]:
         """(rpos, len) of the next record, advancing past wrap markers;
-        None when the ring is empty."""
+        None when the ring is empty.  An implausible record length (the
+        torn-header signature of a writer killed mid-publish, or shm
+        corruption) raises the typed corruption error WITHOUT advancing:
+        the framing is unrecoverable from this position and the
+        consumer's heavy recovery owns the edge."""
         cap = self.capacity
         while True:
             rb = self._get(_ROFF)
@@ -374,10 +622,18 @@ class Channel:
             if n == WRAP:
                 self._set(_ROFF, rb + tail)
                 continue
+            if n > self.max_size or 8 + _align8(n + 4) > tail:
+                self._record_corruption()
+                err = ChannelCorruptionError(
+                    f"{self.path}: torn/garbage record length {n} at "
+                    f"offset {rpos}"
+                )
+                err.advanced = False  # framing broken: no way past it
+                raise err
             return rpos, n
 
     def _consume(self, rpos: int, n: int, blocked_since: float) -> None:
-        self._set(_ROFF, self._get(_ROFF) + 8 + _align8(n))
+        self._set(_ROFF, self._get(_ROFF) + 8 + _align8(n + 4))
         s = self.stats
         s["reads"] += 1
         s["bytes_read"] += n
@@ -401,43 +657,68 @@ class Channel:
             self._tele_flush()
             raise ChannelTimeout(f"no message on {self.path} within {timeout}s")
 
-    def read(self, timeout: Optional[float] = 30.0) -> bytes:
-        deadline = None if timeout is None else time.monotonic() + timeout
+    def read(self, timeout=DEFAULT_TIMEOUT) -> bytes:
+        deadline = None  # resolved at first block (see write())
         spins = 0
         t_block = 0.0
         while True:
             slot = self._read_slot()
             if slot is not None:
                 rpos, n = slot
+                blocked = t_block if spins else 0.0
                 data = bytes(self._mm[HEADER + rpos + 8 : HEADER + rpos + 8 + n])
-                self._consume(rpos, n, t_block if spins else 0.0)
+                stored = _U32C.unpack_from(self._mm, HEADER + rpos + 8 + n)[0]
+                if zlib.crc32(data) != stored:
+                    self._consume(rpos, n, blocked)
+                    self._record_corruption()
+                    raise ChannelCorruptionError(
+                        f"{self.path}: CRC mismatch on {n}-byte record"
+                    )
+                self._consume(rpos, n, blocked)
                 return data
             if spins == 0:
                 t_block = time.monotonic()
+                timeout = _resolve_timeout(timeout)
+                deadline = None if timeout is None else t_block + timeout
             spins += 1
             self._read_wait(spins, t_block, deadline, timeout)
 
-    def read_value(self, timeout: Optional[float] = 30.0) -> Tuple[int, Any]:
+    def read_value(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any]:
         """Fast-path read: wire-decode straight from the ring; returns
         ``(tag, value)``.  Array payloads are copied out before the
         consume-ack (the writer reuses the region afterwards)."""
-        from ray_tpu._private import wire
-
-        deadline = None if timeout is None else time.monotonic() + timeout
+        wire = _wire_mod()
+        deadline = None  # resolved at first block (see write())
         spins = 0
         t_block = 0.0
         while True:
             slot = self._read_slot()
             if slot is not None:
                 rpos, n = slot
-                tag, value = wire.decode(
-                    memoryview(self._mm)[HEADER + rpos + 8 : HEADER + rpos + 8 + n],
-                    copy_arrays=True,
-                )
-                self._consume(rpos, n, t_block if spins else 0.0)
+                blocked = t_block if spins else 0.0
+                # ONE payload view serves both the CRC check and decode
+                mv = memoryview(self._mm)[HEADER + rpos + 8 : HEADER + rpos + 8 + n]
+                stored = _U32C.unpack_from(self._mm, HEADER + rpos + 8 + n)[0]
+                if zlib.crc32(mv) != stored:
+                    self._consume(rpos, n, blocked)
+                    self._record_corruption()
+                    raise ChannelCorruptionError(
+                        f"{self.path}: CRC mismatch on {n}-byte record"
+                    )
+                try:
+                    tag, value = wire.decode(mv, copy_arrays=True)
+                except wire.WireFormatError as e:
+                    self._consume(rpos, n, blocked)
+                    self._record_corruption()
+                    raise ChannelCorruptionError(
+                        f"{self.path}: undecodable record ({e})"
+                    ) from e
+                self._consume(rpos, n, blocked)
                 return tag, value
             if spins == 0:
                 t_block = time.monotonic()
+                timeout = _resolve_timeout(timeout)
+                deadline = None if timeout is None else t_block + timeout
             spins += 1
             self._read_wait(spins, t_block, deadline, timeout)
 
@@ -453,14 +734,32 @@ class Channel:
 
 
 _FRAME = struct.Struct("<Q")
+_FRAME_HDR = struct.Struct("<QQ")  # payload len, seq
 _ACK = b"\x01"
+_MAGIC = b"RTPUCHN2"
+_HELLO = struct.Struct("<8sQ16sQ")  # magic, epoch, token, writer sent_seq
+_REPLY = struct.Struct("<8sQ16sQQ")  # magic, epoch, token, rx_seq, consumed
+
+
+def _recv_exact_sock(sock, n: int) -> Optional[bytes]:
+    """None on EOF; honors the socket's current timeout."""
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks) if len(chunks) != 1 else chunks[0]
 
 
 class SocketListener:
-    """One listening endpoint for one compiled edge.  Accepts exactly ONE
-    connection over its lifetime (the single-writer/single-reader
-    contract), then closes the listening socket — a later dial to the
-    same port is refused (``ChannelConnectionError`` on the dialer)."""
+    """One listening endpoint for one compiled edge.  The first accept
+    pairs the edge (single-writer/single-reader contract); the listening
+    socket then STAYS open so the paired writer can reattach after a
+    connection-level failure by presenting the pairing token at a
+    bumped epoch.  Unauthenticated or stale-epoch reconnects are
+    rejected at the handshake and never reach the consumer."""
 
     def __init__(self):
         import socket as _socket
@@ -468,22 +767,76 @@ class SocketListener:
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
         self._sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
         self._sock.bind(("0.0.0.0", 0))
-        self._sock.listen(1)
+        self._sock.listen(4)
         self.port = self._sock.getsockname()[1]
+        self.token = os.urandom(16)
+        self.epoch = 0
+        self._paired = False
 
     def accept(self, role: str, timeout: Optional[float] = 30.0) -> "SocketChannel":
+        conn, epoch = self._accept_conn(timeout, rx_seq=0, consumed=0)
+        return SocketChannel(conn, role, listener=self, epoch=epoch)
+
+    def _accept_conn(self, timeout: Optional[float], rx_seq: int, consumed: int):
+        """Accept + handshake one connection.  First pairing accepts
+        epoch >= 1 from anyone; later connections must present this
+        listener's token at an epoch strictly above the current one
+        (the authenticated-reattach contract).  Rejected dials are
+        closed and the accept loop continues until the deadline."""
         import socket as _socket
 
-        self._sock.settimeout(timeout)
-        try:
-            conn, _peer = self._sock.accept()
-        except _socket.timeout:
-            raise ChannelTimeout(
-                f"no peer dialed listener :{self.port} within {timeout}s"
-            ) from None
-        finally:
-            self.close()
-        return SocketChannel(conn, role)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ChannelTimeout(
+                        f"no peer dialed listener :{self.port} within {timeout}s"
+                    )
+            self._sock.settimeout(remaining)
+            try:
+                conn, _peer = self._sock.accept()
+            except _socket.timeout:
+                raise ChannelTimeout(
+                    f"no peer dialed listener :{self.port} within {timeout}s"
+                ) from None
+            except OSError:
+                raise ChannelClosed(f"listener :{self.port} closed") from None
+            try:
+                # The handshake recv must not outlive the accept window:
+                # an idle queued dial (stray scanner, rogue dial) sitting
+                # first in the backlog would otherwise eat the whole
+                # reattach budget before the authentic peer is examined.
+                if deadline is not None:
+                    conn.settimeout(
+                        max(0.05, min(5.0, deadline - time.monotonic()))
+                    )
+                else:
+                    conn.settimeout(5.0)
+                hello = _recv_exact_sock(conn, _HELLO.size)
+                if hello is None:
+                    raise OSError("EOF during channel handshake")
+                magic, epoch, token, _sent_seq = _HELLO.unpack(hello)
+                ok = magic == _MAGIC and (
+                    (not self._paired and epoch >= 1)
+                    or (self._paired and token == self.token and epoch > self.epoch)
+                )
+                if not ok:
+                    conn.close()
+                    continue
+                conn.sendall(_REPLY.pack(_MAGIC, epoch, self.token, rx_seq, consumed))
+                conn.settimeout(None)
+                conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                self._paired = True
+                self.epoch = int(epoch)
+                return conn, int(epoch)
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
 
     def close(self) -> None:
         try:
@@ -495,18 +848,29 @@ class SocketListener:
 def dial(addr: Tuple[str, int], role: str, timeout: float = 15.0) -> "SocketChannel":
     """Connect to a bound listener; retries transient refusals on the
     unified CONNECT policy until ``timeout`` (listener startup races),
-    then raises the typed ``ChannelConnectionError``."""
+    then raises the typed ``ChannelConnectionError``.
+
+    The pairing handshake is deliberately asynchronous: the hello frame
+    is sent here, but the listener's reply is absorbed later from the
+    ack stream — a graph with mutual socket edges would deadlock if
+    every dial blocked on its reader reaching accept()."""
     import socket as _socket
 
     from ray_tpu._private import retry, telemetry
 
+    assert role == "write", "channel listeners are reader-side by contract"
     bo = retry.CONNECT.start(deadline_s=timeout)
     last: Optional[Exception] = None
     while True:
         try:
             sock = _socket.create_connection(tuple(addr), timeout=min(timeout, 5.0))
+            try:
+                sock.sendall(_HELLO.pack(_MAGIC, 1, bytes(16), 0))
+            except OSError:
+                sock.close()
+                raise
             telemetry.count_socket_connect("ok")
-            return SocketChannel(sock, role)
+            return SocketChannel(sock, role, peer_addr=tuple(addr))
         except OSError as e:
             last = e
             delay = bo.next_delay()
@@ -514,8 +878,8 @@ def dial(addr: Tuple[str, int], role: str, timeout: float = 15.0) -> "SocketChan
                 telemetry.count_socket_connect("refused")
                 raise ChannelConnectionError(
                     f"socket channel endpoint {addr} refused ({last}); "
-                    "compiled-edge listeners accept exactly one connection — "
-                    "a dropped edge means the graph must be recompiled"
+                    "the reader endpoint is gone — the edge must be "
+                    "reattached from a live listener or rebuilt"
                 ) from last
             time.sleep(delay)
 
@@ -525,21 +889,31 @@ class SocketChannel:
     TCP connection (one per compiled REMOTE edge, chosen at compile time
     by placement).
 
-    Data frames (``[u64 len][payload]``) flow writer→reader; one ack
-    byte per *consumed* message flows back.  Flow control is a bounded
-    unacked window (like the ring's single slot, widened to hide the
-    network RTT).  Reader-side: a daemonized reader thread drains frames
+    Data frames (``[u64 len][u64 seq][payload][u32 crc]``) flow
+    writer→reader; one ack byte per *consumed* message flows back.
+    Flow control is a bounded unacked window (like the ring's free
+    space, widened to hide the network RTT); the unacked frames double
+    as the bounded replay buffer for epoch reattach.  Reader-side: a
+    daemonized reader thread validates CRC trailers and drains frames
     into a local queue so ``pending()`` is local and writer death (EOF /
     reset) is detected immediately as ``ChannelClosed`` — distinct from
     ``ChannelTimeout``, which means the peer is alive but silent.
-    """
+    After a connection-level death either side can resume the session:
+    the writer transparently re-dials (bounded, once per failed send)
+    and the reader's consumer calls :func:`reattach`, which re-accepts
+    at a bumped epoch and seq-resumes from the replay buffer."""
 
     kind = "socket"
 
     _CLOSED = object()  # poison frame received (orderly close)
     _DIED = object()  # EOF/reset without poison (peer death)
+    _CORRUPT = object()  # CRC-mismatched frame (consumed as typed error)
 
-    def __init__(self, sock, role: str, window: Optional[int] = None):
+    def __init__(self, sock, role: str, window: Optional[int] = None,
+                 listener: Optional[SocketListener] = None,
+                 peer_addr: Optional[Tuple[str, int]] = None,
+                 epoch: int = 1):
+        import collections
         import queue as _queue
         import socket as _socket
         import threading as _threading
@@ -563,6 +937,21 @@ class SocketChannel:
         self._window = max(1, window)
         self._unacked = 0
         self._closed = False
+        # -- epoch-reattach state --
+        self.epoch = int(epoch)
+        self._listener = listener  # read role: stays open for reattach
+        self._peer_addr = peer_addr  # write role: re-dial target
+        self._token: Optional[bytes] = listener.token if listener is not None else None
+        # write role: the pairing reply (carrying the listener token)
+        # arrives interleaved ahead of the ack stream; buffered here
+        # until complete.
+        self._reply_buf: Optional[bytes] = b"" if role == "write" else None
+        self._sent_seq = 0  # frames transmitted (write role)
+        self._acked_seq = 0  # frames consumed by the peer (write role)
+        self._replay = collections.deque()  # (seq, frame bytes), unacked
+        self._rx_seq = 0  # read role: highest seq enqueued
+        self._consumed_seq = 0  # read role: frames delivered to consumer
+        self._eof = None  # read role: death sentinel after rx exit
         self.stats = {
             "writes": 0,
             "reads": 0,
@@ -572,38 +961,39 @@ class SocketChannel:
             "read_blocked_s": 0.0,
             "write_timeouts": 0,
             "read_timeouts": 0,
+            "corruptions": 0,
         }
         self._tele_ops = 0
         self._tele_flushed = dict(self.stats)
         self._scratch = bytearray(64 * 1024)
+        self._rx = None
         if role == "read":
             self._q: "_queue.Queue" = _queue.Queue()
-            self._rx = _threading.Thread(
-                target=self._rx_loop, daemon=True, name="socket-channel-rx"
-            )
-            self._rx.start()
+            self._start_rx()
+
+    def _start_rx(self) -> None:
+        import threading as _threading
+
+        self._rx = _threading.Thread(
+            target=self._rx_loop, args=(self._sock,), daemon=True,
+            name="socket-channel-rx",
+        )
+        self._rx.start()
 
     # Telemetry rides the SAME channel_* series as the ring (op labels
     # read/write) — one dataplane, two transports.
     _TELE_FLUSH_OPS = Channel._TELE_FLUSH_OPS
     _tele_flush = Channel._tele_flush
+    _record_corruption = Channel._record_corruption
 
     # -- reader ---------------------------------------------------------
-    def _recv_exact(self, n: int) -> Optional[bytes]:
-        """None on EOF; runs only on the rx thread."""
-        chunks = []
-        while n:
-            chunk = self._sock.recv(n)
-            if not chunk:
-                return None
-            chunks.append(chunk)
-            n -= len(chunk)
-        return b"".join(chunks) if len(chunks) != 1 else chunks[0]
-
-    def _rx_loop(self) -> None:
+    def _rx_loop(self, sock) -> None:
+        """Drains frames from ``sock`` (captured at thread start: a
+        reattach swaps self._sock for a new connection and a new rx
+        thread — this one must never read from it)."""
         while True:
             try:
-                head = self._recv_exact(8)
+                head = _recv_exact_sock(sock, 8)
                 if head is None:
                     self._q.put(self._DIED)
                     return
@@ -611,10 +1001,26 @@ class SocketChannel:
                 if n == POISON:
                     self._q.put(self._CLOSED)
                     return
-                payload = self._recv_exact(n)
+                seq_b = _recv_exact_sock(sock, 8)
+                if seq_b is None:
+                    self._q.put(self._DIED)
+                    return
+                (seq,) = _FRAME.unpack(seq_b)
+                payload = _recv_exact_sock(sock, n)
                 if payload is None:
                     self._q.put(self._DIED)
                     return
+                crc_b = _recv_exact_sock(sock, 4)
+                if crc_b is None:
+                    self._q.put(self._DIED)
+                    return
+                if seq <= self._rx_seq:
+                    continue  # replay duplicate after a reattach
+                self._rx_seq = seq
+                if zlib.crc32(payload) != _U32C.unpack(crc_b)[0]:
+                    self._record_corruption()
+                    self._q.put(self._CORRUPT)
+                    continue
                 self._q.put(payload)
             except OSError:
                 self._q.put(self._DIED)
@@ -623,6 +1029,11 @@ class SocketChannel:
     def _pop_frame(self, timeout: Optional[float]) -> bytes:
         import queue as _queue
 
+        if self._eof is not None and self._q.empty():
+            raise ChannelClosed(
+                f"{self.path}: "
+                + ("closed by writer" if self._eof is self._CLOSED else "writer died")
+            )
         t0 = time.monotonic()
         try:
             item = self._q.get(timeout=timeout)
@@ -637,18 +1048,24 @@ class SocketChannel:
         if waited > 0.0005:
             self.stats["read_blocked_s"] += waited
         if item is self._CLOSED or item is self._DIED:
-            self._closed = True
-            self._q.put(item)  # later reads fail the same way
+            # Remember the death so later reads fail the same way (until
+            # a successful reattach clears it).
+            self._eof = item
             raise ChannelClosed(
                 f"{self.path}: "
                 + ("closed by writer" if item is self._CLOSED else "writer died")
             )
         # Consume-ack: flow control counts messages the CONSUMER has
         # taken, not what the rx thread buffered.
+        self._consumed_seq += 1
         try:
             self._sock.sendall(_ACK)
         except OSError:
             pass  # writer already gone; reads of buffered frames still valid
+        if item is self._CORRUPT:
+            raise ChannelCorruptionError(
+                f"{self.path}: frame failed CRC validation"
+            )
         s = self.stats
         s["reads"] += 1
         s["bytes_read"] += len(item)
@@ -657,22 +1074,171 @@ class SocketChannel:
             self._tele_flush()
         return item
 
-    def read(self, timeout: Optional[float] = 30.0) -> bytes:
-        return self._pop_frame(timeout)
+    def read(self, timeout=DEFAULT_TIMEOUT) -> bytes:
+        return self._pop_frame(_resolve_timeout(timeout))
 
-    def read_value(self, timeout: Optional[float] = 30.0) -> Tuple[int, Any]:
-        from ray_tpu._private import wire
-
-        frame = self._pop_frame(timeout)
-        # One-shot frame owned by us: arrays may alias it (no copy).
-        return wire.decode(memoryview(frame), copy_arrays=False)
+    def read_value(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any]:
+        wire = _wire_mod()
+        frame = self._pop_frame(_resolve_timeout(timeout))
+        try:
+            # One-shot frame owned by us: arrays may alias it (no copy).
+            return wire.decode(memoryview(frame), copy_arrays=False)
+        except wire.WireFormatError as e:
+            self._record_corruption()
+            raise ChannelCorruptionError(
+                f"{self.path}: undecodable frame ({e})"
+            ) from e
 
     def pending(self) -> bool:
         if self.role == "read":
             return not self._q.empty()
         return self._unacked > 0
 
+    # -- reattach -------------------------------------------------------
+    def _reattach_read(self, timeout: float) -> bool:
+        """Re-accept the writer's epoch-bumped dial on the still-open
+        listener and resume the frame stream (the handshake reply tells
+        the writer where to seq-resume from)."""
+        ok = False
+        try:
+            if self._listener is None or self._eof is self._CLOSED:
+                return False  # orderly close is final; only deaths reattach
+            old_rx = self._rx
+            conn, epoch = self._listener._accept_conn(
+                timeout, rx_seq=self._rx_seq, consumed=self._consumed_seq
+            )
+            if old_rx is not None and old_rx.is_alive():
+                old_rx.join(timeout=1.0)
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = conn
+            self.epoch = epoch
+            self._eof = None
+            self._closed = False
+            self._start_rx()
+            ok = True
+            return True
+        except (ChannelTimeout, ChannelClosed, OSError):
+            return False
+        finally:
+            _count_reattach(ok)
+
+    def _reattach_write(self, timeout: float) -> bool:
+        """Re-dial the reader's listener with the pairing token at a
+        bumped epoch; the reply's rx_seq/consumed resync flow control
+        and select which unacked frames to replay."""
+        import socket as _socket
+
+        ok = False
+        try:
+            if self._peer_addr is None:
+                return False
+            # The pairing reply may still sit in the dead socket's
+            # receive buffer (delivered before the FIN): salvage it so
+            # the token is known even when no ack was ever drained.
+            if self._token is None:
+                try:
+                    self._sock.setblocking(False)
+                    tail = self._sock.recv(4096)
+                    if tail:
+                        self._absorb_rx_bytes(tail)
+                except OSError:
+                    pass
+            if self._token is None:
+                return False
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            sock = _socket.create_connection(self._peer_addr, timeout=min(timeout, 5.0))
+            try:
+                sock.settimeout(timeout)
+                sock.sendall(
+                    _HELLO.pack(_MAGIC, self.epoch + 1, self._token, self._sent_seq)
+                )
+                reply = _recv_exact_sock(sock, _REPLY.size)
+                if reply is None:
+                    raise OSError("EOF during reattach handshake")
+                magic, epoch, _token, rx_seq, consumed = _REPLY.unpack(reply)
+                if magic != _MAGIC or epoch != self.epoch + 1:
+                    raise OSError("reattach handshake refused")
+                sock.settimeout(None)
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                sock.close()
+                raise
+            self.epoch = int(epoch)
+            # Resync: acks lost with the connection are recovered from
+            # the reader's consumed count; frames it never enqueued are
+            # replayed (duplicates beyond rx_seq are dropped by seq).
+            if consumed > self._acked_seq:
+                self._ack_frames(consumed - self._acked_seq)
+            self._sock = sock
+            self._closed = False
+            for seq, frame in self._replay:
+                if seq > rx_seq:
+                    self._sock.sendall(frame)
+            ok = True
+            return True
+        except OSError:
+            self._closed = True
+            return False
+        finally:
+            _count_reattach(ok)
+
     # -- writer ---------------------------------------------------------
+    def _ack_frames(self, n: int) -> None:
+        self._acked_seq += n
+        self._unacked = max(0, self._sent_seq - self._acked_seq)
+        while self._replay and self._replay[0][0] <= self._acked_seq:
+            self._replay.popleft()
+
+    def _absorb_rx_bytes(self, data: bytes) -> None:
+        """Writer-side rx stream: the pairing reply first (once), then
+        one ack byte per frame the reader consumed."""
+        if self._reply_buf is not None:
+            take = _REPLY.size - len(self._reply_buf)
+            self._reply_buf += data[:take]
+            data = data[take:]
+            if len(self._reply_buf) == _REPLY.size:
+                magic, epoch, token, _rx, _cons = _REPLY.unpack(self._reply_buf)
+                self._reply_buf = None
+                if magic == _MAGIC:
+                    self._token = bytes(token)
+                    self.epoch = int(epoch)
+        if data:
+            self._ack_frames(len(data))
+
+    def _await_reply(self, deadline: Optional[float]) -> None:
+        """Block (bounded) until the pairing reply is absorbed.  Runs
+        once, before the FIRST frame send: dial() deliberately does not
+        wait for it (mutual-edge deadlock), but the reply must be in
+        hand before any frame could need replaying — it carries the
+        reattach token."""
+        import select as _select
+
+        while self._reply_buf is not None:
+            timeout = 1.0
+            if deadline is not None:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    self.stats["write_timeouts"] += 1
+                    raise ChannelTimeout(
+                        f"pairing reply from {self.path} not received in time"
+                    )
+            try:
+                ready, _, _ = _select.select([self._sock], [], [], timeout)
+            except ValueError:
+                raise OSError("socket closed") from None
+            if not ready:
+                continue
+            data = self._sock.recv(4096)
+            if not data:
+                raise OSError("peer hung up before pairing reply")
+            self._absorb_rx_bytes(data)
+
     def _drain_acks(self, deadline: Optional[float]) -> None:
         """Consume available acks; when the window is full, block (up to
         the deadline) for the next one."""
@@ -692,32 +1258,29 @@ class SocketChannel:
                             f"reader of {self.path} did not consume "
                             f"(window {self._window} full)"
                         )
-            ready, _, _ = _select.select([self._sock], [], [], timeout)
+            try:
+                ready, _, _ = _select.select([self._sock], [], [], timeout)
+            except ValueError:  # closed fd: same meaning as a dead peer
+                raise OSError("socket closed") from None
             if not ready:
                 if self._unacked < self._window:
                     return
                 continue  # window full: keep waiting for the ack
-            try:
-                acks = self._sock.recv(4096)
-            except OSError:
-                acks = b""
+            acks = self._sock.recv(4096)
             if not acks:
-                self._closed = True
-                raise ChannelClosed(f"{self.path}: reader died")
-            self._unacked -= len(acks)
+                raise OSError("reader endpoint hung up")
+            self._absorb_rx_bytes(acks)
             if self._unacked < self._window:
                 return
 
-    def _send_frame(self, payload_len: int) -> None:
-        _FRAME.pack_into(self._scratch, 0, payload_len)
-        self._sock.sendall(memoryview(self._scratch)[: 8 + payload_len])
-
     def _encode_scratch(self, value: Any, tag: int) -> int:
-        from ray_tpu._private import wire
-
+        wire = _wire_mod()
         while True:
             try:
-                return wire.encode_into(memoryview(self._scratch)[8:], value, tag)
+                return wire.encode_into(
+                    memoryview(self._scratch)[_FRAME_HDR.size:len(self._scratch) - 4],
+                    value, tag,
+                )
             except (struct.error, ValueError, IndexError):
                 if len(self._scratch) >= 1 << 31:
                     raise ChannelCapacityError(
@@ -725,37 +1288,97 @@ class SocketChannel:
                     ) from None
                 self._scratch = bytearray(len(self._scratch) * 4)
 
+    def _reattach_budget(self, deadline: Optional[float]) -> float:
+        from ray_tpu._private.config import CONFIG
+
+        budget = float(CONFIG.channel_reattach_timeout_s)
+        if deadline is not None:
+            budget = max(0.5, min(budget, deadline - time.monotonic()))
+        return budget
+
     def _write_payload(self, value: Any, tag: int, timeout: Optional[float], data: Optional[bytes]) -> None:
         if self._closed:
             raise ChannelClosed(self.path)
+        cd = _chaos_decide(self.path)
+        if cd is not None:
+            if cd.delay_s > 0:
+                time.sleep(cd.delay_s)
+            if cd.drop:
+                self._count_write(len(data) if data is not None else 0)
+                return
+            if cd.close:
+                # Abrupt connection loss (no poison): the send below
+                # fails and takes the real reattach path — the drill
+                # exercises exactly what a transient TCP drop does.
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
         deadline = None if timeout is None else time.monotonic() + timeout
         t0 = time.monotonic()
-        try:
-            self._drain_acks(deadline)
-            if data is not None:
-                n = len(data)
-                if len(self._scratch) < 8 + n:
-                    self._scratch = bytearray(8 + n)
-                self._scratch[8 : 8 + n] = data
+        # Encode the full frame once; it is also the replay entry.
+        hdr = _FRAME_HDR.size
+        if data is not None:
+            n = len(data)
+            if len(self._scratch) < hdr + n + 4:
+                self._scratch = bytearray(hdr + n + 4)
+            self._scratch[hdr : hdr + n] = data
+        else:
+            n = self._encode_scratch(value, tag)
+        crc = zlib.crc32(memoryview(self._scratch)[hdr : hdr + n])
+        if cd is not None and cd.corrupt:
+            if n > 0:
+                self._scratch[hdr] ^= 0xFF
             else:
-                n = self._encode_scratch(value, tag)
-            self._send_frame(n)
-        except OSError as e:
-            self._closed = True
-            raise ChannelClosed(f"{self.path}: {e}") from None
+                crc ^= 0xFFFFFFFF
+        seq = self._sent_seq + 1
+        _FRAME_HDR.pack_into(self._scratch, 0, n, seq)
+        _U32C.pack_into(self._scratch, hdr + n, crc & 0xFFFFFFFF)
+        frame = bytes(memoryview(self._scratch)[: hdr + n + 4])
+        # Window space (one transparent reattach on a dead connection).
+        for attempt in (0, 1):
+            try:
+                if self._reply_buf is not None:
+                    self._await_reply(deadline)
+                self._drain_acks(deadline)
+                break
+            except OSError:
+                if attempt or not self._reattach_write(self._reattach_budget(deadline)):
+                    self._closed = True
+                    raise ChannelClosed(f"{self.path}: reader died") from None
+        self._replay.append((seq, frame))
+        self._sent_seq = seq
+        self._unacked += 1
+        try:
+            if cd is not None and cd.torn:
+                # Mid-frame writer kill: header + half the payload on
+                # the wire, then the connection dies.
+                self._sock.sendall(frame[: hdr + max(1, n // 2)])
+                self._sock.close()
+                raise OSError("chaos torn write")
+            self._sock.sendall(frame)
+        except OSError:
+            if not self._reattach_write(self._reattach_budget(deadline)):
+                # Never delivered and never will be: withdraw the frame.
+                self._replay.pop()
+                self._sent_seq -= 1
+                self._unacked -= 1
+                self._closed = True
+                raise ChannelClosed(f"{self.path}: connection lost") from None
+            # _reattach_write replayed every frame past the reader's
+            # rx_seq — including this one.
         waited = time.monotonic() - t0
         if waited > 0.0005:
             self.stats["write_blocked_s"] += waited
-        self._unacked += 1
         self._count_write(n)
 
     _count_write = Channel._count_write
 
-    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
-        self._write_payload(None, 0, timeout, data)
+    def write(self, data: bytes, timeout=DEFAULT_TIMEOUT) -> None:
+        self._write_payload(None, 0, _resolve_timeout(timeout), data)
 
-    def write_value(self, value: Any, tag: int = 0, timeout: Optional[float] = 30.0) -> None:
-        self._write_payload(value, tag, timeout, None)
+    def write_value(self, value: Any, tag: int = 0, timeout=DEFAULT_TIMEOUT) -> None:
+        self._write_payload(value, tag, _resolve_timeout(timeout), None)
 
     def try_write_value(self, value: Any, tag: int = 0) -> bool:
         if self._closed:
@@ -763,16 +1386,30 @@ class SocketChannel:
         if self._unacked >= self._window:
             import select as _select
 
-            ready, _, _ = _select.select([self._sock], [], [], 0.0)
+            try:
+                ready, _, _ = _select.select([self._sock], [], [], 0.0)
+            except ValueError:
+                ready = []
             if ready:
                 try:
                     acks = self._sock.recv(4096)
                 except OSError:
                     acks = b""
                 if not acks:
-                    self._closed = True
-                    raise ChannelClosed(f"{self.path}: reader died")
-                self._unacked -= len(acks)
+                    # Transient connection loss: the same transparent
+                    # reattach the blocking write path gets — an edge
+                    # write_value would heal must not tear down here —
+                    # but bounded at 1 s, not the full reattach budget:
+                    # try-writes are the fan-out scheduling primitive
+                    # and independent sibling edges are stalled while
+                    # this one re-dials.
+                    if not self._reattach_write(
+                        self._reattach_budget(time.monotonic() + 1.0)
+                    ):
+                        self._closed = True
+                        raise ChannelClosed(f"{self.path}: reader died")
+                    return False  # window/acks resynced; caller retries
+                self._absorb_rx_bytes(acks)
             if self._unacked >= self._window:
                 return False
         self.write_value(value, tag, timeout=None)
@@ -794,9 +1431,41 @@ class SocketChannel:
             self._sock.close()
         except OSError:
             pass
+        if self._listener is not None:
+            self._listener.close()
 
     def unlink(self) -> None:  # contract parity with the ring
         pass
+
+
+def reattach(chan, timeout: Optional[float] = None) -> bool:
+    """ONE shared recovery step for a channel that raised
+    ``ChannelClosed``: returns True when the edge is live again (resume
+    reading/writing), False when the peer is really gone and the
+    caller's heavy recovery (replica evict + RPC fallback, runner
+    respawn, pipeline restart) must run.  Socket endpoints perform the
+    epoch-bumped reconnect with seq-resume; ring endpoints are only
+    "reattachable" if the closed flag was never set (a local mmap
+    failure), since a set flag means the peer deliberately closed.
+
+    Counted via ``channel_reattach_total{result}``."""
+    if timeout is None:
+        from ray_tpu._private.config import CONFIG
+
+        timeout = float(CONFIG.channel_reattach_timeout_s)
+    try:
+        if isinstance(chan, SocketChannel):
+            if chan.role == "read":
+                return chan._reattach_read(timeout)
+            return chan._reattach_write(timeout)
+        ok = False
+        if isinstance(chan, Channel):
+            ok = os.path.exists(chan.path) and not chan._closed_flag()
+        _count_reattach(ok)
+        return ok
+    except Exception:
+        _count_reattach(False)
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -808,13 +1477,21 @@ class SocketChannel:
 # ring stores the payload ONCE; each reader owns a consume cursor, and
 # the writer's free space is bounded by the SLOWEST reader (min over
 # cursors), so flow control degrades exactly like a single-reader ring.
+# Every reader registers its PID beside its cursor: a reader that dies
+# without consuming (SIGKILL) is detected by the blocked writer and its
+# cursor EVICTED, so a dead reader can no longer wedge the broadcast
+# forever (counted via channel_fanout_evictions_total).
 #
-#     [wbytes u64][closed u64][n_readers u64][r0 u64]..[rN-1 u64][pad]
-#     [ring payload: [u64 len][data][pad8] / WRAP markers ...]
+#     [wbytes u64][closed u64][n_readers u64][writer_pid u64]
+#     [cursor0 u64]..[cursorN-1 u64][pid0 u64]..[pidN-1 u64][pad..64]
+#     [ring payload: [u64 len][data][u32 crc][pad8] / WRAP markers ...]
+
+
+_EVICTED_PID = (1 << 64) - 1
 
 
 def _fanout_header(n_readers: int) -> int:
-    return ((24 + 8 * n_readers + 63) // 64) * 64
+    return ((32 + 16 * n_readers + 63) // 64) * 64
 
 
 class FanoutChannel:
@@ -838,7 +1515,7 @@ class FanoutChannel:
         self._header = header
         cap = size - header
         self.capacity = cap - (cap % 8)
-        self.max_size = self.capacity - 16
+        self.max_size = self.capacity - 24
         self._mm = mmap.mmap(self._f.fileno(), size)
         if create:
             _U64.pack_into(self._mm, 16, n_readers)
@@ -849,25 +1526,73 @@ class FanoutChannel:
                     f"fan-out channel {path} was created for {stored} "
                     f"readers, opened for {n_readers}"
                 )
-        self.stats = {"writes": 0, "bytes_written": 0, "write_blocked_s": 0.0}
+        _U64.pack_into(self._mm, 24, os.getpid())
+        _register_shm_pid(path)
+        self.stats = {"writes": 0, "bytes_written": 0, "write_blocked_s": 0.0,
+                      "evictions": 0}
 
-    def _reader_off(self, idx: int) -> int:
-        return 24 + 8 * idx
+    def _cursor_off(self, idx: int) -> int:
+        return 32 + 8 * idx
+
+    def _pid_off(self, idx: int) -> int:
+        return 32 + 8 * self.n_readers + 8 * idx
 
     def _min_read(self) -> int:
-        return min(
-            _U64.unpack_from(self._mm, self._reader_off(i))[0]
-            for i in range(self.n_readers)
-        )
+        """Free-space bound: min cursor over NON-evicted readers.  When
+        every reader has been evicted the broadcast has no audience —
+        typed close, never a silent write into the void."""
+        lo = None
+        for i in range(self.n_readers):
+            if _U64.unpack_from(self._mm, self._pid_off(i))[0] == _EVICTED_PID:
+                continue
+            cur = _U64.unpack_from(self._mm, self._cursor_off(i))[0]
+            lo = cur if lo is None or cur < lo else lo
+        if lo is None:
+            raise ChannelClosed(
+                f"{self.path}: every fan-out reader is dead (evicted)"
+            )
+        return lo
 
-    def write(self, data: bytes, timeout: Optional[float] = 30.0) -> None:
-        need = 8 + _align8(len(data))
+    def _evict_dead_readers(self) -> int:
+        """Evict readers whose registered PID is dead: their cursor no
+        longer bounds the writer's free space.  A reader that never
+        attached (pid slot 0) is NOT evicted — it may still be on its
+        way; the write timeout covers that case exactly as before."""
+        evicted = 0
+        for i in range(self.n_readers):
+            pid = _U64.unpack_from(self._mm, self._pid_off(i))[0]
+            if pid in (0, _EVICTED_PID) or _pid_alive(pid):
+                continue
+            _U64.pack_into(self._mm, self._pid_off(i), _EVICTED_PID)
+            evicted += 1
+        if evicted:
+            self.stats["evictions"] += evicted
+            try:
+                from ray_tpu._private import telemetry
+
+                telemetry.count_fanout_eviction(evicted)
+            except Exception:
+                pass
+        return evicted
+
+    def write(self, data: bytes, timeout=DEFAULT_TIMEOUT) -> None:
+        cd = _chaos_decide(self.path)
+        if cd is not None:
+            if cd.delay_s > 0:
+                time.sleep(cd.delay_s)
+            if cd.close:
+                self.close()
+                raise ChannelClosed(f"{self.path}: chaos close")
+            if cd.drop:
+                self.stats["writes"] += 1
+                return
+        need = 8 + _align8(len(data) + 4)
         if need > self.max_size:
             raise ChannelCapacityError(
                 f"message of {len(data)} bytes exceeds fan-out channel "
                 f"capacity {self.max_size}; raise the buffer size"
             )
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None  # resolved at first block (see Channel.write)
         spins = 0
         t_block = 0.0
         cap = self.capacity
@@ -889,12 +1614,20 @@ class FanoutChannel:
                 break
             if spins == 0:
                 t_block = time.monotonic()
+                timeout = _resolve_timeout(timeout)
+                deadline = None if timeout is None else t_block + timeout
             spins += 1
+            # A blocked broadcast probes for dead readers: a SIGKILLed
+            # reader's un-advanced cursor must not wedge the writer for
+            # the whole timeout (or forever, with timeout=None).
+            if spins % 512 == 0 and self._evict_dead_readers():
+                continue
             if spins < 4000:
                 time.sleep(0)
             else:
                 time.sleep(min(0.001, 0.00002 * (spins - 3999)))
             if deadline is not None and time.monotonic() > deadline:
+                self._evict_dead_readers()
                 self.stats["write_blocked_s"] += time.monotonic() - t_block
                 raise ChannelTimeout(
                     f"slowest of {self.n_readers} fan-out readers of "
@@ -902,6 +1635,10 @@ class FanoutChannel:
                 )
         wpos = wb % cap
         self._mm[hdr + wpos + 8: hdr + wpos + 8 + len(data)] = data
+        crc = zlib.crc32(data)
+        if cd is not None:
+            crc = _mutate_payload(self._mm, hdr + wpos + 8, len(data), crc, cd)
+        _U32C.pack_into(self._mm, hdr + wpos + 8 + len(data), crc)
         _U64.pack_into(self._mm, hdr + wpos, len(data))
         _U64.pack_into(self._mm, 0, wb + need)
         if spins:
@@ -910,7 +1647,7 @@ class FanoutChannel:
         self.stats["bytes_written"] += len(data)
 
     def write_value(self, value: Any, tag: int = 0,
-                    timeout: Optional[float] = 30.0) -> None:
+                    timeout=DEFAULT_TIMEOUT) -> None:
         """One encode, N consumers.  The broadcast path is not the
         per-microbatch hot loop, so the simple encode-then-copy beats
         duplicating the ring's in-place encoder for a third layout."""
@@ -939,7 +1676,10 @@ class FanoutChannel:
 class FanoutReader:
     """Reader endpoint ``index`` of a :class:`FanoutChannel`: consumes
     every message exactly once at its own pace; advancing its cursor IS
-    its consume-ack."""
+    its consume-ack.  The reader registers its PID beside the cursor at
+    open so a blocked writer can detect its death and evict it; an
+    evicted reader that was NOT actually dead finds out typed (its pid
+    slot is tombstoned) instead of silently losing frames."""
 
     kind = "fanout"
 
@@ -956,8 +1696,13 @@ class FanoutReader:
         self._header = _fanout_header(n)
         cap = size - self._header
         self.capacity = cap - (cap % 8)
-        self._off = 24 + 8 * index
-        self.stats = {"reads": 0, "bytes_read": 0, "read_blocked_s": 0.0}
+        self.max_size = self.capacity - 24
+        self._off = 32 + 8 * index
+        self._pid_slot = 32 + 8 * n + 8 * index
+        _U64.pack_into(self._mm, self._pid_slot, os.getpid())
+        _register_shm_pid(path)
+        self.stats = {"reads": 0, "bytes_read": 0, "read_blocked_s": 0.0,
+                      "corruptions": 0}
 
     def pending(self) -> bool:
         try:
@@ -967,6 +1712,13 @@ class FanoutReader:
             )
         except ValueError:
             return False
+
+    def _check_evicted(self) -> None:
+        if _U64.unpack_from(self._mm, self._pid_slot)[0] == _EVICTED_PID:
+            raise ChannelClosed(
+                f"{self.path}: reader {self.index} was evicted (writer "
+                f"presumed this PID dead)"
+            )
 
     def _next_slot(self) -> Optional[Tuple[int, int]]:
         cap = self.capacity
@@ -983,21 +1735,40 @@ class FanoutReader:
             if n == WRAP:
                 _U64.pack_into(self._mm, self._off, rb + tail)
                 continue
+            if n > self.max_size or 8 + _align8(n + 4) > tail:
+                self.stats["corruptions"] += 1
+                _count_corruption()
+                err = ChannelCorruptionError(
+                    f"{self.path}: torn/garbage fan-out record length {n}"
+                )
+                err.advanced = False  # framing broken: no way past it
+                raise err
             return rpos, n
 
-    def read(self, timeout: Optional[float] = 30.0) -> bytes:
-        deadline = None if timeout is None else time.monotonic() + timeout
+    def read(self, timeout=DEFAULT_TIMEOUT) -> bytes:
+        deadline = None  # resolved at first block (see write())
         spins = 0
         t_block = 0.0
         while True:
+            # Eviction outranks everything: once the writer tombstoned
+            # this cursor it may have overwritten the unread region, so
+            # interpreting it would misreport corruption.
+            self._check_evicted()
             slot = self._next_slot()
             if slot is not None:
                 rpos, n = slot
                 data = bytes(
                     self._mm[self._header + rpos + 8: self._header + rpos + 8 + n]
                 )
+                stored = _U32C.unpack_from(self._mm, self._header + rpos + 8 + n)[0]
                 rb = _U64.unpack_from(self._mm, self._off)[0]
-                _U64.pack_into(self._mm, self._off, rb + 8 + _align8(n))
+                _U64.pack_into(self._mm, self._off, rb + 8 + _align8(n + 4))
+                if zlib.crc32(data) != stored:
+                    self.stats["corruptions"] += 1
+                    _count_corruption()
+                    raise ChannelCorruptionError(
+                        f"{self.path}: fan-out record failed CRC validation"
+                    )
                 self.stats["reads"] += 1
                 self.stats["bytes_read"] += n
                 if spins:
@@ -1007,6 +1778,8 @@ class FanoutReader:
                 raise ChannelClosed(self.path)
             if spins == 0:
                 t_block = time.monotonic()
+                timeout = _resolve_timeout(timeout)
+                deadline = None if timeout is None else t_block + timeout
             spins += 1
             if spins < 4000:
                 time.sleep(0)
@@ -1018,12 +1791,19 @@ class FanoutReader:
                     f"no fan-out message on {self.path} within {timeout}s"
                 )
 
-    def read_value(self, timeout: Optional[float] = 30.0) -> Tuple[int, Any]:
+    def read_value(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any]:
         from ray_tpu._private import wire
 
         # The frame was copied out of the ring by read(); arrays may
         # alias the private copy.
-        return wire.decode(memoryview(self.read(timeout)), copy_arrays=False)
+        try:
+            return wire.decode(memoryview(self.read(timeout)), copy_arrays=False)
+        except wire.WireFormatError as e:
+            self.stats["corruptions"] += 1
+            _count_corruption()
+            raise ChannelCorruptionError(
+                f"{self.path}: undecodable fan-out record ({e})"
+            ) from e
 
     def close(self) -> None:
         try:
@@ -1073,6 +1853,82 @@ def ring_base_dir() -> str:
     return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
 
 
+def sweep_orphan_ring_dirs(base: Optional[str] = None,
+                           grace_s: Optional[float] = None) -> int:
+    """Reclaim ring/fan-out shm directories whose registered owner PIDs
+    are ALL dead (the tmpfs leak after a SIGKILL skipped every teardown
+    path).  Run by the raylet on a channel_shm_sweep_period_s cadence;
+    safe to run from multiple raylets of one host (unlink succeeds once,
+    so files are never double-counted).  Conservative by construction:
+    a directory with no PID registry yet, or any live registered owner,
+    is never touched, and directories younger than the grace window are
+    skipped (the mkdir→first-open registration gap).
+
+    Returns the number of channel files reclaimed (counted via
+    ``channel_shm_reclaimed_total``)."""
+    from ray_tpu._private.config import CONFIG
+
+    if base is None:
+        base = ring_base_dir()
+    if grace_s is None:
+        grace_s = float(CONFIG.channel_shm_orphan_grace_s)
+    reclaimed = 0
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        if not name.startswith("ray_tpu_"):
+            continue
+        d = os.path.join(base, name)
+        try:
+            if not os.path.isdir(d) or now - os.stat(d).st_mtime < grace_s:
+                continue
+            with open(os.path.join(d, ".pids")) as f:
+                pids = {int(line) for line in f if line.strip()}
+        except (OSError, ValueError):
+            continue  # no/invalid registry: conservative, skip
+        if not pids or any(_pid_alive(p) for p in pids):
+            continue
+        # Narrow the attach race: a process registering between the
+        # first read and the unlink below would lose its live files.
+        # Creating channel files bumps the dir mtime (grace-protected),
+        # but pure-open endpoints only append to .pids — re-read it
+        # immediately before destruction so the window shrinks from one
+        # sweep period to microseconds.
+        try:
+            with open(os.path.join(d, ".pids")) as f:
+                pids2 = {int(line) for line in f if line.strip()}
+        except (OSError, ValueError):
+            continue
+        if pids2 != pids and any(_pid_alive(p) for p in pids2):
+            continue
+        try:
+            entries = os.listdir(d)
+        except OSError:
+            continue
+        for fn in entries:
+            try:
+                os.unlink(os.path.join(d, fn))
+                if fn != ".pids":
+                    reclaimed += 1
+            except OSError:
+                pass
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass
+    if reclaimed:
+        try:
+            from ray_tpu._private import telemetry
+
+            telemetry.count_shm_reclaimed(reclaimed)
+        except Exception:
+            pass
+    return reclaimed
+
+
 def node_hosts(worker) -> dict:
     """node id (hex) -> reachable host, from the GCS cluster view.
     Local (unix-socket) raylets are same-machine by definition."""
@@ -1100,8 +1956,8 @@ def open_channel(desc: dict, role: str, timeout: float = 30.0):
     Socket rule: the READER bound the listener during setup (and accepts
     here); the WRITER dials.  Dials never deadlock accepts because every
     listener is bound before any loop starts (TCP completes the
-    handshake from the backlog).
-    """
+    handshake from the backlog; the pairing reply is absorbed lazily
+    from the ack stream)."""
     if desc["kind"] == "ring":
         return Channel(desc["path"])
     if role == "write":
@@ -1110,7 +1966,7 @@ def open_channel(desc: dict, role: str, timeout: float = 30.0):
 
 
 def write_value_fanout(
-    targets: Sequence[Tuple[Any, Any, int]], timeout: Optional[float] = None
+    targets: Sequence[Tuple[Any, Any, int]], timeout=DEFAULT_TIMEOUT
 ) -> None:
     """Write a batch of (channel, value, tag) with fan-out overlap: each
     blocked edge is retried round-robin via ``try_write_value`` so one
@@ -1119,19 +1975,39 @@ def write_value_fanout(
     blocking on any single peer)."""
     if len(targets) == 1:
         chan, value, tag = targets[0]
-        chan.write_value(value, tag, timeout=timeout)
+        chan.write_value(value, tag, timeout=timeout)  # resolves lazily
         return
-    pending: List[Tuple[Any, Any, int]] = list(targets)
-    deadline = None if timeout is None else time.monotonic() + timeout
+    # Ring frames get their chaos verdict HERE, once per frame, with the
+    # pre-actions (drop / delay / close) applied exactly once — blocked
+    # retry rounds below must not consume extra match ordinals or
+    # re-sleep a delay (seeded schedules are per-frame deterministic).
+    # Socket channels decide inside the actual send, which try-writes
+    # reach at most once per frame.
+    pending = []
+    for chan, value, tag in targets:
+        cd = _CHAOS_UNDECIDED
+        if isinstance(chan, Channel):
+            cd = _chaos_decide(chan.path)
+            if cd is not None and chan._apply_write_chaos(cd, 0):
+                continue  # dropped: the frame silently vanishes
+        pending.append((chan, value, tag, cd))
+    deadline = None  # resolved at first blocked round (see Channel.write)
     spins = 0
     while pending:
         rest = []
-        for chan, value, tag in pending:
-            if not chan.try_write_value(value, tag):
-                rest.append((chan, value, tag))
+        for chan, value, tag, cd in pending:
+            if cd is _CHAOS_UNDECIDED:
+                ok = chan.try_write_value(value, tag)
+            else:
+                ok = chan.try_write_value(value, tag, cd=cd)
+            if not ok:
+                rest.append((chan, value, tag, cd))
         if not rest:
             return
         pending = rest
+        if spins == 0:
+            timeout = _resolve_timeout(timeout)
+            deadline = None if timeout is None else time.monotonic() + timeout
         spins += 1
         if spins > 1000:
             time.sleep(min(0.001, 0.00002 * (spins - 1000)))

@@ -46,10 +46,12 @@ import numpy as np
 from ray_tpu.experimental.channel import (
     Channel,
     ChannelClosed,
+    ChannelCorruptionError,
     ChannelTimeout,
     SocketListener,
     dial,
     node_hosts,
+    reattach,
     ring_base_dir,
 )
 
@@ -233,7 +235,7 @@ class TrajectoryPlane:
             self.fragment_length, self.explore
         )
         if self.policy_mode == "anakin":
-            rs.weights.write_value((generation, weights), timeout=30.0)
+            rs.weights.write_value((generation, weights))
         rs.last_gen = generation
         rs.alive = True
 
@@ -326,7 +328,28 @@ class TrajectoryPlane:
                     if not rs.traj.pending():
                         continue
                     _tag, frag = rs.traj.read_value(timeout=10.0)
-                except (ChannelClosed, ChannelTimeout):
+                except ChannelCorruptionError:
+                    # The fragment is gone and per-runner seqs must stay
+                    # contiguous: retire the edge (typed, counted) and
+                    # let maintain() respawn the runner at the current
+                    # generation.  No corrupted fragment ever reaches
+                    # the learner.
+                    if not self._closing:
+                        logger.warning(
+                            "trajectory frame from runner %d failed "
+                            "integrity validation; retiring the edge",
+                            rs.index + 1,
+                        )
+                        rs.alive = False
+                    continue
+                except ChannelClosed:
+                    # Connection-level death: one shared reattach (the
+                    # runner's writer re-dials on its next fragment)
+                    # before the heavy respawn path.
+                    if not self._closing and not reattach(rs.traj, timeout=2.0):
+                        rs.alive = False  # maintain() reclaims + respawns
+                    continue
+                except ChannelTimeout:
                     if not self._closing:
                         rs.alive = False  # maintain() reclaims + respawns
                     continue

@@ -228,12 +228,26 @@ class SingleAgentEnvRunner:
         """Adopt the NEWEST pending weight snapshot (generation-tagged);
         stale intermediates are consumed and discarded.  ``block`` only
         on the very first fragment (no params yet)."""
+        from ray_tpu.experimental.channel import ChannelCorruptionError
+
         chan = self._weight_chan
         if chan is None:
             return
         newest = None
         while chan.pending() or (block and newest is None):
-            _tag, (gen, weights) = chan.read_value(timeout=60.0 if block else 1.0)
+            try:
+                _tag, (gen, weights) = chan.read_value(timeout=60.0 if block else 1.0)
+            except ChannelCorruptionError as e:
+                # A torn/corrupt snapshot is NEVER adopted: keep the
+                # current weights (one generation staler — the next
+                # broadcast or a staleness refresh covers it) unless
+                # this is the blocking first snapshot, which must retry.
+                # Broken FRAMING (non-advanced) would spin on the same
+                # garbage: let it kill the stream loop so the learner
+                # respawns this runner with fresh channels.
+                if e.advanced:
+                    continue
+                raise
             newest = (gen, weights)
         if newest is not None:
             self._weight_gen = int(newest[0])

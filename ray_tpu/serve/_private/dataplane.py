@@ -33,9 +33,11 @@ from typing import Any, Dict, Optional, Tuple
 from ray_tpu.experimental.channel import (
     Channel,
     ChannelClosed,
+    ChannelCorruptionError,
     SocketListener,
     dial,
     node_hosts,
+    reattach,
 )
 
 _DEAD = object()  # rx-thread sentinel fanned out to every waiter on death
@@ -89,7 +91,26 @@ class ReplicaDataplane:
             if self._req_listener is not None:
                 self._req = self._req_listener.accept("read", timeout=30.0)
             while True:
-                _tag, frame = self._req.read_value(timeout=None)
+                try:
+                    _tag, frame = self._req.read_value(timeout=None)
+                except ChannelCorruptionError as e:
+                    # The corrupted frame is consumed and its request id
+                    # unknowable — nothing wrong is ever dispatched.
+                    # The router's call/stream surfaces a typed timeout/
+                    # ActorDiedError, never a garbage payload.  A
+                    # NON-advancing corruption (torn framing) would spin
+                    # on the same garbage forever: detach instead (the
+                    # router falls back to the RPC path).
+                    if e.advanced:
+                        continue
+                    raise
+                except ChannelClosed:
+                    # Connection-level death: one shared reattach (the
+                    # router's writer re-dials with the pairing token)
+                    # before detaching back to the RPC path.
+                    if reattach(self._req):
+                        continue
+                    raise
                 kind, rid, method, args, kwargs, model_id = frame
                 if kind == "cancel":
                     # park-then-recheck (the dispatch does the mirrored
@@ -347,7 +368,24 @@ class ChannelClient:
         items = 0
         try:
             while True:
-                _tag, frame = self._resp.read_value(timeout=None)
+                try:
+                    _tag, frame = self._resp.read_value(timeout=None)
+                except ChannelCorruptionError:
+                    # A response frame is gone and its request id with
+                    # it: the waiter would hang, so the affected client
+                    # fails over like a replica death — every in-flight
+                    # request gets the typed ActorDiedError and the
+                    # router evicts + falls back to RPC.  Zero corrupted
+                    # values ever reach user code.
+                    raise
+                except ChannelClosed:
+                    # Transient connection loss: one shared reattach
+                    # (epoch bump + seq replay) keeps every in-flight
+                    # call/stream alive; failure falls through to the
+                    # death path below.
+                    if reattach(self._resp):
+                        continue
+                    raise
                 rid = frame[1]
                 with self._waiters_lock:
                     q = self._waiters.get(rid)
@@ -382,7 +420,7 @@ class ChannelClient:
         if self.dead:
             raise ChannelClosed(self.replica_id)
         with self._send_lock:
-            self._req.write_value(frame, timeout=30.0)
+            self._req.write_value(frame)
 
     # -- public ---------------------------------------------------------
     def call(self, method: str, args: tuple, kwargs: dict, model_id: str = "") -> ChannelFuture:

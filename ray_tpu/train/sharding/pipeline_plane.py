@@ -41,12 +41,14 @@ import ray_tpu
 from ray_tpu.experimental.channel import (
     Channel,
     ChannelClosed,
+    ChannelCorruptionError,
     ChannelTimeout,
     FanoutChannel,
     FanoutReader,
     SocketListener,
     dial,
     node_hosts,
+    reattach,
     ring_base_dir,
 )
 
@@ -426,7 +428,10 @@ class PipelineStage:
     def _read(self, chan, what: str):
         """Blocking channel read that honors the stop flag: short read
         timeouts are retried until stop is set (an idle pipeline between
-        driver steps is not an error)."""
+        driver steps is not an error).  A connection-level death takes
+        one shared reattach() before giving up; a corrupted frame
+        propagates typed (a lost microbatch desyncs 1F1B — the driver's
+        checkpoint-restart owns that)."""
         while True:
             try:
                 _tag, value = chan.read_value(timeout=5.0)
@@ -435,7 +440,10 @@ class PipelineStage:
                 if self._stop.is_set():
                     raise ChannelClosed(f"stage {self.index} stopping ({what})")
             except ChannelClosed:
-                raise
+                if self._stop.is_set():
+                    raise
+                if not reattach(chan):
+                    raise
 
     def _loop(self, edge_specs: Dict[str, dict]):
         import jax
@@ -813,10 +821,18 @@ class PipelinePlane:
                 self._chans["tgt"].write_value(
                     np.ascontiguousarray(targets[sl]), timeout=60.0
                 )
-            _tag, res = self._chans["result"].read_value(
-                timeout=cfg.step_timeout_s
-            )
-        except (ChannelClosed, ChannelTimeout, OSError) as e:
+            while True:
+                try:
+                    _tag, res = self._chans["result"].read_value(
+                        timeout=cfg.step_timeout_s
+                    )
+                    break
+                except ChannelClosed:
+                    # A transient drop of the result edge is recoverable
+                    # in place; anything else is a stage failure.
+                    if not reattach(self._chans["result"]):
+                        raise
+        except (ChannelClosed, ChannelTimeout, ChannelCorruptionError, OSError) as e:
             raise StageFailedError(
                 f"pipeline step failed ({type(e).__name__}: {e}); "
                 f"dead stages: {self._dead_stages()}; "

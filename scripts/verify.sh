@@ -82,6 +82,20 @@ if [ "${RAY_TPU_SKIP_DAG_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Dataplane chaos smoke (self-healing dataplane end-to-end): compiled
+# DAG with a cross-raylet socket edge + serve calls and token streams
+# under a seeded chan:* chaos spec (mid-frame torn writes, abrupt
+# socket drops, a serve ring close) — every result exact via epoch
+# reattach / RPC fallback, zero leaked shm.  Skippable via
+# RAY_TPU_SKIP_DATAPLANE_CHAOS_SMOKE=1.
+if [ "${RAY_TPU_SKIP_DATAPLANE_CHAOS_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      python scripts/dataplane_chaos_smoke.py; then
+    echo "dataplane chaos smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # RLlib async smoke (podracer streaming plane end-to-end): 2 streaming
 # env runners + learner over real channels, fixed seed, reward parity
 # vs the synchronous PPO path on CartPole, and the IMPALA-style async

@@ -18,6 +18,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -44,6 +46,7 @@ def _run_bench(env_overrides, timeout):
     return proc, records
 
 
+@pytest.mark.slow  # ~21 s wedged-subprocess deadline drill: tier-2
 def test_wedged_tunnel_still_emits_record():
     """A hanging backend init (the real wedge signature) must still yield
     parseable JSON lines well inside the global deadline."""

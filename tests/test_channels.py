@@ -413,34 +413,327 @@ def test_reader_timeout_vs_writer_death_detection(tmp_path):
     assert out["death_latency"] < 5.0  # detected, not timed out at 30s
 
 
-def test_socket_reconnect_refused_semantics(tmp_path):
-    """A compiled edge's listener accepts exactly one connection; once
-    consumed (or dead), a new dial is refused with the typed error —
-    silent reconnects could drop in-flight messages."""
+def test_socket_rogue_dial_never_pairs(tmp_path):
+    """The single-writer contract under the reattach-capable listener:
+    a second (unauthenticated) dial during a healthy pairing is never
+    paired — it gets no handshake reply, its frames never reach the
+    consumer, and its writes fail typed (flow-control timeout) instead
+    of corrupting the stream.  The legit edge is unaffected."""
     import threading
 
-    from ray_tpu.experimental.channel import (
-        ChannelConnectionError,
-        SocketListener,
-        dial,
-    )
+    from ray_tpu.experimental.channel import SocketListener, dial
 
     lst = SocketListener()
     got = {}
 
     def reader():
         ch = lst.accept("read", timeout=5)
-        got["v"] = ch.read_value(timeout=5)
+        got["v1"] = ch.read_value(timeout=5)
+        got["v2"] = ch.read_value(timeout=10)
+        got["chan"] = ch
 
     t = threading.Thread(target=reader, daemon=True)
     t.start()
     w = dial(("127.0.0.1", lst.port), "write", timeout=5)
     w.write_value(123)
+    # A rogue dial connects at the TCP level (backlog) but is never
+    # handshaken: its first write times out waiting for a pairing reply
+    # that will never come — no rogue frame ever reaches the consumer.
+    rogue = dial(("127.0.0.1", lst.port), "write", timeout=5)
+    with pytest.raises((ChannelTimeout, ChannelClosed)):
+        rogue.write_value("evil", timeout=0.5)
+    w.write_value(456)
     t.join(10)
-    assert got["v"] == (0, 123)
-    with pytest.raises(ChannelConnectionError):
-        dial(("127.0.0.1", lst.port), "write", timeout=0.8)
+    assert got["v1"] == (0, 123) and got["v2"] == (0, 456)
+    rogue.close()
     w.close()
+    got["chan"].close()
+
+
+def test_socket_epoch_reattach_resumes_unacked(tmp_path):
+    """Transient TCP drop: the writer re-dials with the pairing token
+    at a bumped epoch and replays unacked frames; the reader re-accepts
+    via the shared reattach() helper.  Every frame arrives exactly once
+    in order — no loss, no duplicates."""
+    import threading
+
+    from ray_tpu.experimental.channel import SocketListener, dial, reattach
+
+    lst = SocketListener()
+    out = {"vals": []}
+
+    def reader():
+        ch = lst.accept("read", timeout=5)
+        out["chan"] = ch
+        while len(out["vals"]) < 8:
+            try:
+                out["vals"].append(ch.read_value(timeout=10)[1])
+            except ChannelClosed:
+                assert reattach(ch, timeout=5)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    w = dial(("127.0.0.1", lst.port), "write", timeout=5)
+    for i in range(4):
+        w.write_value(i)
+    time.sleep(0.3)
+    w._sock.close()  # transient connection loss, both peers alive
+    for i in range(4, 8):
+        w.write_value(i)  # transparent writer-side reattach
+    t.join(10)
+    assert out["vals"] == list(range(8)), out["vals"]
+    assert w.epoch == 2 and out["chan"].epoch == 2
+    w.close()
+    out["chan"].close()
+
+
+def test_socket_reattach_rejects_bad_token_and_stale_epoch(tmp_path):
+    """Reconnects without the pairing token (or at a non-advancing
+    epoch) are rejected at the handshake: the listener closes the
+    connection and keeps waiting for the authentic peer."""
+    import socket as pysocket
+    import threading
+
+    from ray_tpu.experimental.channel import (
+        _HELLO,
+        _MAGIC,
+        _REPLY,
+        SocketListener,
+        dial,
+        reattach,
+    )
+
+    lst = SocketListener()
+    out = {}
+
+    def reader():
+        ch = lst.accept("read", timeout=5)
+        out["first"] = ch.read_value(timeout=5)
+        try:
+            ch.read_value(timeout=10)
+        except ChannelClosed:
+            out["reattached"] = reattach(ch, timeout=5)
+            out["second"] = ch.read_value(timeout=5)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    w = dial(("127.0.0.1", lst.port), "write", timeout=5)
+    w.write_value("a")
+    time.sleep(0.3)
+    w._sock.close()
+    time.sleep(0.1)
+    # Forged reconnects: wrong token at a bumped epoch, then the right
+    # token at a stale epoch.  Neither may pair.
+    for hello in (
+        _HELLO.pack(_MAGIC, 99, b"\x00" * 16, 0),
+        _HELLO.pack(_MAGIC, 1, lst.token, 0),
+    ):
+        s = pysocket.create_connection(("127.0.0.1", lst.port), timeout=2)
+        s.sendall(hello)
+        s.settimeout(2)
+        assert s.recv(_REPLY.size) == b""  # closed without a reply
+        s.close()
+    # The authentic writer still reattaches fine afterwards.
+    w.write_value("b")
+    t.join(10)
+    assert out["first"] == (0, "a")
+    assert out.get("reattached") is True
+    assert out.get("second") == (0, "b")
+    w.close()
+
+
+def test_ring_crc_corruption_is_typed_and_skipped(tmp_path):
+    """A bit flip in a published record raises ChannelCorruptionError
+    (never a garbage value); the garbage record is consumed so later
+    records still flow."""
+    from ray_tpu.experimental import channel as cm
+    from ray_tpu.experimental.channel import ChannelCorruptionError
+
+    p = str(tmp_path / "crc")
+    Channel.create_file(p, 2048)
+    w, r = Channel(p), Channel(p)
+    w.write(b"good-1")
+    w.write_value({"k": "evil"})
+    w.write(b"good-3")
+    # flip one payload byte of the SECOND record (first record occupies
+    # 8 + align8(6 + 4) = 24 bytes)
+    w._mm[cm.HEADER + 24 + 8] ^= 0xFF
+    assert r.read(timeout=2) == b"good-1"
+    with pytest.raises(ChannelCorruptionError) as ei:
+        r.read_value(timeout=2)
+    assert ei.value.advanced  # garbage consumed: skip-and-continue is safe
+    assert r.read(timeout=2) == b"good-3"
+    assert r.stats["corruptions"] == 1
+
+
+def test_ring_torn_record_length_is_typed_not_garbage(tmp_path):
+    """A torn/garbage length header (SIGKILLed writer mid-publish, shm
+    corruption) raises typed instead of hanging or mis-framing."""
+    import struct as pystruct
+
+    from ray_tpu.experimental import channel as cm
+    from ray_tpu.experimental.channel import ChannelCorruptionError
+
+    p = str(tmp_path / "torn")
+    Channel.create_file(p, 1024)
+    w, r = Channel(p), Channel(p)
+    # Forge a published record whose length field is garbage.
+    pystruct.Struct("<Q").pack_into(w._mm, cm.HEADER, 0x7878787878787878)
+    pystruct.Struct("<Q").pack_into(w._mm, cm._WOFF, 64)  # "published"
+    with pytest.raises(ChannelCorruptionError) as ei:
+        r.read(timeout=2)
+    # the framing itself is broken: the reader CANNOT advance past it,
+    # and consumers must run heavy recovery instead of retrying
+    assert ei.value.advanced is False
+
+
+def test_channel_chaos_actions_inject_and_replay(tmp_path):
+    """chan:<glob> chaos rules fire on channel writes: corrupt_frame is
+    caught by CRC, torn_write by the trailer, drop_frame vanishes, and
+    the seeded schedule replays deterministically."""
+    import os
+
+    from ray_tpu._private.chaos import CHAOS, ChaosPlane
+    from ray_tpu.experimental.channel import ChannelCorruptionError
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("RAY_TPU_testing_chaos_spec", "RAY_TPU_testing_chaos_seed")
+    }
+    try:
+        os.environ["RAY_TPU_testing_chaos_spec"] = (
+            "chan:*chaosring*:corrupt_frame:at=2,"
+            "chan:*chaosring*:torn_write:at=4,"
+            "chan:*chaosring*:drop_frame:at=6"
+        )
+        os.environ["RAY_TPU_testing_chaos_seed"] = "11"
+        CHAOS.reset()
+        p = str(tmp_path / "chaosring")
+        Channel.create_file(p, 8192)
+        w, r = Channel(p), Channel(p)
+        for i in range(7):
+            w.write_value(i)
+        got, corrupt = [], 0
+        while len(got) + corrupt < 6:  # frame 6 was dropped entirely
+            try:
+                got.append(r.read_value(timeout=2)[1])
+            except ChannelCorruptionError:
+                corrupt += 1
+        assert got == [0, 2, 4, 6] and corrupt == 2  # frames 1,3 corrupted/torn
+        with pytest.raises(ChannelTimeout):
+            r.read_value(timeout=0.3)  # frame 5 (at=6) really dropped
+        # seed replay: the same seed + spec produces the same schedule
+        def run_schedule(seed):
+            plane = ChaosPlane()
+            os.environ["RAY_TPU_testing_chaos_seed"] = str(seed)
+            os.environ["RAY_TPU_testing_chaos_spec"] = (
+                "chan:*x*:corrupt_frame:p=0.5:n=-1"
+            )
+            plane.reset()
+            verdicts = [plane.decide_channel("/x/ring").corrupt for _ in range(40)]
+            return verdicts, plane.schedule_digest()
+
+        v1, d1 = run_schedule(123)
+        v2, d2 = run_schedule(123)
+        v3, d3 = run_schedule(321)
+        assert v1 == v2 and d1 == d2
+        assert v3 != v1  # a different seed reshuffles the schedule
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        CHAOS.reset()
+
+
+def test_channel_default_timeout_config_knob(tmp_path):
+    """Channel read/write default timeouts route through ONE config
+    knob (channel_default_timeout_s) instead of per-call-site 30.0s."""
+    import os
+
+    p = str(tmp_path / "deft")
+    Channel.create_file(p, 1024)
+    r = Channel(p)
+    os.environ["RAY_TPU_channel_default_timeout_s"] = "0.3"
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ChannelTimeout):
+            r.read()  # no per-call timeout: the knob governs
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        os.environ.pop("RAY_TPU_channel_default_timeout_s", None)
+
+
+def test_orphan_shm_sweeper(tmp_path):
+    """Directories whose registered owner PIDs are ALL dead are
+    reclaimed; live or unregistered dirs are never touched."""
+    import os
+
+    from ray_tpu.experimental.channel import sweep_orphan_ring_dirs
+
+    base = str(tmp_path)
+    dead = os.path.join(base, "ray_tpu_dag_dead")
+    os.makedirs(dead)
+    with open(os.path.join(dead, "c1"), "wb") as f:
+        f.write(b"\x00" * 256)
+    with open(os.path.join(dead, "c2"), "wb") as f:
+        f.write(b"\x00" * 256)
+    with open(os.path.join(dead, ".pids"), "w") as f:
+        f.write("4194300\n4194301\n")  # near pid_max: dead
+    live = os.path.join(base, "ray_tpu_serve_live")
+    os.makedirs(live)
+    with open(os.path.join(live, "req"), "wb") as f:
+        f.write(b"\x00" * 256)
+    with open(os.path.join(live, ".pids"), "w") as f:
+        f.write(f"{os.getpid()}\n")
+    unregistered = os.path.join(base, "ray_tpu_pp_new")
+    os.makedirs(unregistered)
+    assert sweep_orphan_ring_dirs(base=base, grace_s=0.0) == 2
+    assert not os.path.exists(dead)
+    assert os.path.exists(live) and os.path.exists(unregistered)
+    # grace window: a fresh dir with dead pids is left alone
+    fresh = os.path.join(base, "ray_tpu_rllib_fresh")
+    os.makedirs(fresh)
+    with open(os.path.join(fresh, ".pids"), "w") as f:
+        f.write("4194300\n")
+    assert sweep_orphan_ring_dirs(base=base, grace_s=3600.0) == 0
+    assert os.path.exists(fresh)
+
+
+def test_fanout_dead_reader_evicted_unblocks_writer(tmp_path):
+    """A SIGKILLed fan-out reader (dead registered PID, stale cursor)
+    no longer wedges the writer: its cursor is evicted (metric-counted)
+    and the broadcast proceeds for the survivors.  The evicted slot
+    fails typed if it ever reads again."""
+    import struct as pystruct
+
+    from ray_tpu.experimental.channel import (
+        ChannelClosed as CC,
+        FanoutChannel,
+        FanoutReader,
+    )
+
+    p = str(tmp_path / "fev")
+    ch = FanoutChannel(p, 2, max_size=1 << 13, create=True)
+    r0, r1 = FanoutReader(p, 0), FanoutReader(p, 1)
+    ch.write(b"seed")
+    assert r0.read(timeout=5) == b"seed"
+    assert r1.read(timeout=5) == b"seed"
+    # model r1's death: its registered pid is replaced by a dead one
+    pystruct.Struct("<Q").pack_into(ch._mm, ch._pid_off(1), 4194300)
+    payload = b"x" * 3000
+    for _ in range(10):  # would wedge forever bounded by r1's cursor
+        ch.write(payload, timeout=5)
+        assert r0.read(timeout=5) == payload
+    assert ch.stats["evictions"] == 1
+    with pytest.raises(CC, match="evicted"):
+        r1.read(timeout=1)
+    # all readers dead -> typed close, not a silent write into the void
+    pystruct.Struct("<Q").pack_into(ch._mm, ch._pid_off(0), 4194301)
+    with pytest.raises(CC):
+        for _ in range(20):
+            ch.write(payload, timeout=5)
 
 
 def test_socket_poison_close_vs_flow_control(tmp_path):
@@ -639,3 +932,81 @@ def test_fanout_capacity_and_index_validation(tmp_path):
     with pytest.raises(ValueError, match="created for"):
         FanoutChannel(p, 3)
     ch.close()
+
+
+def test_wire_fuzz_malformed_input_is_typed_never_garbage():
+    """Seeded fuzz over every wire type code: truncated and bit-flipped
+    encodings fed to ``wire.decode`` either raise the ONE typed
+    ``WireFormatError`` or decode cleanly — never a raw struct/index/
+    unicode error, never a hang (every decode loop is bounded by a
+    length field that is bounds-checked before use).  Value-level
+    integrity of flipped payload bytes is the channel CRC trailer's
+    contract, tested above; this pins the decoder itself."""
+    import random
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu._private import wire
+
+    exemplars = [  # at least one value per type code, PICKLE included
+        None, True, False,                      # NONE / TRUE / FALSE
+        5, -7, 2**100, -(2**90),                # I64 / BIGINT
+        1.5,                                    # F64
+        b"xyz-payload", "héllo wire",      # BYTES / STR
+        (1, "a", 2.5, None), [1, b"b", (2, 3)], # TUPLE / LIST
+        {"k": 1, 2: "v", "n": {"d": [1.0]}},    # DICT
+        np.arange(6, dtype=np.float32).reshape(2, 3),   # NDARRAY
+        np.array(7, dtype=np.int8),             # NDARRAY zero-dim
+        set([1, 2, 3]),                         # PICKLE fallback
+    ]
+    rng = random.Random(0xC0FFEE)
+    t0 = _time.monotonic()
+
+    def check(buf):
+        b = bytes(buf)
+        try:
+            _, out = wire.decode(memoryview(b))
+            return "ok", out
+        except wire.WireFormatError:
+            return "typed", None
+        except (ImportError, AttributeError, NameError):
+            # PICKLE-path class resolution is app-level BY CONTRACT
+            # (wire.decode lets it propagate so an unimportable class
+            # can't masquerade as frame corruption) — permitted only
+            # for pickle-framed buffers
+            assert len(b) > 1 and b[1] == wire.PICKLE, b[:4]
+            return "app", None
+        # anything else propagates and fails the test
+
+    for v in exemplars:
+        enc = wire.encode(v, tag=1)
+        # Every strict truncation of a fast-path encoding starves a
+        # bounds-checked length field -> typed error.  The PICKLE
+        # fallback may tolerate losing its unused trailing footer (past
+        # the STOP opcode) — but then the value must be EXACTLY right.
+        lengths = range(len(enc)) if len(enc) <= 64 else sorted(
+            rng.sample(range(len(enc)), 64)
+        )
+        for n in lengths:
+            verdict, out = check(enc[:n])
+            if enc[1] == wire.PICKLE:
+                if verdict == "ok":
+                    assert out == v, (v, n, out)  # only the footer was cut
+            else:
+                assert verdict == "typed", (v, n, out)
+        # seeded single-bit flips anywhere in the buffer
+        for _ in range(150):
+            b = bytearray(enc)
+            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            check(b)
+        # multi-bit shotgun: up to 8 flips per trial
+        for _ in range(50):
+            b = bytearray(enc)
+            for _ in range(rng.randint(2, 8)):
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            check(b)
+    # pure-noise buffers (random type codes, random lengths)
+    for _ in range(300):
+        check(bytes(rng.randrange(256) for _ in range(rng.randint(0, 80))))
+    assert _time.monotonic() - t0 < 60.0  # bounded: no decode may hang

@@ -242,6 +242,7 @@ def test_elastic_shrink_on_drain(elastic_cluster, tmp_path):
 # ==========================================================================
 
 
+@pytest.mark.slow  # ~36 s preempt/shrink/grow acceptance: runs under `-m chaos`
 @pytest.mark.chaos
 def test_elastic_acceptance_preempt_shrink_grow(elastic_cluster, tmp_path):
     """The acceptance drill: num_workers=4, min_workers=2; a seeded

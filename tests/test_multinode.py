@@ -200,6 +200,7 @@ def test_lineage_reconstruction_error_path(cluster):
     assert ray_tpu.get(consume.remote(ref), timeout=120) == 3.0 * 150_000
 
 
+@pytest.mark.slow  # ~17 s 1 GiB cross-node transfer: tier-2
 def test_chunked_cross_node_transfer_1gib(cluster):
     """A >1GiB object crosses nodes in bounded-parallel 4MB chunks — no
     single whole-object frame, no event-loop stall (reference:

@@ -182,6 +182,8 @@ def test_profile_ships_record_to_gcs_table(cluster):
     ray_tpu.get(ref, timeout=60)
 
 
+@pytest.mark.slow  # ~38 s kill drill: runs under `-m chaos`
+@pytest.mark.chaos
 def test_profiled_worker_dies_mid_capture_partial_no_leak(cluster):
     """SIGKILL the profiled worker mid-capture: the orchestration
     returns a partial result with an errors entry (no exception), and
@@ -609,6 +611,7 @@ def test_bench_gate_checked_in_lineage_warn_only():
 # ----------------------------------------------------------------------
 # chaos drill: capture survives its raylet dying
 # ----------------------------------------------------------------------
+@pytest.mark.slow  # ~39 s raylet-kill drill: runs under `-m chaos`
 @pytest.mark.chaos
 def test_profile_worker_through_raylet_kill():
     """SIGKILL the raylet of the node hosting the profiled actor while

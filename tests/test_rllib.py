@@ -550,6 +550,7 @@ def test_dqn_learns_cartpole(ray_cluster):
     algo.cleanup()
 
 
+@pytest.mark.slow  # ~39 s learning test: tier-2
 def test_dreamerv3_learns_cartpole_from_imagination(ray_cluster):
     """DreamerV3 (reward-gated): the world model's imagination training
     must lift greedy eval clearly above both random (~20) and

@@ -618,6 +618,7 @@ def test_llm_http_token_streaming_and_disconnect(serve_cluster):
     serve.delete("llm_http")
 
 
+@pytest.mark.slow  # ~17 s replica-kill drill: runs under `-m chaos`
 @pytest.mark.chaos
 def test_chaos_replica_kill_mid_stream(serve_cluster):
     """Kill one replica mid-load: its streams fail, streams on the

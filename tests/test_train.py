@@ -167,6 +167,8 @@ def _kill_rank1_once_loop(config):
     train.report({"ok": 1, "procs": jax.process_count()})
 
 
+@pytest.mark.slow  # ~104 s whole-mesh restart drill: runs under `-m chaos`
+@pytest.mark.chaos
 def test_killed_worker_whole_mesh_restart(ray_cluster, tmp_path):
     """Recovery drill (ISSUE 1): a killed training worker triggers a
     clean WHOLE-mesh restart — XLA's world is static, so the dead rank
