@@ -360,6 +360,79 @@ def bench_compiled_socket_roundtrip(n=1000) -> dict:
         c.shutdown()
 
 
+def _make_ckpt_src(td: str, n_files: int = 8, file_kb: int = 256) -> str:
+    import os
+
+    src = os.path.join(td, "src")
+    os.makedirs(src, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n_files):
+        with open(os.path.join(src, f"shard_{i}.bin"), "wb") as f:
+            f.write(rng.integers(0, 255, file_kb << 10, dtype=np.uint8).tobytes())
+    return src
+
+
+def bench_checkpoint_stall_sync_ms(repeat=5) -> float:
+    """Caller-visible stall of one SYNCHRONOUS checkpoint report: the
+    full snapshot-commit (per-file tmp+fsync+rename + CRC32 + manifest
+    os.replace) of a 2 MiB / 8-shard checkpoint, median of ``repeat``."""
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu.train import checkpoint_plane as cp
+
+    td = tempfile.mkdtemp(prefix="bench_ckpt_sync_")
+    try:
+        src = _make_ckpt_src(td)
+        times = []
+        for i in range(repeat + 1):
+            dest = os.path.join(td, f"checkpoint_{i:06d}")
+            t0 = time.perf_counter()
+            cp.persist_dir(src, dest, mode="sync")
+            t = (time.perf_counter() - t0) * 1e3
+            if i:  # first is warmup (page cache, dir creation)
+                times.append(t)
+        times.sort()
+        return times[len(times) // 2]
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def bench_checkpoint_stall_async_ms(repeat=5) -> float:
+    """Caller-visible stall of one ASYNC checkpoint report in the
+    steady state the async writer targets (compute time covers the
+    write): submit() hands the same snapshot-commit to the background
+    writer and returns after enqueue; the previous write drains during
+    the between-reports compute window (modeled by wait() OUTSIDE the
+    timed region).  The acceptance gap vs the sync number is the train-
+    step stall the async writer buys back."""
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu.train import checkpoint_plane as cp
+
+    td = tempfile.mkdtemp(prefix="bench_ckpt_async_")
+    writer = cp.AsyncCheckpointWriter(name="bench-ckpt-writer")
+    try:
+        src = _make_ckpt_src(td)
+        times = []
+        for i in range(repeat + 1):
+            dest = os.path.join(td, f"checkpoint_{i:06d}")
+            t0 = time.perf_counter()
+            writer.submit(lambda d=dest: cp.persist_dir(src, d, mode="async"))
+            t = (time.perf_counter() - t0) * 1e3
+            writer.wait()  # the "compute" window: drain outside the stall
+            if i:
+                times.append(t)
+        times.sort()
+        return times[len(times) // 2]
+    finally:
+        writer.close()
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def bench_wait_1k() -> float:
     refs = [nullary.remote() for _ in range(1000)]
     ray_tpu.get(refs)  # all complete
@@ -394,6 +467,12 @@ BENCHES = [
     # write; vs_single stamped against the depth-matched single path.
     ("compiled_calls_per_s_single_depth64", bench_compiled_single_depth_k, "calls/s", None),
     ("compiled_calls_per_s_execute_many_k64", bench_execute_many, "calls/s", None),
+    # Durable checkpoint plane (ISSUE 16): the train-step stall of one
+    # checkpoint report, sync vs the bounded async writer (the async
+    # number must sit measurably below the sync one — the stall the
+    # background writer buys back; docs/failure_semantics.md).
+    ("checkpoint_stall_ms_sync", bench_checkpoint_stall_sync_ms, "ms", None),
+    ("checkpoint_stall_ms_async", bench_checkpoint_stall_async_ms, "ms", None),
 ]
 
 

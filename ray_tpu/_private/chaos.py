@@ -66,12 +66,22 @@ _ACTIONS = ("drop_req", "drop_rep", "delay_req", "delay_rep", "dup_req", "kill",
             # channel-level dataplane faults (pattern "chan:<path-glob>",
             # consulted in the write paths of experimental/channel.py)
             "drop_frame", "delay_frame", "corrupt_frame", "torn_write",
-            "close")
+            "close",
+            # checkpoint-write fault (pattern "ckpt:<phase-glob>",
+            # consulted in train/checkpoint_plane.py; kill/torn_write
+            # are shared with the families above)
+            "bit_flip")
 
 # The dataplane subset of _ACTIONS: rules carrying one of these only
 # ever match channel writes (decide() skips them and they skip RPCs).
 _CHANNEL_ACTIONS = ("drop_frame", "delay_frame", "corrupt_frame",
                     "torn_write", "close")
+
+# The checkpoint-plane subset: matched only by decide_ckpt() against
+# "ckpt:<phase-glob>" patterns (phases: shard, precommit, manifest).
+# kill = SIGKILL mid-phase; torn_write = truncated bytes published under
+# the final name; bit_flip = one byte of a committed shard flipped.
+_CKPT_ACTIONS = ("kill", "torn_write", "bit_flip")
 
 # Bound on the in-memory schedule log; fired entries past this are
 # counted but not stored.
@@ -115,6 +125,27 @@ class ChannelDecision(NamedTuple):
 
 
 _CHAN_CLEAN = ChannelDecision(False, 0.0, False, False, False)
+
+
+class CkptDecision(NamedTuple):
+    """Fault verdict for one checkpoint-write phase (consulted by
+    train/checkpoint_plane.py at phases ``shard``/``precommit``/
+    ``manifest``).  ``kill`` dies with os._exit mid-phase (the SIGKILL
+    model — no unwind, no atexit); ``torn`` publishes truncated bytes
+    under the final name (the storage-tear model the manifest CRC must
+    catch at restore); ``bit_flip`` flips one byte of an
+    already-committed shard (the bit-rot model)."""
+
+    kill: bool
+    torn: bool
+    bit_flip: bool
+
+    @property
+    def clean(self) -> bool:
+        return not (self.kill or self.torn or self.bit_flip)
+
+
+_CKPT_CLEAN = CkptDecision(False, False, False)
 
 
 class _Rule:
@@ -202,6 +233,8 @@ class ChaosPlane:
         # RPC-only drill must not make every dataplane frame write take
         # the plane lock and scan the rule list just to skip it.
         self.has_channel_rules = False
+        # Same fast-path flag for the checkpoint plane's ckpt:* family.
+        self.has_ckpt_rules = False
 
     # ------------------------------------------------------------------
     def _ensure(self):
@@ -252,6 +285,10 @@ class ChaosPlane:
             self._active = bool(rules)
             self.has_channel_rules = any(
                 r.action in _CHANNEL_ACTIONS for r in rules
+            )
+            self.has_ckpt_rules = any(
+                r.pattern.startswith("ckpt:") and r.action in _CKPT_ACTIONS
+                for r in rules
             )
             self.schedule = []
             self.schedule_len = 0
@@ -354,6 +391,41 @@ class ChaosPlane:
         if not fired_rules:
             return _CHAN_CLEAN
         return ChannelDecision(drop, delay_s, corrupt, torn, close)
+
+    def decide_ckpt(self, phase: str) -> CkptDecision:
+        """Fault decision for one checkpoint-write phase (``shard``,
+        ``precommit``, ``manifest``).  Rules match with pattern
+        ``ckpt:<phase-glob>`` and one of the ``_CKPT_ACTIONS``; verdicts
+        are deterministic in each rule's match ordinal, so a seeded
+        kill-at-every-phase drill matrix replays exactly."""
+        if not self.active or not self.has_ckpt_rules:
+            return _CKPT_CLEAN
+        kill = torn = bit_flip = False
+        fired_rules = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.action not in _CKPT_ACTIONS:
+                    continue
+                if not rule.pattern.startswith("ckpt:"):
+                    continue
+                if not fnmatch.fnmatchcase(phase, rule.pattern[5:]):
+                    continue
+                fired = rule.evaluate()
+                self._log(rule, "fire" if fired else "skip")
+                if not fired:
+                    continue
+                fired_rules.append(rule)
+                if rule.action == "kill":
+                    kill = True
+                elif rule.action == "torn_write":
+                    torn = True
+                else:  # bit_flip
+                    bit_flip = True
+        for rule in fired_rules:  # outside the lock: metric writes lock too
+            _count_injection(rule)
+        if not fired_rules:
+            return _CKPT_CLEAN
+        return CkptDecision(kill, torn, bit_flip)
 
     # ------------------------------------------------------------------
     def maybe_kill(self, point: str) -> bool:

@@ -265,6 +265,19 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # the ping at its next report boundary — a too-small budget
     # misclassifies slow-but-alive ranks as casualties.
     "elastic_ping_timeout_s": 60.0,
+    # --- durable checkpoint plane (train/checkpoint_plane.py) ---
+    # Persist session.report(checkpoint=...) on the bounded background
+    # writer (the train step pays host-snapshot time only; the next
+    # report back-pressures while a write is in flight).  Off = every
+    # report stalls for the full serialize+CRC+write+commit.
+    "train_checkpoint_async": True,
+    # Retention: keep the newest K COMMITTED checkpoints (the restore
+    # fallback chain) plus pinned ones; older ones are reclaimed.
+    "train_checkpoint_keep": 3,
+    # Uncommitted checkpoint directories (no manifest — a writer died
+    # mid-save) are reclaimed only once older than this, so GC never
+    # races a live in-flight writer.
+    "train_checkpoint_gc_grace_s": 300.0,
     # --- multi-tenant job plane (tenants.py; quotas + DRF fair share +
     # priority preemption) ---
     # Enforce registered per-tenant quotas at admission (GCS actors/PGs)

@@ -379,6 +379,36 @@ class _Metrics:
             "feed, by action (publish, clear)",
             tag_keys=("action",),
         )
+        # --- durable checkpoint plane (train/checkpoint_plane.py) ---
+        self.checkpoint_write = m.Histogram(
+            "checkpoint_write_seconds",
+            "serialize+CRC+write+commit seconds for one checkpoint "
+            "persist (mode = sync: the train step stalled for it; "
+            "async: a background writer paid it off the train loop)",
+            boundaries=[0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                        30.0, 60.0, 120.0],
+            tag_keys=("mode",),
+        )
+        self.checkpoint_commit = m.Counter(
+            "checkpoint_commit_total",
+            "checkpoint manifest commit attempts (result = committed, "
+            "failed); only a committed manifest makes a checkpoint "
+            "adoptable — anything short of it is GC-eligible debris",
+            tag_keys=("result",),
+        )
+        self.checkpoint_restore_fallbacks = m.Counter(
+            "checkpoint_restore_fallbacks_total",
+            "restore candidates rejected by manifest/CRC32 verification "
+            "(CheckpointCorruptionError) before a verified checkpoint "
+            "loaded — nonzero outside chaos drills means storage "
+            "corruption or a writer SIGKILLed mid-commit",
+        )
+        self.checkpoint_gc_reclaimed = m.Counter(
+            "checkpoint_gc_reclaimed_total",
+            "checkpoint directories reclaimed by retention GC: committed "
+            "ones past the keep-K window plus uncommitted debris past "
+            "the grace period (the mid-write-SIGKILL residue backstop)",
+        )
 
 
 def _metrics() -> _Metrics:
@@ -870,3 +900,39 @@ def count_grow_hint(action: str) -> None:
         _grow_hint_bound, action, "grow_hints", {"action": action}
     )
     b.inc(1.0)
+
+
+_ckpt_write_bound: dict = {}
+_ckpt_commit_bound: dict = {}
+
+
+def observe_checkpoint_write(mode: str, seconds: float) -> None:
+    """One checkpoint persist (mode = sync, async) — serialize + CRC +
+    write + manifest commit, end to end."""
+    if not enabled():
+        return
+    b = _ckpt_write_bound.get(mode) or _bind(
+        _ckpt_write_bound, mode, "checkpoint_write", {"mode": mode}
+    )
+    b.observe(max(0.0, seconds))
+
+
+def count_checkpoint_commit(result: str) -> None:
+    if not enabled():
+        return
+    b = _ckpt_commit_bound.get(result) or _bind(
+        _ckpt_commit_bound, result, "checkpoint_commit", {"result": result}
+    )
+    b.inc(1.0)
+
+
+def count_checkpoint_restore_fallback(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _metrics().checkpoint_restore_fallbacks.inc(float(n))
+
+
+def count_checkpoint_gc_reclaimed(n: int) -> None:
+    if not enabled() or n <= 0:
+        return
+    _metrics().checkpoint_gc_reclaimed.inc(float(n))

@@ -246,6 +246,33 @@ def _register_shm_pid(path: str) -> None:
         pass
 
 
+_SMALL_HOST = (os.cpu_count() or 1) <= 2
+
+
+def _poll_wait(spins: int) -> None:
+    """One blocked-poll backoff step (spins counts from 0 per block).
+
+    Big hosts: sched_yield for ~4k spins, then ramp sleeps 20us -> 1ms
+    so a long-idle resident loop doesn't pin a core (the reference's
+    channels busy-wait the same way).
+
+    Small (1-2 core) hosts: the peer needs THIS core, and sched_yield
+    may return without descheduling the caller (EEVDF keeps an eligible
+    task running), so a yield phase can starve the peer for the whole
+    quantum.  Go straight to tiny timer sleeps — a sleep always cedes
+    the core, waking in ~0.1ms — then ramp to 1ms the same way.
+    """
+    if _SMALL_HOST:
+        if spins < 256:
+            time.sleep(0.000001)
+        else:
+            time.sleep(min(0.001, 0.00002 * (spins - 255)))
+    elif spins < 4000:
+        time.sleep(0)
+    else:
+        time.sleep(min(0.001, 0.00002 * (spins - 3999)))
+
+
 def _pid_alive(pid: int) -> bool:
     if pid <= 0:
         return False
@@ -316,15 +343,10 @@ class Channel:
 
     def _backoff(self, spins: int) -> None:
         """Latency-first wait: (multicore only) hot-spin ~0.1ms, then
-        sched_yield, then ramp sleeps toward 1ms so a long-idle resident
-        loop doesn't pin a core (the reference's channels busy-wait the
-        same way)."""
+        the host-size-aware poll wait (see _poll_wait)."""
         if spins < self._HOT_SPINS:
             return
-        if spins < self._HOT_SPINS + 4000:
-            time.sleep(0)
-            return
-        time.sleep(min(0.001, 0.00002 * (spins - self._HOT_SPINS - 3999)))
+        _poll_wait(spins - self._HOT_SPINS)
 
     _TELE_FLUSH_OPS = 512
 
@@ -1622,10 +1644,7 @@ class FanoutChannel:
             # the whole timeout (or forever, with timeout=None).
             if spins % 512 == 0 and self._evict_dead_readers():
                 continue
-            if spins < 4000:
-                time.sleep(0)
-            else:
-                time.sleep(min(0.001, 0.00002 * (spins - 3999)))
+            _poll_wait(spins - 1)
             if deadline is not None and time.monotonic() > deadline:
                 self._evict_dead_readers()
                 self.stats["write_blocked_s"] += time.monotonic() - t_block
@@ -1781,10 +1800,7 @@ class FanoutReader:
                 timeout = _resolve_timeout(timeout)
                 deadline = None if timeout is None else t_block + timeout
             spins += 1
-            if spins < 4000:
-                time.sleep(0)
-            else:
-                time.sleep(min(0.001, 0.00002 * (spins - 3999)))
+            _poll_wait(spins - 1)
             if deadline is not None and time.monotonic() > deadline:
                 self.stats["read_blocked_s"] += time.monotonic() - t_block
                 raise ChannelTimeout(
@@ -2009,10 +2025,7 @@ def write_value_fanout(
             timeout = _resolve_timeout(timeout)
             deadline = None if timeout is None else time.monotonic() + timeout
         spins += 1
-        if spins > 1000:
-            time.sleep(min(0.001, 0.00002 * (spins - 1000)))
-        else:
-            time.sleep(0)
+        _poll_wait(spins - 1)
         if deadline is not None and time.monotonic() > deadline:
             raise ChannelTimeout(
                 f"{len(pending)} fan-out peers did not consume within {timeout}s"

@@ -48,6 +48,7 @@ from ray_tpu.experimental.channel import (
     ChannelClosed,
     ChannelCorruptionError,
     ChannelTimeout,
+    FanoutChannel,
     SocketListener,
     dial,
     node_hosts,
@@ -108,6 +109,10 @@ class _RunnerStream:
         self.alive = False
         self.last_gen = 0  # newest generation written to this runner
         self.ring_dir: Optional[str] = None
+        # Slot in the shared same-node weight fan-out ring (None =
+        # dedicated weight channel).  Replacements always get dedicated
+        # rings: an evicted fan-out slot is tombstoned permanently.
+        self.fanout_index: Optional[int] = None
 
 
 class TrajectoryPlane:
@@ -176,6 +181,12 @@ class TrajectoryPlane:
         self._weight_capacity = 0
         self._started = False
         self._closing = False
+        # Same-node weight broadcast fan-out (ROADMAP item 1): N
+        # same-node anakin runners share ONE 1-to-N shm ring — one
+        # snapshot write per broadcast instead of N ring copies.
+        self._fanout: Optional[FanoutChannel] = None
+        self._fanout_path: Optional[str] = None
+        self._fanout_dir: Optional[str] = None
         self._intake: Optional[threading.Thread] = None
         self._episode_returns: List[float] = []
         self._episode_lens: List[int] = []
@@ -212,21 +223,84 @@ class TrajectoryPlane:
                 self.inference_handle.set_weights.remote(weights, generation),
                 timeout=60,
             )
+        # Create every actor first so placement is known before wiring:
+        # same-node anakin runners (2+) share ONE weight fan-out ring.
         for rs in self.streams:
-            self._spawn(rs, weights, generation)
+            rs.actor = self._remote_cls.remote(
+                worker_index=rs.index + 1, **self._make_runner_args
+            )
+        nodes = {rs.index: self._resolve_node(rs) for rs in self.streams}
+        if self.policy_mode == "anakin":
+            my_node = self._my_node()
+            cohort = [rs for rs in self.streams if nodes[rs.index] == my_node]
+            if len(cohort) >= 2:
+                self._create_fanout(cohort)
+        for rs in self.streams:
+            self._wire(rs, nodes[rs.index], weights, generation)
+        if self._fanout is not None:
+            # One ring write seeds the whole cohort (every reader was
+            # registered by its stream_attach above, so nothing races).
+            self._fanout.write_value((generation, weights))
         self._intake = threading.Thread(
             target=self._intake_loop, daemon=True, name="rllib-traj-intake"
         )
         self._intake.start()
         self._started = True
 
+    def _create_fanout(self, cohort: List[_RunnerStream]) -> None:
+        d = os.path.join(
+            ring_base_dir(), f"ray_tpu_rllib_fo_{uuid.uuid4().hex[:12]}"
+        )
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "weights_fanout")
+        self._fanout = FanoutChannel(
+            path, n_readers=len(cohort),
+            max_size=self._weight_capacity, create=True,
+        )
+        self._fanout_path = path
+        self._fanout_dir = d
+        for i, rs in enumerate(cohort):
+            rs.fanout_index = i
+
+    def _drop_fanout(self) -> None:
+        """Retire the shared fan-out ring (every reader evicted): the
+        cohort's survivors respawn on dedicated rings via maintain()."""
+        f, self._fanout = self._fanout, None
+        self._fanout_path = None
+        for rs in self.streams:
+            if rs.fanout_index is not None:
+                rs.fanout_index = None
+                if rs.weights is f:
+                    rs.weights = None
+        if f is not None:
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                f.unlink()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._fanout_dir:
+            import shutil
+
+            shutil.rmtree(self._fanout_dir, ignore_errors=True)
+            self._fanout_dir = None
+
     def _spawn(self, rs: _RunnerStream, weights, generation: int) -> None:
         """(Re)create one runner on slot ``rs`` and wire its edges; the
-        runner joins at the CURRENT weight generation."""
+        runner joins at the CURRENT weight generation.  Replacements
+        always get dedicated channels — a fan-out slot tombstones on
+        eviction, so a respawned runner can never rejoin one."""
         rs.actor = self._remote_cls.remote(
             worker_index=rs.index + 1, **self._make_runner_args
         )
-        self._attach(rs)
+        rs.fanout_index = None
+        self._wire(rs, self._resolve_node(rs), weights, generation)
+
+    def _wire(self, rs: _RunnerStream, runner_node: str, weights,
+              generation: int) -> None:
+        self._attach(rs, runner_node)
         # run_stream FIRST: it performs the weight-listener accept on
         # the cross-node path and blocks in _drain_weights for the first
         # snapshot — writing a large snapshot before any reader exists
@@ -234,21 +308,23 @@ class TrajectoryPlane:
         rs.stream_ref = rs.actor.run_stream.remote(
             self.fragment_length, self.explore
         )
-        if self.policy_mode == "anakin":
+        if self.policy_mode == "anakin" and rs.fanout_index is None:
             rs.weights.write_value((generation, weights))
         rs.last_gen = generation
         rs.alive = True
 
-    def _attach(self, rs: _RunnerStream) -> None:
-        """Build the channel edges to one runner.  Placement picks the
-        transport exactly like compiled DAGs / the serve dataplane:
-        same node → shm rings, cross node → persistent sockets."""
+    def _my_node(self) -> str:
+        from ray_tpu._private.worker import get_global_worker
+
+        worker = get_global_worker()
+        return worker.node_id.hex() if worker.node_id is not None else ""
+
+    def _resolve_node(self, rs: _RunnerStream) -> str:
         import ray_tpu
         from ray_tpu._private.ids import ActorID, NodeID
         from ray_tpu._private.worker import get_global_worker
 
         worker = get_global_worker()
-        my_node = worker.node_id.hex() if worker.node_id is not None else ""
         runner_node = None
         deadline = time.monotonic() + 30.0
         while runner_node is None and time.monotonic() < deadline:
@@ -260,6 +336,19 @@ class TrajectoryPlane:
                 ray_tpu.get(rs.actor.ping.remote(), timeout=30)
         if runner_node is None:
             raise RuntimeError(f"env runner {rs.index} has no node")
+        return runner_node
+
+    def _attach(self, rs: _RunnerStream, runner_node: str) -> None:
+        """Build the channel edges to one runner.  Placement picks the
+        transport exactly like compiled DAGs / the serve dataplane:
+        same node → shm rings, cross node → persistent sockets.  A
+        fan-out cohort member reads weights from the SHARED ring (its
+        reader slot) instead of a dedicated one."""
+        import ray_tpu
+        from ray_tpu._private.worker import get_global_worker
+
+        worker = get_global_worker()
+        my_node = worker.node_id.hex() if worker.node_id is not None else ""
 
         want_weights = self.policy_mode == "anakin"
         if runner_node == my_node:
@@ -267,18 +356,24 @@ class TrajectoryPlane:
             os.makedirs(d, exist_ok=True)
             traj_path = os.path.join(d, "traj")
             w_path = os.path.join(d, "weights")
+            use_fanout = rs.fanout_index is not None and self._fanout is not None
             Channel.create_file(traj_path, self._traj_capacity)
-            if want_weights:
+            if want_weights and not use_fanout:
                 Channel.create_file(w_path, self._weight_capacity)
             spec = {
                 "kind": "ring",
                 "traj_path": traj_path,
-                "w_path": w_path if want_weights else None,
+                "w_path": w_path if want_weights and not use_fanout else None,
+                "w_fanout_path": self._fanout_path if use_fanout else None,
+                "w_fanout_index": rs.fanout_index if use_fanout else None,
                 "inference": self.inference_handle,
             }
             ray_tpu.get(rs.actor.stream_attach.remote(spec), timeout=30)
             rs.traj = Channel(traj_path)
-            rs.weights = Channel(w_path) if want_weights else None
+            if use_fanout:
+                rs.weights = self._fanout  # shared write endpoint
+            else:
+                rs.weights = Channel(w_path) if want_weights else None
             rs.ring_dir = d
             # tmpfs must not outlive an abandoned/killed learner (mirror
             # the serve-attach and compiled-DAG ring-dir finalizers)
@@ -406,8 +501,35 @@ class TrajectoryPlane:
             for rs in self.streams:
                 rs.last_gen = generation
             return
+        if self._fanout is not None:
+            cohort = [
+                rs for rs in self.streams
+                if rs.fanout_index is not None and rs.alive
+            ]
+            if cohort:
+                try:
+                    # ONE snapshot write covers the whole cohort.  The
+                    # short timeout emulates try-write: a parked reader
+                    # just means the next broadcast carries a later
+                    # generation (and a blocked write probes for dead
+                    # readers, so a SIGKILLed one gets evicted rather
+                    # than wedging the learner).
+                    self._fanout.write_value(
+                        (generation, weights), timeout=0.05
+                    )
+                    for rs in cohort:
+                        rs.last_gen = generation
+                except ChannelTimeout:
+                    pass
+                except (ChannelClosed, Exception):  # noqa: BLE001
+                    # every reader evicted: the broadcast has no
+                    # audience — retire the ring; maintain() respawns
+                    # the cohort on dedicated channels
+                    for rs in cohort:
+                        rs.alive = False
+                    self._drop_fanout()
         for rs in self.streams:
-            if not rs.alive or rs.weights is None:
+            if not rs.alive or rs.weights is None or rs.fanout_index is not None:
                 continue
             try:
                 if rs.weights.try_write_value((generation, weights)):
@@ -423,11 +545,23 @@ class TrajectoryPlane:
             if rs.index + 1 == worker_index and rs.alive and rs.weights is not None:
                 try:
                     rs.weights.write_value((generation, weights), timeout=5.0)
-                    rs.last_gen = generation
+                    if rs.fanout_index is not None:
+                        # the shared ring delivered to the whole cohort
+                        for peer in self.streams:
+                            if peer.alive and peer.fanout_index is not None:
+                                peer.last_gen = generation
+                    else:
+                        rs.last_gen = generation
                 except ChannelTimeout:
                     pass  # runner parked mid-fragment; next broadcast covers it
                 except (ChannelClosed, Exception):  # noqa: BLE001
-                    rs.alive = False
+                    if rs.fanout_index is not None:
+                        for peer in self.streams:
+                            if peer.fanout_index is not None:
+                                peer.alive = False
+                        self._drop_fanout()
+                    else:
+                        rs.alive = False
 
     def maintain(self, weights_fn: Callable[[], Any], generation: int) -> int:
         """Detect dead runners (GCS actor state DEAD, or intake marked
@@ -480,11 +614,16 @@ class TrajectoryPlane:
         rs.alive = False
         for chan in (rs.traj, rs.weights):
             try:
-                if chan is not None:
+                # The shared fan-out ring outlives any one cohort
+                # member: the dead member's reader slot is evicted by
+                # the next blocked broadcast, the ring itself closes
+                # only in stop()/_drop_fanout().
+                if chan is not None and chan is not self._fanout:
                     chan.close()
             except Exception:  # noqa: BLE001
                 pass
         rs.traj = rs.weights = None
+        rs.fanout_index = None
         if rs.ring_dir:
             import shutil
 
@@ -526,6 +665,7 @@ class TrajectoryPlane:
         self._closing = True
         for rs in self.streams:
             self._close_stream(rs)
+        self._drop_fanout()
         if self.inference_handle is not None:
             try:
                 self._ray.kill(self.inference_handle)

@@ -208,13 +208,29 @@ class SingleAgentEnvRunner:
         Ring: both files already exist (driver created them).  Socket:
         this side dials the trajectory edge (driver listener pre-bound)
         and binds the weight listener the driver will dial."""
-        from ray_tpu.experimental.channel import Channel, SocketListener, dial
+        from ray_tpu.experimental.channel import (
+            Channel,
+            FanoutReader,
+            SocketListener,
+            dial,
+        )
 
         self._infer_handle = spec.get("inference")
         out: dict = {}
         if spec["kind"] == "ring":
             self._traj_chan = Channel(spec["traj_path"])
-            self._weight_chan = Channel(spec["w_path"]) if spec.get("w_path") else None
+            if spec.get("w_fanout_path"):
+                # Same-node cohort: this runner is reader slot
+                # ``w_fanout_index`` of the shared 1-to-N weight ring —
+                # the learner writes each snapshot once for the whole
+                # cohort.  Reader semantics (pending/read_value, CRC
+                # validation, ChannelClosed on eviction) match the
+                # dedicated ring, so _drain_weights is unchanged.
+                self._weight_chan = FanoutReader(
+                    spec["w_fanout_path"], int(spec["w_fanout_index"])
+                )
+            else:
+                self._weight_chan = Channel(spec["w_path"]) if spec.get("w_path") else None
         else:
             self._traj_chan = dial(tuple(spec["traj_addr"]), "write")
             self._weight_chan = None

@@ -6,12 +6,12 @@ from __future__ import annotations
 
 import os
 import queue
-import shutil
 import threading
 import time
 import traceback
 from typing import Any, Dict, Optional
 
+from ray_tpu.train import checkpoint_plane
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.context import _set_session
 
@@ -75,6 +75,10 @@ class _TrainSession:
         # Elastic plane: set when this session was superseded by a resize;
         # the old loop thread unwinds at its next report.
         self._stopped = threading.Event()
+        # Durable checkpoint plane: bounded background writer (one write
+        # in flight; the next report back-pressures).  Lazy — sessions
+        # that never checkpoint never spawn the thread.
+        self._ckpt_writer: Optional[checkpoint_plane.AsyncCheckpointWriter] = None
 
     def request_drain_checkpoint(self):
         """A drain notice covers this worker group: ask the user loop for
@@ -90,6 +94,15 @@ class _TrainSession:
         put() it is currently blocked in is released by draining the
         queue.  Idempotent."""
         self._stopped.set()
+        # Land any in-flight async checkpoint write before retiring: the
+        # resize may hand exactly that directory out as the resume
+        # checkpoint.  Errors are swallowed — restore verifies, and an
+        # uncommitted directory is never adopted.
+        if self._ckpt_writer is not None:
+            try:
+                self._ckpt_writer.close(timeout=30.0)
+            except Exception:
+                pass
         # Release a loop thread blocked in _queue.put (maxsize=1) waiting
         # for a driver poll that will never come.  Drain ONLY — refilling
         # the slot (e.g. with a sentinel) could win the race against the
@@ -177,8 +190,7 @@ class _TrainSession:
                 f"{prefix}{self._report_idx:06d}_rank{self.world_rank}",
             )
             if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-                os.makedirs(os.path.dirname(dest), exist_ok=True)
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                self._persist_checkpoint(checkpoint.path, dest)
             persisted = Checkpoint(dest)
         self._report_idx += 1
         self._queue.put(("report", dict(metrics), persisted))
@@ -188,6 +200,48 @@ class _TrainSession:
             raise SessionInvalidatedError(
                 "this training session was superseded by an elastic resize"
             )
+
+    def _persist_checkpoint(self, src: str, dest: str) -> None:
+        """Snapshot-commit ``src`` into the run's storage dir.  The user
+        loop already host-snapshotted into ``src`` (Checkpoint.from_*),
+        so the serialize+CRC+write+commit here is the part the async
+        writer takes off the train step.  A failed async write surfaces
+        as CheckpointWriteError on the NEXT report via submit(); drain /
+        preempt forces the synchronous path (flush + sync persist) so
+        the checkpoint is durable before the shrink."""
+        from ray_tpu._private.config import CONFIG
+
+        meta = {
+            "experiment": self.experiment_name,
+            "generation": self.generation,
+            "report_idx": self._report_idx,
+            "world_rank": self.world_rank,
+            "world_size": self.world_size,
+        }
+
+        def _persist(mode: str) -> None:
+            checkpoint_plane.persist_dir(src, dest, meta=meta, mode=mode)
+            # Retention: one sweeper per world (rank 0) is enough — all
+            # ranks share the storage dir and groups live/die together.
+            if self.world_rank == 0:
+                pinned = [dest]
+                if self.resume_checkpoint is not None:
+                    pinned.append(self.resume_checkpoint.path)
+                checkpoint_plane.gc_checkpoints(self.storage_dir, pinned=pinned)
+
+        use_async = bool(CONFIG.train_checkpoint_async) and not self._drain_requested.is_set()
+        if use_async:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = checkpoint_plane.AsyncCheckpointWriter(
+                    name=f"ckpt-writer-r{self.world_rank}"
+                )
+            # Back-pressures while the previous write is in flight and
+            # raises its failure (typed) instead of queueing over it.
+            self._ckpt_writer.submit(lambda: _persist("async"))
+        else:
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()
+            _persist("sync")
 
     def next_report(self, timeout: Optional[float] = None):
         """Blocking fetch of the next report; driver calls via actor rpc."""

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import inspect
 import logging
+import os
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
@@ -143,21 +144,36 @@ class BaseTrainer:
 
 
 def _latest_checkpoint(path: str) -> Optional[str]:
-    """Newest checkpoint dir under the experiment dir.  Elastic resizes
-    write generation-scoped names (checkpoint_gGGG_NNNNNN_rank0); newest
-    is by (generation, report index)."""
-    import os
-    import re
+    """Newest VERIFIED checkpoint dir under the experiment dir (elastic
+    resizes write generation-scoped names checkpoint_gGGG_NNNNNN_rank0;
+    newest is by (generation, report index)).  Goes through the
+    checkpoint plane's fallback-chain loader: a corrupt / partial /
+    uncommitted newest is skipped (counted) and the walk continues to
+    the last good one — garbage is never adopted."""
+    from ray_tpu.train import checkpoint_plane
 
-    best, best_key = None, (-1, -1)
-    for entry in os.listdir(path):
-        m = re.match(r"checkpoint_(?:g(\d+)_)?(\d+)_rank0$", entry)
-        if m:
-            key = (int(m.group(1) or 0), int(m.group(2)))
-            if key > best_key:
-                best_key = key
-                best = os.path.join(path, entry)
-    return best
+    return checkpoint_plane.resolve_restore(root=path, rank=0)
+
+
+def _verified_resume(ckpt: Optional[Checkpoint]) -> Optional[Checkpoint]:
+    """Resolve a resume checkpoint through the checkpoint plane before
+    handing it to a restart/shrink/grow: if it is uncommitted (an async
+    write still in flight or killed mid-save) or fails CRC validation,
+    walk back through the retained chain in the same storage dir to the
+    last good one.  Raises CheckpointCorruptionError only when NOTHING
+    in the chain verifies — a corrupted checkpoint is never adopted."""
+    if ckpt is None:
+        return None
+    from ray_tpu.train import checkpoint_plane
+
+    path = checkpoint_plane.resolve_restore(
+        preferred=ckpt.path, root=os.path.dirname(ckpt.path), rank=0
+    )
+    if path is None:
+        return None
+    if os.path.abspath(path) == os.path.abspath(ckpt.path):
+        return ckpt
+    return Checkpoint.from_directory(path)
 
 
 class DataParallelTrainer(BaseTrainer):
@@ -311,6 +327,10 @@ class DataParallelTrainer(BaseTrainer):
                         # nothing is charged to max_failures.  (A user
                         # exception raises TrainingWorkerError instead and
                         # is always charged.)
+                        # A dead rank may have left its async checkpoint
+                        # write mid-flight: resolve through the verified
+                        # fallback chain before anyone resumes from it.
+                        latest_checkpoint = _verified_resume(latest_checkpoint)
                         if elastic and executor.shrink("worker_death", latest_checkpoint):
                             continue
                         raise e
@@ -336,12 +356,14 @@ class DataParallelTrainer(BaseTrainer):
                         # not a failure — nothing is charged to
                         # max_failures, and no work is lost (survivors
                         # resume from this round's checkpoint).
+                        latest_checkpoint = _verified_resume(latest_checkpoint)
                         if elastic and executor.shrink("preempt", latest_checkpoint):
                             continue
                     if round_ckpt and executor.drain_imminent():
                         # A drain notice covers the group and a checkpoint
                         # landed after it (the report round is the
                         # barrier: every rank reached this step).
+                        latest_checkpoint = _verified_resume(latest_checkpoint)
                         if elastic and executor.shrink("drain", latest_checkpoint):
                             # Shrunk past the doomed ranks: survivors keep
                             # their actors and resume from the checkpoint.
@@ -359,6 +381,8 @@ class DataParallelTrainer(BaseTrainer):
                         # from the latest checkpoint, so only attempt it
                         # once one exists (never trade real progress for
                         # idle chips).
+                        if latest_checkpoint is not None:
+                            latest_checkpoint = _verified_resume(latest_checkpoint)
                         if latest_checkpoint is not None:
                             executor.try_grow(latest_checkpoint)
                 if proactive:
@@ -380,6 +404,9 @@ class DataParallelTrainer(BaseTrainer):
             except (TrainingWorkerError, ray_tpu.exceptions.RayActorError) as e:
                 last_error = e
                 executor.shutdown()
+                # The group died with a write possibly mid-flight: the
+                # restart must resume from a COMMITTED checkpoint.
+                latest_checkpoint = _verified_resume(latest_checkpoint)
                 attempts += 1
                 if attempts > max_failures:
                     raise TrainingFailedError(

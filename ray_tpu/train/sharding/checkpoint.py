@@ -80,7 +80,18 @@ def save_sharded(state: Any, path: str, mesh=None) -> None:
                     tuple(slice(None) for _ in shape), shape
                 )}
             )
-    np.savez(os.path.join(path, f"{_SHARD_PREFIX}{proc}.npz"), **arrays)
+    # Atomic shard publish (checkpoint_plane commit path): a SIGKILL
+    # mid-save leaves a .tmp orphan, never a plausible partial .npz
+    # under the final name next to an older meta.json.
+    import io
+
+    from ray_tpu.train import checkpoint_plane
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    checkpoint_plane.write_file_atomic(
+        path, f"{_SHARD_PREFIX}{proc}.npz", buf.getvalue()
+    )
     meta = {
         "leaves": paths,
         "shapes": [list(l.shape) for l in leaves],
@@ -91,13 +102,22 @@ def save_sharded(state: Any, path: str, mesh=None) -> None:
     # Process 0 writes the canonical meta; other processes merge their
     # entry lists in via per-process sidecars (no write contention).
     if proc == 0:
-        tmp = os.path.join(path, f".{META_NAME}.tmp")
-        with open(tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, os.path.join(path, META_NAME))
+        checkpoint_plane.write_file_atomic(
+            path, META_NAME, json.dumps(meta).encode()
+        )
+        # Single-process runtimes own the whole directory: commit the
+        # manifest (shard list + CRC32s) so restore can verify.  A
+        # multi-host save has no single committing writer — its caller
+        # (e.g. the pipeline plane / an external barrier) runs
+        # checkpoint_plane.commit_directory once every process returned.
+        if getattr(jax, "process_count", lambda: 1)() == 1:
+            checkpoint_plane.commit_directory(
+                path, meta={"mesh_shape": meta["mesh_shape"]}
+            )
     else:
-        with open(os.path.join(path, f"entries_p{proc}.json"), "w") as f:
-            json.dump(entries, f)
+        checkpoint_plane.write_file_atomic(
+            path, f"entries_p{proc}.json", json.dumps(entries).encode()
+        )
 
 
 def load_sharded(path: str, like: Any) -> Any:
@@ -107,6 +127,15 @@ def load_sharded(path: str, like: Any) -> Any:
     differ from ``like``'s — this IS the elastic re-shard path."""
     import jax
     import numpy as np
+
+    from ray_tpu.train import checkpoint_plane
+
+    # Committed checkpoints verify before a single byte is adopted: a
+    # bit-flipped or truncated shard raises CheckpointCorruptionError
+    # here instead of silently restoring wrong weights.  (Pre-plane
+    # checkpoints have no manifest and load as before.)
+    if os.path.exists(os.path.join(path, checkpoint_plane.MANIFEST_NAME)):
+        checkpoint_plane.verify_checkpoint(path)
 
     with open(os.path.join(path, META_NAME)) as f:
         meta = json.load(f)

@@ -78,6 +78,11 @@ class PipelineConfig:
     # Driver-side in-memory checkpoint cadence (steps); 0 = only the
     # initial state is restorable.
     checkpoint_every: int = 0
+    # Durable checkpoints (checkpoint_plane commit protocol): every
+    # in-memory checkpoint is ALSO snapshot-committed here, and a fresh
+    # plane (driver restart, not just stage restart) resumes from the
+    # newest verified one.  None = in-memory restart points only.
+    checkpoint_dir: Optional[str] = None
     # Whole-pipeline restarts allowed before a stage death propagates.
     max_restarts: int = 1
     seed: int = 0
@@ -590,7 +595,16 @@ class PipelinePlane:
         node), and launch the resident loops."""
         cfg = self.config
         S = cfg.stages
-        if state is None:
+        if (
+            state is None
+            and self._ckpt is None
+            and cfg.checkpoint_dir
+            and self._restore_durable_ckpt()
+        ):
+            # Driver restart: a verified durable checkpoint supersedes a
+            # fresh init (stage restarts pass state= and skip this).
+            _step, params_full, opt_states = self._ckpt
+        elif state is None:
             params_full = self.program.init_params()
             params_full = _host_tree(params_full)
             opt_states = None
@@ -896,7 +910,56 @@ class PipelinePlane:
         params_full = self.program.merge([p for p, _ in states])
         opt_states = [o for _, o in states]
         self._ckpt = (self.steps_done, params_full, opt_states)
+        if self.config.checkpoint_dir:
+            self._persist_ckpt()
         return self._ckpt
+
+    def _persist_ckpt(self) -> None:
+        """Snapshot-commit the in-memory restart point under
+        ``config.checkpoint_dir`` so a DRIVER restart (not just a stage
+        restart) resumes from it; keep-K retention via the plane's GC."""
+        import pickle
+
+        from ray_tpu.train import checkpoint_plane
+
+        step, params_full, opt_states = self._ckpt
+        dest = os.path.join(
+            self.config.checkpoint_dir, f"checkpoint_{step:06d}"
+        )
+        blob = pickle.dumps(
+            {"step": step, "params": params_full, "opt_states": opt_states},
+            protocol=5,
+        )
+        crc = checkpoint_plane.write_file_atomic(dest, "state.pkl", blob)
+        checkpoint_plane.commit_manifest(
+            dest,
+            {"state.pkl": {"crc": crc, "bytes": len(blob)}},
+            meta={"step": step, "stages": self.config.stages},
+        )
+        checkpoint_plane.gc_checkpoints(
+            self.config.checkpoint_dir, pinned=[dest]
+        )
+
+    def _restore_durable_ckpt(self) -> bool:
+        """Adopt the newest VERIFIED durable checkpoint (fallback chain:
+        a corrupt/uncommitted newest is skipped, never loaded).  Returns
+        True when one was adopted."""
+        import pickle
+
+        from ray_tpu.train import checkpoint_plane
+
+        path = checkpoint_plane.resolve_restore(root=self.config.checkpoint_dir)
+        if path is None:
+            return False
+        with open(os.path.join(path, "state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._ckpt = (state["step"], state["params"], state["opt_states"])
+        self.steps_done = state["step"]
+        logger.info(
+            "pipeline resuming from durable checkpoint %s (step %d)",
+            path, state["step"],
+        )
+        return True
 
     def state_dict(self) -> Any:
         """Merged full-model params (checkpoint interop with the

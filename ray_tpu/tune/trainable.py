@@ -71,11 +71,19 @@ class _FnSession:
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         persisted = None
         if checkpoint is not None:
-            import shutil
+            from ray_tpu.train import checkpoint_plane
 
             dest = os.path.join(self.storage_dir, f"checkpoint_{self._idx:06d}")
             if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                # Snapshot-commit (tmp+fsync+rename per file + manifest):
+                # a trial killed mid-report never leaves a plausible
+                # partial checkpoint for resume to adopt.
+                checkpoint_plane.persist_dir(
+                    checkpoint.path, dest,
+                    meta={"trial": self.experiment_name, "idx": self._idx},
+                    mode="sync",
+                )
+                checkpoint_plane.gc_checkpoints(self.storage_dir, pinned=[dest])
             persisted = Checkpoint(dest)
         self._idx += 1
         self._queue.put(("report", dict(metrics), persisted))
@@ -97,6 +105,10 @@ class _TrialRunner:
         self.trial_id = trial_id
         self.trial_dir = trial_dir
         self.iteration = 0
+        # Route every resume through the verified loader: a checkpoint
+        # whose writer was killed mid-commit (or that bit-rotted) is
+        # skipped and the newest verified one in the trial dir adopted.
+        restore_from = self._verified_restore(restore_from)
         self._last_checkpoint: Optional[str] = restore_from
         self._is_function = not (inspect.isclass(trainable) and issubclass(trainable, Trainable))
         if self._is_function:
@@ -109,6 +121,15 @@ class _TrialRunner:
             self._trainable = trainable(config, trial_dir)
             if restore_from:
                 self._trainable.load_checkpoint(restore_from)
+
+    def _verified_restore(self, restore_from: Optional[str]) -> Optional[str]:
+        if not restore_from:
+            return None
+        from ray_tpu.train import checkpoint_plane
+
+        return checkpoint_plane.resolve_restore(
+            preferred=restore_from, root=os.path.dirname(restore_from)
+        )
 
     # ------------------------------------------------------------------
     def _ensure_thread(self):
@@ -164,9 +185,17 @@ class _TrialRunner:
         """Persist a checkpoint; returns its directory."""
         if self._is_function:
             return self._last_checkpoint
+        from ray_tpu.train import checkpoint_plane
+
         ckpt_dir = os.path.join(self.trial_dir, f"checkpoint_{self.iteration:06d}")
         os.makedirs(ckpt_dir, exist_ok=True)
         self._trainable.save_checkpoint(ckpt_dir)
+        # Class trainables wrote files directly into the dir: commit the
+        # manifest in place so resume/exploit can verify before adopting.
+        checkpoint_plane.commit_directory(
+            ckpt_dir, meta={"trial": self.trial_id, "iteration": self.iteration}
+        )
+        checkpoint_plane.gc_checkpoints(self.trial_dir, pinned=[ckpt_dir])
         self._last_checkpoint = ckpt_dir
         return ckpt_dir
 

@@ -96,6 +96,20 @@ if [ "${RAY_TPU_SKIP_DATAPLANE_CHAOS_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Checkpoint chaos smoke (durable checkpoint plane end-to-end): a JAX
+# training loop SIGKILLed mid-shard and pre-commit (seeded ckpt:*
+# rules) with a bit-flipped shard at rest restarts every time from the
+# last COMMITTED checkpoint with byte-exact loss/parameter parity,
+# never adopts corrupted state, and leaves zero debris after retention
+# GC.  Skippable via RAY_TPU_SKIP_CHECKPOINT_CHAOS_SMOKE=1.
+if [ "${RAY_TPU_SKIP_CHECKPOINT_CHAOS_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/checkpoint_chaos_smoke.py; then
+    echo "checkpoint chaos smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # RLlib async smoke (podracer streaming plane end-to-end): 2 streaming
 # env runners + learner over real channels, fixed seed, reward parity
 # vs the synchronous PPO path on CartPole, and the IMPALA-style async

@@ -72,6 +72,7 @@ def test_wedged_tunnel_still_emits_record():
     assert elapsed < 260, f"bench overran its deadline: {elapsed:.0f}s"
 
 
+@pytest.mark.slow  # ~30 s full bench-harness record; gate logic unit-tested above
 def test_healthy_cpu_backend_full_record():
     """With a healthy (CPU) backend the record carries the framework
     number, the raw comparison, and the probe timing."""

@@ -152,6 +152,7 @@ def test_serve_dataplane_corrupt_response_frame_typed_and_reattaches(chaos_env):
         serve.shutdown()
 
 
+@pytest.mark.slow  # ~30 s restart-parity drill; dataplane chaos smoke covers it
 def test_pipeline_plane_corrupt_and_torn_frames_restart_with_parity(chaos_env):
     """Driver-side faults on the pipeline's tgt edge: one corrupted
     frame and one torn (mid-write-killed) frame each surface in the
@@ -196,6 +197,7 @@ def test_pipeline_plane_corrupt_and_torn_frames_restart_with_parity(chaos_env):
         plane.stop()
 
 
+@pytest.mark.slow  # ~20 s respawn drill; dataplane chaos smoke covers the path
 def test_podracer_stream_corruption_retires_edge_and_respawns(chaos_env):
     """Runner-side fault: a corrupted trajectory fragment is caught by
     the intake's CRC check (typed, counted), the edge is retired and the

@@ -67,6 +67,7 @@ def test_spread_scheduling(cluster):
     assert len(nodes) >= 2, f"SPREAD used only {nodes}"
 
 
+@pytest.mark.slow  # ~60 s node-death drill; drain/elastic smokes cover it
 def test_actor_on_remote_node_and_node_death(cluster):
     node = cluster.add_node(num_cpus=1, resources={"doomed": 1})
     cluster.wait_for_nodes()

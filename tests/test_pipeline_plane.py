@@ -91,6 +91,45 @@ def test_gpt2_program_split_merge_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_pipeline_durable_checkpoint_roundtrip_and_fallback(tmp_path):
+    """PipelineConfig.checkpoint_dir makes the restart point DURABLE
+    through the checkpoint plane: a fresh plane (driver restart) adopts
+    the newest committed checkpoint, and a bit-flipped newest is skipped
+    for the previous verified one — never adopted."""
+    import os
+
+    cfg = _cfg()
+    prog = gpt2_pipeline_programs(cfg, n_stages=2, lr=1e-3, seed=0)
+    pcfg = PipelineConfig(
+        stages=2, microbatches=2, checkpoint_dir=str(tmp_path)
+    )
+    plane = PipelinePlane(prog, pcfg)
+    params = prog.init_params()
+    for step in (1, 2):
+        plane._ckpt = (step, params, None)
+        plane._persist_ckpt()
+    # driver restart: a fresh plane resumes from the newest commit
+    plane2 = PipelinePlane(gpt2_pipeline_programs(cfg, n_stages=2), pcfg)
+    assert plane2._restore_durable_ckpt()
+    assert plane2.steps_done == 2
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plane2._ckpt[1]),
+        jax.tree_util.tree_leaves(params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # bit-rot the newest: the loader walks back to step 1, never adopts
+    newest = os.path.join(str(tmp_path), "checkpoint_000002")
+    sp = os.path.join(newest, "state.pkl")
+    with open(sp, "r+b") as f:
+        f.seek(os.path.getsize(sp) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    plane3 = PipelinePlane(gpt2_pipeline_programs(cfg, n_stages=2), pcfg)
+    assert plane3._restore_durable_ckpt()
+    assert plane3.steps_done == 1
+
+
 def test_gpt2_program_rejects_indivisible_layers():
     cfg = _cfg()  # n_layer=2
     prog = gpt2_pipeline_programs(cfg, n_stages=3)

@@ -124,6 +124,7 @@ def test_marwil_learns_cartpole_from_mixed_data(ray_cluster):
     assert best > 120, f"MARWIL failed to exceed mixed-data baseline: best={best}"
 
 
+@pytest.mark.slow  # ~30 s learning gate, like the other *_learns_* drills
 def test_cql_learns_one_step_continuous_task(ray_cluster):
     """CQL on a one-step continuous-control dataset recovers near-optimal
     actions from noisy behavior data (reference: BUILD

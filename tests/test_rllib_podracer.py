@@ -204,6 +204,66 @@ def test_podracer_backpressure_parks_never_drops(ray_cluster):
         plane.stop()
 
 
+def test_podracer_same_node_weight_fanout(ray_cluster):
+    """Same-node anakin runners share ONE fan-out weight ring: a single
+    broadcast write covers the whole cohort (no per-runner snapshot
+    copies) and generations keep advancing for every member."""
+    algo = _ppo_podracer_cfg().build()
+    try:
+        out1 = algo.train()
+        plane = algo.env_runner_group
+        # both same-node runners were placed on the shared fan-out ring
+        assert plane._fanout is not None
+        cohort = [rs for rs in plane.streams if rs.fanout_index is not None]
+        assert len(cohort) == 2
+        assert sorted(rs.fanout_index for rs in cohort) == [0, 1]
+        assert all(rs.weights is plane._fanout for rs in cohort)
+        # one shared write advances the whole cohort's generation
+        out2 = algo.train()
+        assert out2["weight_generation"] > out1["weight_generation"]
+        assert all(rs.last_gen > 0 for rs in cohort)
+    finally:
+        algo.cleanup()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # replacement runner pays a cold JIT compile (~1 min)
+def test_podracer_fanout_member_kill_replacement(ray_cluster):
+    """A killed fan-out cohort member's replacement comes back on a
+    DEDICATED ring (fan-out reader slots tombstone on eviction) while
+    the survivor keeps streaming from the shared ring."""
+    algo = _ppo_podracer_cfg().build()
+    try:
+        algo.train()
+        plane = algo.env_runner_group
+        cohort = [rs for rs in plane.streams if rs.fanout_index is not None]
+        assert len(cohort) == 2
+        # kill one cohort member: the replacement must NOT rejoin the
+        # shared ring (its reader slot is evicted/tombstoned) — it gets
+        # a dedicated weight channel and still receives broadcasts
+        victim = cohort[0]
+        ray_tpu.kill(victim.actor)
+        time.sleep(1.0)  # death report propagates to the GCS actor table
+        for _ in range(3):
+            algo.train()
+        assert plane.replacements >= 1
+        replaced = plane.streams[victim.index]
+        assert replaced.alive
+        assert replaced.fanout_index is None
+        assert replaced.weights is not plane._fanout
+        # fragments flow from both worker indices again (generous
+        # deadline: the replacement runner pays a cold JIT compile)
+        workers = set()
+        drv = algo._podracer
+        deadline = time.monotonic() + 120
+        while len(workers) < 2 and time.monotonic() < deadline:
+            for frag in drv.collect(2):
+                workers.add(frag["worker"])
+        assert workers == {1, 2}
+    finally:
+        algo.cleanup()
+
+
 @pytest.mark.slow
 def test_podracer_sebulba_inference_server(ray_cluster):
     """Sebulba split: action selection served by the shared
