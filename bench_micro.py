@@ -360,6 +360,108 @@ def bench_compiled_socket_roundtrip(n=1000) -> dict:
         c.shutdown()
 
 
+def _rtt_echo_child(req_path: str, rep_path: str, total: int) -> None:
+    """Echo peer of the ring RTT bench: read a value off the request
+    ring, write it back on the reply ring.  When the channel layer has
+    the trace-propagation API, traced frames are echoed UNDER the
+    frame's context so the reply leg is traced too (the full traced
+    round trip); untraced frames take the plain path."""
+    from ray_tpu.experimental.channel import Channel
+
+    req, rep = Channel(req_path), Channel(rep_path)
+    traced_api = hasattr(req, "read_value_traced")
+    if traced_api:
+        from ray_tpu.util import tracing
+    try:
+        for _ in range(total):
+            if traced_api:
+                tag, v, tctx = req.read_value_traced(timeout=60.0)
+                if tctx is not None:
+                    tok = tracing.set_frame_context(tctx)
+                    try:
+                        rep.write_value(v, tag)
+                    finally:
+                        tracing.reset_context(tok)
+                else:
+                    rep.write_value(v, tag)
+            else:
+                tag, v = req.read_value(timeout=60.0)
+                rep.write_value(v, tag)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # bench infra failure: name it, don't hide it
+    finally:
+        req.close()
+        rep.close()
+
+
+def _ring_rtt_us(traced: bool, n: int = 5000, warm: int = 200) -> float:
+    """Two-process ring-channel round trip in microseconds (the serve /
+    DAG dataplane hop shape).  ``traced`` runs the driver side under an
+    active trace context, so frames carry the trace trailer and every
+    hop records channel spans; untraced is the hot-path guard the
+    bench gate holds within noise of HEAD."""
+    import multiprocessing
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu.experimental.channel import Channel
+
+    runs = 4  # timeit: 1 warmup run + 3 timed repeats
+    td = tempfile.mkdtemp(prefix="bench_chan_rtt_")
+    try:
+        req_path = os.path.join(td, "req")
+        rep_path = os.path.join(td, "rep")
+        Channel.create_file(req_path, 1 << 20)
+        Channel.create_file(rep_path, 1 << 20)
+        proc = multiprocessing.Process(
+            target=_rtt_echo_child,
+            args=(req_path, rep_path, runs * (warm + n)),
+            daemon=True,
+        )
+        proc.start()
+        req, rep = Channel(req_path), Channel(rep_path)
+
+        def ping_pong(k: int) -> None:
+            for i in range(k):
+                req.write_value(i, 0, timeout=60.0)
+                rep.read_value(timeout=60.0)
+
+        def run() -> float:
+            if traced:
+                from ray_tpu.util import tracing
+
+                with tracing.start_span("bench_channel_rtt"):
+                    ping_pong(warm)
+                    start = time.perf_counter()
+                    ping_pong(n)
+                    return time.perf_counter() - start
+            ping_pong(warm)
+            start = time.perf_counter()
+            ping_pong(n)
+            return time.perf_counter() - start
+
+        best = timeit(run)
+        req.close()
+        rep.close()
+        proc.join(timeout=10)
+        if proc.is_alive():
+            proc.terminate()
+        return best / n * 1e6
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def bench_channel_rtt_untraced() -> float:
+    return _ring_rtt_us(False)
+
+
+def bench_channel_rtt_traced() -> float:
+    return _ring_rtt_us(True)
+
+
 def _make_ckpt_src(td: str, n_files: int = 8, file_kb: int = 256) -> str:
     import os
 
@@ -467,6 +569,12 @@ BENCHES = [
     # write; vs_single stamped against the depth-matched single path.
     ("compiled_calls_per_s_single_depth64", bench_compiled_single_depth_k, "calls/s", None),
     ("compiled_calls_per_s_execute_many_k64", bench_execute_many, "calls/s", None),
+    # Dataplane tracing overhead guard (ISSUE 17): ring round trip with
+    # and without an active trace context.  The untraced number is the
+    # hot-path invariant (bench_gate holds it within noise of HEAD); the
+    # traced number prices the trailer + channel spans.
+    ("channel_rtt_us_untraced", bench_channel_rtt_untraced, "us", None),
+    ("channel_rtt_us_traced", bench_channel_rtt_traced, "us", None),
     # Durable checkpoint plane (ISSUE 16): the train-step stall of one
     # checkpoint report, sync vs the bounded async writer (the async
     # number must sit measurably below the sync one — the stall the

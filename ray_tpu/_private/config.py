@@ -146,6 +146,11 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # (1.0 = keep everything).  Deterministic in the trace id, so every
     # process keeps or drops the SAME traces and trees stay whole.
     "span_sample_rate": 1.0,
+    # Per-tenant clamp on the GCS span and profile tables: no single
+    # tenant's records may hold more than this fraction of the ring, so
+    # one chatty tenant cannot evict every other tenant's flight-recorder
+    # history.  1.0 disables the clamp (only the global cap applies).
+    "span_table_tenant_share": 0.5,
     # --- sampling profiler (profiling.py) ---
     # Default sampling rate for on-demand profile sessions.  67 Hz keeps
     # the attached overhead well inside the <5% telemetry budget while

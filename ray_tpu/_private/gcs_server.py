@@ -40,6 +40,67 @@ from ray_tpu._private.common import (
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
 
+
+class _TenantTable:
+    """Bounded flight-recorder table with a per-tenant quota.
+
+    Entries append under a tenant label; each tenant may hold at most
+    ``share * size`` entries (its own oldest evicts first), and the
+    table overall holds at most ``size`` (globally-oldest evicts) — one
+    chatty tenant saturates only its own quota instead of flushing
+    every other tenant's records out of the ring.  Every eviction is
+    counted per tenant through ``on_evict``
+    (span_table_evictions_total).  Iteration yields records oldest-
+    first in global arrival order, so ``list()``/``islice()`` consumers
+    keep their newest-last semantics."""
+
+    def __init__(self, size: int, share: float, on_evict=None):
+        self._size = max(1, int(size))
+        share = min(1.0, max(0.0, float(share)))
+        self._quota = max(1, int(self._size * share))
+        self._seq = 0
+        self._total = 0
+        self._by_tenant: Dict[str, deque] = {}
+        self._on_evict = on_evict
+
+    def __len__(self) -> int:
+        return self._total
+
+    def __iter__(self):
+        import heapq
+
+        return (rec for _seq, rec in heapq.merge(*self._by_tenant.values()))
+
+    def _evict(self, tenant: str, d: "deque") -> None:
+        d.popleft()
+        self._total -= 1
+        if not d:
+            del self._by_tenant[tenant]
+        if self._on_evict is not None:
+            try:
+                self._on_evict(tenant, 1)
+            except Exception:  # noqa: BLE001 — accounting must not drop writes
+                pass
+
+    def append(self, tenant: str, rec: Any) -> None:
+        d = self._by_tenant.get(tenant)
+        if d is None:
+            d = self._by_tenant[tenant] = deque()
+        self._seq += 1
+        d.append((self._seq, rec))
+        self._total += 1
+        if len(d) > self._quota:
+            self._evict(tenant, d)
+        while self._total > self._size:
+            oldest_tenant, oldest = min(
+                self._by_tenant.items(), key=lambda kv: kv[1][0][0]
+            )
+            self._evict(oldest_tenant, oldest)
+
+    def extend(self, tenant: str, recs) -> None:
+        for rec in recs:
+            self.append(tenant, rec)
+
 logger = logging.getLogger(__name__)
 
 
@@ -126,14 +187,24 @@ class GcsServer:
         # Flight recorder: finished spans from every process's span
         # flusher (util/tracing.flush); merged cluster-wide by
         # util.state.timeline() and the dashboard /api/timeline.
-        self.spans: "deque" = deque(maxlen=int(CONFIG.span_buffer_size))
+        # Per-tenant clamp (span_table_tenant_share): a chatty tenant
+        # evicts its own history, never another tenant's.
+        self.spans = _TenantTable(
+            int(CONFIG.span_buffer_size),
+            float(CONFIG.span_table_tenant_share),
+            on_evict=telemetry.count_span_table_eviction,
+        )
         # Profile captures shipped by profiled processes at end of
         # capture (profiling.py _ship_finished) — rides the same report
         # path as spans, so a capture survives its driver AND its
         # target process.  Depth must exceed one cluster-wide capture's
         # process count (profile_table_size) or eviction breaks the
         # died-mid-capture recovery path.
-        self.profiles: "deque" = deque(maxlen=int(CONFIG.profile_table_size))
+        self.profiles = _TenantTable(
+            int(CONFIG.profile_table_size),
+            float(CONFIG.span_table_tenant_share),
+            on_evict=telemetry.count_span_table_eviction,
+        )
         self.pending_shapes: Dict[NodeID, list] = {}  # autoscaler demand
         # Capacity-return signal: preempted nodes whose resources the
         # autoscaler should replace even when no task demand is pending
@@ -2239,18 +2310,26 @@ class GcsServer:
             if method == "metrics_report":
                 self.metrics[payload.get("worker_id", b"")] = payload.get("metrics", [])
             elif method == "span_report":
-                self.spans.extend(payload.get("spans", ()))
+                self.spans.extend(
+                    self._report_tenant(payload), payload.get("spans", ())
+                )
             elif method == "profile_report":
                 rec = payload.get("profile")
                 if rec:
-                    self.profiles.append(rec)
+                    self.profiles.append(self._report_tenant(payload), rec)
 
         self.loop.call_soon_threadsafe(apply)
+
+    def _report_tenant(self, payload) -> str:
+        """Clamped tenant label of a span/profile report (registered
+        tenants + "default"/"other", so table keys and the eviction
+        counter's tag values stay bounded)."""
+        return tenants_mod.tenant_label((payload or {}).get("tenant"), self.tenants)
 
     async def rpc_span_report(self, payload, conn):
         """Batched finished spans from a process's span flusher
         (util/tracing.flush — the off-box half of the flight recorder)."""
-        self.spans.extend(payload.get("spans", ()))
+        self.spans.extend(self._report_tenant(payload), payload.get("spans", ()))
         return True
 
     async def rpc_profile_report(self, payload, conn):
@@ -2259,7 +2338,7 @@ class GcsServer:
         the process dies."""
         rec = payload.get("profile")
         if rec:
-            self.profiles.append(rec)
+            self.profiles.append(self._report_tenant(payload), rec)
         return True
 
     async def rpc_list_profiles(self, payload, conn):

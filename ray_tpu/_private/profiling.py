@@ -339,7 +339,15 @@ def _ship_finished(profiler: SamplingProfiler) -> None:
                 "sample_count": record["sample_count"],
             },
         )
-        metrics_mod.report("profile_report", {"profile": record})
+        metrics_mod.report(
+            "profile_report",
+            {
+                "profile": record,
+                # per-tenant accounting in the GCS profile table (same
+                # stamp the span flusher carries)
+                "tenant": os.environ.get("RAY_TPU_TENANT") or "default",
+            },
+        )
     except Exception:  # noqa: BLE001 — shipping is best-effort
         pass
 

@@ -160,6 +160,12 @@ class _Metrics:
             "shrink, actor_restart)",
             tag_keys=("tenant", "action"),
         )
+        self.span_table_evictions = m.Counter(
+            "span_table_evictions_total",
+            "records evicted from the GCS span/profile flight-recorder "
+            "tables, by tenant (per-tenant quota clamp or global ring cap)",
+            tag_keys=("tenant",),
+        )
         # --- per-node drain budget (no node label: each raylet reports
         # through its own channel, keyed by node id at the GCS) ---
         self.drain_deadline_remaining = m.Gauge(
@@ -610,6 +616,18 @@ def count_tenant_preemption(tenant: str, action: str) -> None:
         {"tenant": tenant, "action": action},
     )
     b.inc(1.0)
+
+
+_span_evict_bound: dict = {}
+
+
+def count_span_table_eviction(tenant: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    b = _span_evict_bound.get(tenant) or _bind(
+        _span_evict_bound, tenant, "span_table_evictions", {"tenant": tenant}
+    )
+    b.inc(float(n))
 
 
 def count_lost_capacity(reason: str) -> None:

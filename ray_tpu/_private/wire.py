@@ -15,6 +15,17 @@ as ``[u8 type_code][payload]`` (no tag byte).  The ``tag`` is the same
 namespace as ``serialization.TAG_*`` (NORMAL / ERROR), so errors flow
 through channels exactly like results.
 
+Trace trailer: a frame written from a traced context sets the tag
+byte's high bit (``TRACE_FLAG``) and carries a fixed 33-byte trailer
+between the tag byte and the type code — ``[u8 tag|0x80]``
+``[16s raw trace id][8s parent span id][u8 flags][f64 write ts]``
+``[u8 type_code][payload]`` — so trace identity crosses ring, socket,
+and fan-out hops in-band (Dapper-style context propagation, per-frame).
+Untraced frames pay zero bytes and exactly one ``is None`` test on the
+write path and one bit test on the read path.  ``decode`` masks the
+flag and skips the trailer, so legacy readers stay correct;
+``decode_traced`` surfaces it.
+
 Capacity errors surface as the encoder's ``struct.error``/``ValueError``
 /``IndexError`` (writes past the destination view fail — which of the
 three depends on whether a struct field, a slice, or a single type-code
@@ -49,6 +60,14 @@ _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 _I64 = struct.Struct("<q")
 _F64 = struct.Struct("<d")
+
+# Trace trailer (see module docstring).  The tag byte's high bit marks
+# its presence; real tags live in the low 7 bits (serialization.TAG_*
+# values are single digits).
+TRACE_FLAG = 0x80
+TAG_MASK = 0x7F
+_TRACE = struct.Struct("<16s8sBd")  # raw trace id, parent span id, flags, write ts
+TRACE_LEN = _TRACE.size  # 33
 
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
@@ -171,29 +190,44 @@ def _enc_array(dest: memoryview, off: int, arr, np) -> int:
     return off + nb
 
 
-def encode_into(dest: memoryview, value: Any, tag: int = 0) -> int:
+def encode_into(dest: memoryview, value: Any, tag: int = 0,
+                trace: Any = None) -> int:
     """Encode ``value`` directly into ``dest``; returns bytes written.
+
+    ``trace`` (optional) is ``(trace_id_hex, parent_span_id_hex, flags,
+    write_ts)``: when present the frame carries the 33-byte trace
+    trailer and the tag byte's high bit is set.
 
     Raises ``struct.error``/``ValueError``/``IndexError`` when the
     destination is too small (channel callers catch all three and
     translate to their typed capacity error).
     """
-    dest[0] = tag
+    if trace is None:
+        dest[0] = tag
+        body = 1
+    else:
+        dest[0] = tag | TRACE_FLAG
+        _TRACE.pack_into(
+            dest, 1,
+            bytes.fromhex(trace[0]), bytes.fromhex(trace[1]),
+            trace[2], trace[3],
+        )
+        body = 1 + TRACE_LEN
     try:
-        return _enc(dest, 1, value, 0)
+        return _enc(dest, body, value, 0)
     except _Unencodable:
         meta, buffers = serialization.serialize(value, tag)
-        need = 2 + serialization.total_size(meta, buffers)
+        need = body + 1 + serialization.total_size(meta, buffers)
         if need > len(dest):
             raise ValueError(
                 f"serialized value of {need} bytes exceeds buffer of {len(dest)}"
             )
-        dest[1] = PICKLE
-        serialization.write_into(dest[2:], meta, buffers)
+        dest[body] = PICKLE
+        serialization.write_into(dest[body + 1 :], meta, buffers)
         return need
 
 
-def encode(value: Any, tag: int = 0) -> bytes:
+def encode(value: Any, tag: int = 0, trace: Any = None) -> bytes:
     """Encode to a fresh bytes object (socket frames, tests)."""
     size = 256
     np = sys.modules.get("numpy")
@@ -202,7 +236,7 @@ def encode(value: Any, tag: int = 0) -> bytes:
     while True:
         buf = bytearray(size)
         try:
-            n = encode_into(memoryview(buf), value, tag)
+            n = encode_into(memoryview(buf), value, tag, trace)
             return bytes(buf[:n])
         except (struct.error, ValueError, IndexError):
             size *= 4
@@ -306,7 +340,9 @@ def decode(view: memoryview, copy_arrays: bool = True) -> Tuple[int, Any]:
     view = view.cast("B") if view.format != "B" else view
     try:
         tag = view[0]
-        is_pickle = view[1] == PICKLE
+        body = 1 + TRACE_LEN if tag & TRACE_FLAG else 1
+        tag &= TAG_MASK
+        is_pickle = view[body] == PICKLE
     except IndexError as e:
         raise WireFormatError(f"truncated wire header: {e}") from e
     if is_pickle:
@@ -318,16 +354,37 @@ def decode(view: memoryview, copy_arrays: bool = True) -> Tuple[int, Any]:
         # failures (truncated/flipped pickle in direct or fuzz use)
         # still surface as the typed error.
         try:
-            _inner_tag, value = serialization.deserialize(view[2:])
+            _inner_tag, value = serialization.deserialize(view[body + 1 :])
             return tag, value
         except (ImportError, AttributeError, NameError):
             raise  # class-resolution / app-level: not a framing problem
         except Exception as e:  # noqa: BLE001 — structural: typed
             raise WireFormatError(f"malformed pickle payload: {e}") from e
     try:
-        value, _ = _dec(view, 1, copy_arrays)
+        value, _ = _dec(view, body, copy_arrays)
         return tag, value
     except WireFormatError:
         raise
     except Exception as e:  # noqa: BLE001 — any escape = malformed input
         raise WireFormatError(f"malformed wire payload: {e}") from e
+
+
+def decode_traced(
+    view: memoryview, copy_arrays: bool = True
+) -> Tuple[int, Any, Any]:
+    """Decode one value plus its trace trailer; returns ``(tag, value,
+    trace)`` where ``trace`` is ``None`` for untraced frames and
+    ``(trace_id_hex, parent_span_id_hex, flags, write_ts)`` otherwise.
+    Same error contract as :func:`decode`."""
+    view = view.cast("B") if view.format != "B" else view
+    try:
+        flagged = view[0] & TRACE_FLAG
+    except IndexError as e:
+        raise WireFormatError(f"truncated wire header: {e}") from e
+    if not flagged:
+        tag, value = decode(view, copy_arrays)
+        return tag, value, None
+    _need(view, 1, TRACE_LEN)
+    tid, psid, flags, write_ts = _TRACE.unpack_from(view, 1)
+    tag, value = decode(view, copy_arrays)
+    return tag, value, (tid.hex(), psid.hex(), flags, write_ts)

@@ -1775,6 +1775,7 @@ class Worker:
             start,
             end,
             {"task_id": spec.task_id.hex(), "ok": error is None},
+            context=_tracing.current_context(),
         )
         telemetry.observe_task_phase("exec", end - start)
         self._record_task_event(spec, start, end, error)
@@ -2166,6 +2167,7 @@ class Worker:
                 exec_start,
                 exec_end,
                 {"task_id": spec.task_id.hex()},
+                context=_tracing.current_context(),
             )
             telemetry.observe_task_phase("exec", exec_end - exec_start)
             self.current_spec = None

@@ -262,6 +262,7 @@ def _actor_channel_loop(self, ops, descs, token):
     from ray_tpu._private.config import CONFIG
     from ray_tpu.experimental import channel as channel_mod
     from ray_tpu.experimental.channel import ChannelClosed
+    from ray_tpu.util import tracing
 
     read_ids, write_ids = set(), set()
     for op in ops:
@@ -294,7 +295,7 @@ def _actor_channel_loop(self, ops, descs, token):
         executions' results to the wrong refs."""
         while True:
             try:
-                return chans[cid].read_value(timeout=None)
+                return chans[cid].read_value_traced(timeout=None)
             except ChannelClosed:
                 if not channel_mod.reattach(chans[cid]):
                     raise
@@ -324,13 +325,20 @@ def _actor_channel_loop(self, ops, descs, token):
         while True:
             local = {}
             local_batched = set()  # uuids whose local result is a K-list
+            # Trace context of each op's recorded dag.op span, so ops fed
+            # only by "local" args still chain under the execution that
+            # produced their input.
+            local_ctx = {}
             for op in ops:
                 args = []
                 arg_error = None
                 batch_k = None  # execute_many: K executions in one frame
+                frame_ctx = None  # first traced inbound frame this op saw
                 for kind, val in op["args"]:
                     if kind == "chan":
-                        tag, v = read_arg(val)
+                        tag, v, tctx = read_arg(val)
+                        if tctx is not None and frame_ctx is None:
+                            frame_ctx = tctx
                         if tag == TAG_BATCH:
                             batch_k = len(v)
                         elif tag == TAG_ERROR:
@@ -338,6 +346,8 @@ def _actor_channel_loop(self, ops, descs, token):
                         args.append((tag == TAG_BATCH, v))
                     elif kind == "local":
                         v = local[val]
+                        if frame_ctx is None:
+                            frame_ctx = local_ctx.get(val)
                         if val in local_batched:
                             batch_k = len(v)
                             args.append((True, v))
@@ -347,44 +357,68 @@ def _actor_channel_loop(self, ops, descs, token):
                             args.append((False, v))
                     else:  # const
                         args.append((False, val))
-                if batch_k is not None:
-                    # K executions amortized into one channel write per
-                    # edge: scalars (consts) broadcast, per-entry errors
-                    # stay entries (downstream skips only their slot).
-                    results = []
-                    for k in range(batch_k):
-                        item_args = [v[k] if b else v for b, v in args]
-                        err = next(
-                            (
-                                a
-                                for a in item_args
-                                if isinstance(a, exceptions.RayTaskError)
-                            ),
-                            None,
-                        )
-                        if err is not None:
-                            results.append(err)
-                        else:
-                            results.append(run_op(op, item_args)[0])
-                    local[op["uuid"]] = results
-                    local_batched.add(op["uuid"])
+                # Re-parent THIS execution from the inbound frame context.
+                # The loop runs inside one long-lived task whose context
+                # was installed once at actor start; without the per-
+                # execution re-parent every span recorded inside resident
+                # executors chained to that stale context.  An untraced
+                # frame (frame_ctx None) CLEARS the context for the same
+                # reason.
+                ftok = tracing.set_frame_context(frame_ctx)
+                t_op = _time.time()
+                try:
+                    if batch_k is not None:
+                        # K executions amortized into one channel write per
+                        # edge: scalars (consts) broadcast, per-entry errors
+                        # stay entries (downstream skips only their slot).
+                        results = []
+                        for k in range(batch_k):
+                            item_args = [v[k] if b else v for b, v in args]
+                            err = next(
+                                (
+                                    a
+                                    for a in item_args
+                                    if isinstance(a, exceptions.RayTaskError)
+                                ),
+                                None,
+                            )
+                            if err is not None:
+                                results.append(err)
+                            else:
+                                results.append(run_op(op, item_args)[0])
+                        local[op["uuid"]] = results
+                        local_batched.add(op["uuid"])
+                        if frame_ctx is not None:
+                            local_ctx[op["uuid"]] = tracing.current_context()
+                        if op["outs"]:
+                            channel_mod.write_value_fanout(
+                                [(chans[o], results, TAG_BATCH) for o in op["outs"]],
+                                timeout=None,
+                            )
+                        continue
+                    plain_args = [v for _b, v in args]
+                    if arg_error is not None:
+                        result, tag = arg_error, TAG_ERROR
+                    else:
+                        result, tag = run_op(op, plain_args)
+                    local[op["uuid"]] = result
+                    if frame_ctx is not None:
+                        local_ctx[op["uuid"]] = tracing.current_context()
                     if op["outs"]:
                         channel_mod.write_value_fanout(
-                            [(chans[o], results, TAG_BATCH) for o in op["outs"]],
+                            [(chans[o], result, tag) for o in op["outs"]],
                             timeout=None,
                         )
-                    continue
-                plain_args = [v for _b, v in args]
-                if arg_error is not None:
-                    result, tag = arg_error, TAG_ERROR
-                else:
-                    result, tag = run_op(op, plain_args)
-                local[op["uuid"]] = result
-                if op["outs"]:
-                    channel_mod.write_value_fanout(
-                        [(chans[o], result, tag) for o in op["outs"]],
-                        timeout=None,
-                    )
+                finally:
+                    if frame_ctx is not None:
+                        tracing.record_span(
+                            "dag.op",
+                            t_op,
+                            _time.time(),
+                            {"method": op["method"], "batch_k": batch_k or 1},
+                            context=tracing.current_context(),
+                        )
+                    tracing.reset_context(ftok)
     except (ChannelClosed, channel_mod.ChannelCorruptionError):
         # Teardown (orderly close, or fail-closed frame corruption):
         # propagate the poison downstream so every consumer (other

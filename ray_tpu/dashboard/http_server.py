@@ -151,6 +151,17 @@ class _DashboardState:
 
         return group_traces(self.spans())
 
+    def dataplane(self):
+        """Hot-path health view: per-channel-edge hop stats from
+        sampled spans merged with cluster-wide channel_* counters."""
+        from ray_tpu.util.state import build_dataplane
+
+        try:
+            metric_records = self.gcs.call("metrics_get", None) or []
+        except Exception:
+            metric_records = []
+        return build_dataplane(self.spans(), metric_records)
+
     def timeline_trace(self):
         """Cluster flight-recorder export: GCS task events + spans from
         every process merged into one Chrome-trace/Perfetto event list."""
@@ -379,6 +390,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(info)
             if path == "/api/traces":
                 return self._json(self.state.traces())
+            if path == "/api/dataplane":
+                return self._json(self.state.dataplane())
             if path == "/api/timeline":
                 body = json.dumps(self.state.timeline_trace(), default=str).encode()
                 self.send_response(200)
@@ -539,7 +552,7 @@ class _Handler(BaseHTTPRequestHandler):
             + _html_table("Jobs (submitted)", self.jobs.list_jobs())
             + "<p>API: /api/nodes /api/actors /api/tasks /api/jobs "
             "/api/objects /api/placement_groups /api/workers /api/traces "
-            "/api/timeline /api/chaos /metrics</p>"
+            "/api/dataplane /api/timeline /api/chaos /metrics</p>"
             "</body></html>"
         )
         self._send(200, html.encode(), "text/html")

@@ -10,6 +10,7 @@ from ray_tpu.devtools.lint.checkers import (
     metrics_drift,
     retry_gate,
     thread_lifecycle,
+    trace_orphan,
 )
 
 ALL_CHECKERS = [
@@ -20,6 +21,7 @@ ALL_CHECKERS = [
     metrics_drift,
     generation_key,
     import_cycle,
+    trace_orphan,
 ]
 
 CHECK_NAMES = [c.name for c in ALL_CHECKERS]

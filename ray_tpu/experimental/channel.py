@@ -75,6 +75,20 @@ def _align8(n: int) -> int:
     return (n + 7) & ~7
 
 
+def _u64_view(mm, nbytes: int):
+    """Aligned u64 accessor over the mmap's first ``nbytes`` bytes.
+
+    Shared header fields (write/read offsets, cursors, closed flag) are
+    mutated by one process while the peer polls them.  struct's "<Q"
+    pack/unpack loops BYTE-WISE, so a peer that preempts the writer
+    mid-store observes a torn offset — seen in practice as phantom
+    zero-length records on contended single-core hosts (the reader
+    passes the occupancy check on the torn value, then reads a length
+    word the writer hasn't stored yet).  A cast-memoryview item access
+    is one aligned 8-byte load/store, which x86-64 keeps atomic."""
+    return memoryview(mm)[:nbytes].cast("Q")
+
+
 class ChannelClosed(Exception):
     """The peer closed the channel (drained) or died (socket EOF)."""
 
@@ -137,6 +151,94 @@ def _wire_mod():
 
         _wire = wire
     return _wire
+
+
+_tracing = None  # lazy module ref (same pattern as _wire)
+
+
+def _tracing_mod():
+    global _tracing
+    if _tracing is None:
+        from ray_tpu.util import tracing
+
+        _tracing = tracing
+    return _tracing
+
+
+def _trace_begin():
+    """Per-frame write-side trace state: ``None`` when the writing
+    context is untraced (ONE contextvar read — the untraced hot path
+    pays nothing else), otherwise a mutable ``[trace_id, write_span_id,
+    caller_span_id, t_entry]``.  One state per (frame, target) so every
+    channel edge gets its own write span and blocked retries of the
+    same frame never mint new span ids."""
+    tr = _tracing_mod()
+    ctx = tr.current_context()
+    if ctx is None:
+        return None
+    return [ctx[0], tr.new_span_id(), ctx[1], time.time()]
+
+
+def _trace_trailer(ts):
+    """Wire trailer for one publish attempt.  write_ts is re-stamped per
+    attempt so the committed frame carries ~commit time, making the
+    reader's queue-wait attribution blocked-writer-proof."""
+    return (ts[0], ts[1], 0, time.time())
+
+
+def _trace_commit_write(ts, kind: str, path: str) -> None:
+    """Record the frame's ``channel.write`` span (entry → commit) at the
+    pre-minted write span id, parented under the caller's span."""
+    _tracing_mod().record_span(
+        "channel.write",
+        ts[3],
+        time.time(),
+        {"kind": kind, "path": path},
+        context=(ts[0], ts[1], ts[2]),
+    )
+
+
+def _trace_read(tr_tuple, kind: str, path: str):
+    """Record the read-side hop span for a traced frame and return the
+    frame context ``(trace_id, read_span_id, write_span_id)`` consumers
+    adopt via ``tracing.set_frame_context``.  The span covers
+    write-commit → read-return, so its duration IS the edge's queue
+    wait (same-host clocks for rings; sockets carry the writer's stamp,
+    close enough for attribution)."""
+    trm = _tracing_mod()
+    tid, wsid, _flags, wts = tr_tuple
+    rsid = trm.new_span_id()
+    end = time.time()
+    start = wts if 0 < wts <= end else end
+    trm.record_span(
+        "channel.read",
+        start,
+        end,
+        {"kind": kind, "path": path, "queue_wait_s": max(0.0, end - start)},
+        context=(tid, rsid, wsid),
+    )
+    return (tid, rsid, wsid)
+
+
+def _trace_reattach(path: str, ok: bool, epoch: int) -> None:
+    """A reattach is an ANNOTATED event on the live trace (child span
+    when a context is active, standalone event span otherwise) — never a
+    break in the tree."""
+    try:
+        trm = _tracing_mod()
+        now = time.time()
+        attrs = {"path": path, "result": "ok" if ok else "failed",
+                 "epoch": epoch}
+        ctx = trm.current_context()
+        if ctx is not None:
+            trm.record_span(
+                "channel.reattach", now, now, attrs,
+                context=(ctx[0], trm.new_span_id(), ctx[1]),
+            )
+        else:
+            trm.record_event_span("channel.reattach", now, now, attrs)
+    except Exception:
+        pass
 
 
 def _resolve_timeout(timeout) -> Optional[float]:
@@ -309,6 +411,7 @@ class Channel:
         # ring can carry: one wrap marker must always fit beside it.
         self.max_size = self.capacity - 24
         self._mm = mmap.mmap(self._f.fileno(), size)
+        self._hdr = _u64_view(self._mm, HEADER)
         _register_shm_pid(path)
         # Dataplane counters (item-2 hot path must land measurable):
         # plain dict increments on the fast path (~100 ns), folded into
@@ -328,12 +431,12 @@ class Channel:
         self._tele_ops = 0
         self._tele_flushed = dict(self.stats)
 
-    # -- raw fields -----------------------------------------------------
+    # -- raw fields (single atomic 8-byte access; see _u64_view) --------
     def _get(self, off: int) -> int:
-        return _U64.unpack_from(self._mm, off)[0]
+        return self._hdr[off >> 3]
 
     def _set(self, off: int, v: int) -> None:
-        _U64.pack_into(self._mm, off, v)
+        self._hdr[off >> 3] = v
 
     # Hot-spinning only helps when the peer can run on another core;
     # on a 1-2 core host it starves the peer for a whole scheduler
@@ -489,7 +592,8 @@ class Channel:
             self.stats["write_blocked_s"] += time.monotonic() - t_block
         self._count_write(len(data))
 
-    def _try_publish_value(self, value: Any, tag: int, cd=None) -> Tuple[bool, bool]:
+    def _try_publish_value(self, value: Any, tag: int, cd=None,
+                           trace=None) -> Tuple[bool, bool]:
         """One encode attempt at the current write position.  Returns
         (published, blocked_on_reader): encoding straight into the ring
         means the payload size is unknown up front, so an overflow is
@@ -511,6 +615,7 @@ class Channel:
                     ],
                     value,
                     tag,
+                    trace,
                 )
             except (struct.error, ValueError, IndexError):
                 n = -1
@@ -549,6 +654,7 @@ class Channel:
         cd = _chaos_decide(self.path)
         if cd is not None and self._apply_write_chaos(cd, 0):
             return
+        ts = _trace_begin()
         deadline = None  # resolved at first block (see write())
         spins = 0
         t_block = 0.0
@@ -561,10 +667,14 @@ class Channel:
                     self._write_wait(spins, t_block, deadline)
                     continue
                 blocked_at_rb = None
-            published, blocked = self._try_publish_value(value, tag, cd)
+            published, blocked = self._try_publish_value(
+                value, tag, cd, None if ts is None else _trace_trailer(ts)
+            )
             if published:
                 if spins:
                     self.stats["write_blocked_s"] += time.monotonic() - t_block
+                if ts is not None:
+                    _trace_commit_write(ts, self.kind, self.path)
                 return
             if blocked:
                 if spins == 0:
@@ -581,7 +691,7 @@ class Channel:
                 self._write_wait(spins, t_block, deadline)
 
     def try_write_value(self, value: Any, tag: int = 0,
-                        cd=_CHAOS_UNDECIDED) -> bool:
+                        cd=_CHAOS_UNDECIDED, trace_state=None) -> bool:
         """Non-blocking write attempt (fan-out scheduling): False when
         the ring lacks free space right now.
 
@@ -589,16 +699,25 @@ class Channel:
         verdict ONCE (pre-actions already applied) so blocked retries of
         the same frame don't consume extra rule match-ordinals — the
         seeded schedule must be deterministic per FRAME, not per retry
-        (retry counts are timing-dependent)."""
+        (retry counts are timing-dependent).  ``trace_state`` is the
+        frame's pre-minted _trace_begin state for the same reason: one
+        write span per (frame, edge) no matter how many retries."""
         if self._closed_flag():
             raise ChannelClosed(self.path)
         if cd is _CHAOS_UNDECIDED:
             cd = _chaos_decide(self.path)
             if cd is not None and self._apply_write_chaos(cd, 0):
                 return True
+        if trace_state is None:
+            trace_state = _trace_begin()
         while True:
-            published, blocked = self._try_publish_value(value, tag, cd)
+            published, blocked = self._try_publish_value(
+                value, tag, cd,
+                None if trace_state is None else _trace_trailer(trace_state),
+            )
             if published:
+                if trace_state is not None:
+                    _trace_commit_write(trace_state, self.kind, self.path)
                 return True
             if blocked:
                 return False
@@ -616,6 +735,10 @@ class Channel:
             self._set(_COFF, 1)
         except ValueError:
             pass  # mmap already closed
+        try:
+            self._hdr.release()
+        except Exception:
+            pass
         try:
             self._mm.close()
             self._f.close()
@@ -709,6 +832,14 @@ class Channel:
         """Fast-path read: wire-decode straight from the ring; returns
         ``(tag, value)``.  Array payloads are copied out before the
         consume-ack (the writer reuses the region afterwards)."""
+        return self.read_value_traced(timeout)[:2]
+
+    def read_value_traced(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any, Any]:
+        """``read_value`` plus the frame's trace context: ``(tag, value,
+        tctx)`` where tctx is ``None`` for untraced frames or
+        ``(trace_id, read_span_id, write_span_id)`` — the tuple a
+        consumer hands to ``tracing.set_frame_context`` to re-parent its
+        own spans under this hop."""
         wire = _wire_mod()
         deadline = None  # resolved at first block (see write())
         spins = 0
@@ -728,7 +859,7 @@ class Channel:
                         f"{self.path}: CRC mismatch on {n}-byte record"
                     )
                 try:
-                    tag, value = wire.decode(mv, copy_arrays=True)
+                    tag, value, tr = wire.decode_traced(mv, copy_arrays=True)
                 except wire.WireFormatError as e:
                     self._consume(rpos, n, blocked)
                     self._record_corruption()
@@ -736,7 +867,8 @@ class Channel:
                         f"{self.path}: undecodable record ({e})"
                     ) from e
                 self._consume(rpos, n, blocked)
-                return tag, value
+                tctx = None if tr is None else _trace_read(tr, self.kind, self.path)
+                return tag, value, tctx
             if spins == 0:
                 t_block = time.monotonic()
                 timeout = _resolve_timeout(timeout)
@@ -1100,16 +1232,22 @@ class SocketChannel:
         return self._pop_frame(_resolve_timeout(timeout))
 
     def read_value(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any]:
+        return self.read_value_traced(timeout)[:2]
+
+    def read_value_traced(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any, Any]:
+        """(tag, value, tctx) — see Channel.read_value_traced."""
         wire = _wire_mod()
         frame = self._pop_frame(_resolve_timeout(timeout))
         try:
             # One-shot frame owned by us: arrays may alias it (no copy).
-            return wire.decode(memoryview(frame), copy_arrays=False)
+            tag, value, tr = wire.decode_traced(memoryview(frame), copy_arrays=False)
         except wire.WireFormatError as e:
             self._record_corruption()
             raise ChannelCorruptionError(
                 f"{self.path}: undecodable frame ({e})"
             ) from e
+        tctx = None if tr is None else _trace_read(tr, self.kind, self.path)
+        return tag, value, tctx
 
     def pending(self) -> bool:
         if self.role == "read":
@@ -1295,13 +1433,13 @@ class SocketChannel:
             if self._unacked < self._window:
                 return
 
-    def _encode_scratch(self, value: Any, tag: int) -> int:
+    def _encode_scratch(self, value: Any, tag: int, trace=None) -> int:
         wire = _wire_mod()
         while True:
             try:
                 return wire.encode_into(
                     memoryview(self._scratch)[_FRAME_HDR.size:len(self._scratch) - 4],
-                    value, tag,
+                    value, tag, trace,
                 )
             except (struct.error, ValueError, IndexError):
                 if len(self._scratch) >= 1 << 31:
@@ -1318,9 +1456,11 @@ class SocketChannel:
             budget = max(0.5, min(budget, deadline - time.monotonic()))
         return budget
 
-    def _write_payload(self, value: Any, tag: int, timeout: Optional[float], data: Optional[bytes]) -> None:
+    def _write_payload(self, value: Any, tag: int, timeout: Optional[float],
+                       data: Optional[bytes], trace_state=None) -> None:
         if self._closed:
             raise ChannelClosed(self.path)
+        ts = trace_state if trace_state is not None else _trace_begin()
         cd = _chaos_decide(self.path)
         if cd is not None:
             if cd.delay_s > 0:
@@ -1345,8 +1485,11 @@ class SocketChannel:
             if len(self._scratch) < hdr + n + 4:
                 self._scratch = bytearray(hdr + n + 4)
             self._scratch[hdr : hdr + n] = data
+            ts = None  # raw frames carry no wire header to trail
         else:
-            n = self._encode_scratch(value, tag)
+            n = self._encode_scratch(
+                value, tag, None if ts is None else _trace_trailer(ts)
+            )
         crc = zlib.crc32(memoryview(self._scratch)[hdr : hdr + n])
         if cd is not None and cd.corrupt:
             if n > 0:
@@ -1393,6 +1536,8 @@ class SocketChannel:
         if waited > 0.0005:
             self.stats["write_blocked_s"] += waited
         self._count_write(n)
+        if ts is not None:
+            _trace_commit_write(ts, self.kind, self.path)
 
     _count_write = Channel._count_write
 
@@ -1402,7 +1547,8 @@ class SocketChannel:
     def write_value(self, value: Any, tag: int = 0, timeout=DEFAULT_TIMEOUT) -> None:
         self._write_payload(value, tag, _resolve_timeout(timeout), None)
 
-    def try_write_value(self, value: Any, tag: int = 0) -> bool:
+    def try_write_value(self, value: Any, tag: int = 0,
+                        trace_state=None) -> bool:
         if self._closed:
             raise ChannelClosed(self.path)
         if self._unacked >= self._window:
@@ -1434,7 +1580,7 @@ class SocketChannel:
                 self._absorb_rx_bytes(acks)
             if self._unacked >= self._window:
                 return False
-        self.write_value(value, tag, timeout=None)
+        self._write_payload(value, tag, None, None, trace_state)
         return True
 
     # -- teardown -------------------------------------------------------
@@ -1478,15 +1624,21 @@ def reattach(chan, timeout: Optional[float] = None) -> bool:
     try:
         if isinstance(chan, SocketChannel):
             if chan.role == "read":
-                return chan._reattach_read(timeout)
-            return chan._reattach_write(timeout)
+                ok = chan._reattach_read(timeout)
+            else:
+                ok = chan._reattach_write(timeout)
+            _trace_reattach(chan.path, ok, getattr(chan, "epoch", 0))
+            return ok
         ok = False
         if isinstance(chan, Channel):
             ok = os.path.exists(chan.path) and not chan._closed_flag()
         _count_reattach(ok)
+        _trace_reattach(chan.path, ok, getattr(chan, "epoch", 0))
         return ok
     except Exception:
         _count_reattach(False)
+        _trace_reattach(getattr(chan, "path", "?"), False,
+                        getattr(chan, "epoch", 0))
         return False
 
 
@@ -1539,16 +1691,17 @@ class FanoutChannel:
         self.capacity = cap - (cap % 8)
         self.max_size = self.capacity - 24
         self._mm = mmap.mmap(self._f.fileno(), size)
+        self._hdr = _u64_view(self._mm, header)
         if create:
-            _U64.pack_into(self._mm, 16, n_readers)
+            self._hdr[2] = n_readers
         else:
-            stored = _U64.unpack_from(self._mm, 16)[0]
+            stored = self._hdr[2]
             if stored != n_readers:
                 raise ValueError(
                     f"fan-out channel {path} was created for {stored} "
                     f"readers, opened for {n_readers}"
                 )
-        _U64.pack_into(self._mm, 24, os.getpid())
+        self._hdr[3] = os.getpid()
         _register_shm_pid(path)
         self.stats = {"writes": 0, "bytes_written": 0, "write_blocked_s": 0.0,
                       "evictions": 0}
@@ -1565,9 +1718,9 @@ class FanoutChannel:
         typed close, never a silent write into the void."""
         lo = None
         for i in range(self.n_readers):
-            if _U64.unpack_from(self._mm, self._pid_off(i))[0] == _EVICTED_PID:
+            if self._hdr[self._pid_off(i) >> 3] == _EVICTED_PID:
                 continue
-            cur = _U64.unpack_from(self._mm, self._cursor_off(i))[0]
+            cur = self._hdr[self._cursor_off(i) >> 3]
             lo = cur if lo is None or cur < lo else lo
         if lo is None:
             raise ChannelClosed(
@@ -1582,10 +1735,10 @@ class FanoutChannel:
         way; the write timeout covers that case exactly as before."""
         evicted = 0
         for i in range(self.n_readers):
-            pid = _U64.unpack_from(self._mm, self._pid_off(i))[0]
+            pid = self._hdr[self._pid_off(i) >> 3]
             if pid in (0, _EVICTED_PID) or _pid_alive(pid):
                 continue
-            _U64.pack_into(self._mm, self._pid_off(i), _EVICTED_PID)
+            self._hdr[self._pid_off(i) >> 3] = _EVICTED_PID
             evicted += 1
         if evicted:
             self.stats["evictions"] += evicted
@@ -1620,9 +1773,9 @@ class FanoutChannel:
         cap = self.capacity
         hdr = self._header
         while True:
-            if _U64.unpack_from(self._mm, 8)[0]:
+            if self._hdr[1]:
                 raise ChannelClosed(self.path)
-            wb = _U64.unpack_from(self._mm, 0)[0]
+            wb = self._hdr[0]
             free = cap - (wb - self._min_read())
             tail = cap - (wb % cap)
             if tail < need:
@@ -1630,7 +1783,7 @@ class FanoutChannel:
                     # Wrap: the tail region is free for EVERY reader.
                     if tail >= 8:
                         _U64.pack_into(self._mm, hdr + (wb % cap), WRAP)
-                    _U64.pack_into(self._mm, 0, wb + tail)
+                    self._hdr[0] = wb + tail
                     continue
             elif free >= need:
                 break
@@ -1659,7 +1812,7 @@ class FanoutChannel:
             crc = _mutate_payload(self._mm, hdr + wpos + 8, len(data), crc, cd)
         _U32C.pack_into(self._mm, hdr + wpos + 8 + len(data), crc)
         _U64.pack_into(self._mm, hdr + wpos, len(data))
-        _U64.pack_into(self._mm, 0, wb + need)
+        self._hdr[0] = wb + need
         if spins:
             self.stats["write_blocked_s"] += time.monotonic() - t_block
         self.stats["writes"] += 1
@@ -1672,12 +1825,22 @@ class FanoutChannel:
         duplicating the ring's in-place encoder for a third layout."""
         from ray_tpu._private import wire
 
-        self.write(wire.encode(value, tag), timeout=timeout)
+        ts = _trace_begin()
+        self.write(
+            wire.encode(value, tag, None if ts is None else _trace_trailer(ts)),
+            timeout=timeout,
+        )
+        if ts is not None:
+            _trace_commit_write(ts, self.kind, self.path)
 
     def close(self) -> None:
         try:
-            _U64.pack_into(self._mm, 8, 1)
+            self._hdr[1] = 1
         except ValueError:
+            pass
+        try:
+            self._hdr.release()
+        except Exception:
             pass
         try:
             self._mm.close()
@@ -1713,27 +1876,25 @@ class FanoutReader:
             raise ValueError(f"reader index {index} out of range (n={n})")
         self.n_readers = n
         self._header = _fanout_header(n)
+        self._hdr = _u64_view(self._mm, self._header)
         cap = size - self._header
         self.capacity = cap - (cap % 8)
         self.max_size = self.capacity - 24
         self._off = 32 + 8 * index
         self._pid_slot = 32 + 8 * n + 8 * index
-        _U64.pack_into(self._mm, self._pid_slot, os.getpid())
+        self._hdr[self._pid_slot >> 3] = os.getpid()
         _register_shm_pid(path)
         self.stats = {"reads": 0, "bytes_read": 0, "read_blocked_s": 0.0,
                       "corruptions": 0}
 
     def pending(self) -> bool:
         try:
-            return (
-                _U64.unpack_from(self._mm, 0)[0]
-                != _U64.unpack_from(self._mm, self._off)[0]
-            )
+            return self._hdr[0] != self._hdr[self._off >> 3]
         except ValueError:
             return False
 
     def _check_evicted(self) -> None:
-        if _U64.unpack_from(self._mm, self._pid_slot)[0] == _EVICTED_PID:
+        if self._hdr[self._pid_slot >> 3] == _EVICTED_PID:
             raise ChannelClosed(
                 f"{self.path}: reader {self.index} was evicted (writer "
                 f"presumed this PID dead)"
@@ -1742,17 +1903,17 @@ class FanoutReader:
     def _next_slot(self) -> Optional[Tuple[int, int]]:
         cap = self.capacity
         while True:
-            rb = _U64.unpack_from(self._mm, self._off)[0]
-            if _U64.unpack_from(self._mm, 0)[0] == rb:
+            rb = self._hdr[self._off >> 3]
+            if self._hdr[0] == rb:
                 return None
             rpos = rb % cap
             tail = cap - rpos
             if tail < 8:
-                _U64.pack_into(self._mm, self._off, rb + tail)
+                self._hdr[self._off >> 3] = rb + tail
                 continue
             n = _U64.unpack_from(self._mm, self._header + rpos)[0]
             if n == WRAP:
-                _U64.pack_into(self._mm, self._off, rb + tail)
+                self._hdr[self._off >> 3] = rb + tail
                 continue
             if n > self.max_size or 8 + _align8(n + 4) > tail:
                 self.stats["corruptions"] += 1
@@ -1780,8 +1941,8 @@ class FanoutReader:
                     self._mm[self._header + rpos + 8: self._header + rpos + 8 + n]
                 )
                 stored = _U32C.unpack_from(self._mm, self._header + rpos + 8 + n)[0]
-                rb = _U64.unpack_from(self._mm, self._off)[0]
-                _U64.pack_into(self._mm, self._off, rb + 8 + _align8(n + 4))
+                rb = self._hdr[self._off >> 3]
+                self._hdr[self._off >> 3] = rb + 8 + _align8(n + 4)
                 if zlib.crc32(data) != stored:
                     self.stats["corruptions"] += 1
                     _count_corruption()
@@ -1793,7 +1954,7 @@ class FanoutReader:
                 if spins:
                     self.stats["read_blocked_s"] += time.monotonic() - t_block
                 return data
-            if _U64.unpack_from(self._mm, 8)[0]:
+            if self._hdr[1]:
                 raise ChannelClosed(self.path)
             if spins == 0:
                 t_block = time.monotonic()
@@ -1808,20 +1969,32 @@ class FanoutReader:
                 )
 
     def read_value(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any]:
+        return self.read_value_traced(timeout)[:2]
+
+    def read_value_traced(self, timeout=DEFAULT_TIMEOUT) -> Tuple[int, Any, Any]:
+        """(tag, value, tctx) — see Channel.read_value_traced."""
         from ray_tpu._private import wire
 
         # The frame was copied out of the ring by read(); arrays may
         # alias the private copy.
         try:
-            return wire.decode(memoryview(self.read(timeout)), copy_arrays=False)
+            tag, value, tr = wire.decode_traced(
+                memoryview(self.read(timeout)), copy_arrays=False
+            )
         except wire.WireFormatError as e:
             self.stats["corruptions"] += 1
             _count_corruption()
             raise ChannelCorruptionError(
                 f"{self.path}: undecodable fan-out record ({e})"
             ) from e
+        tctx = None if tr is None else _trace_read(tr, self.kind, self.path)
+        return tag, value, tctx
 
     def close(self) -> None:
+        try:
+            self._hdr.release()
+        except Exception:
+            pass
         try:
             self._mm.close()
             self._f.close()
@@ -2006,18 +2179,20 @@ def write_value_fanout(
             cd = _chaos_decide(chan.path)
             if cd is not None and chan._apply_write_chaos(cd, 0):
                 continue  # dropped: the frame silently vanishes
-        pending.append((chan, value, tag, cd))
+        # One trace state per (frame, target): each edge gets its own
+        # write span, and blocked retry rounds reuse the same span id.
+        pending.append((chan, value, tag, cd, _trace_begin()))
     deadline = None  # resolved at first blocked round (see Channel.write)
     spins = 0
     while pending:
         rest = []
-        for chan, value, tag, cd in pending:
+        for chan, value, tag, cd, ts in pending:
             if cd is _CHAOS_UNDECIDED:
-                ok = chan.try_write_value(value, tag)
+                ok = chan.try_write_value(value, tag, trace_state=ts)
             else:
-                ok = chan.try_write_value(value, tag, cd=cd)
+                ok = chan.try_write_value(value, tag, cd=cd, trace_state=ts)
             if not ok:
-                rest.append((chan, value, tag, cd))
+                rest.append((chan, value, tag, cd, ts))
         if not rest:
             return
         pending = rest

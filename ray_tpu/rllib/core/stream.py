@@ -412,6 +412,7 @@ class TrajectoryPlane:
         runners park in write_value: the whole backpressure chain is
         flow control, never drops."""
         from ray_tpu._private import telemetry
+        from ray_tpu.util import tracing
 
         spins = 0
         while not self._closing:
@@ -422,7 +423,7 @@ class TrajectoryPlane:
                 try:
                     if not rs.traj.pending():
                         continue
-                    _tag, frag = rs.traj.read_value(timeout=10.0)
+                    _tag, frag, tctx = rs.traj.read_value_traced(timeout=10.0)
                 except ChannelCorruptionError:
                     # The fragment is gone and per-runner seqs must stay
                     # contiguous: retire the edge (typed, counted) and
@@ -456,6 +457,7 @@ class TrajectoryPlane:
                         rs.alive = False
                     continue
                 progressed = True
+                t_in = time.time()
                 while not self._closing:
                     try:
                         self.queue.put(frag, timeout=0.2)
@@ -463,6 +465,17 @@ class TrajectoryPlane:
                     except queue.Full:
                         telemetry.set_rllib_queue_depth(self.queue.qsize())
                 telemetry.set_rllib_queue_depth(self.queue.qsize())
+                if tctx is not None:
+                    # Traced fragment: record the intake hop (read → learner
+                    # queue) as a child of the channel.read span, so runner
+                    # traces stay connected through the learner.
+                    tracing.record_span(
+                        "rllib.intake",
+                        t_in,
+                        time.time(),
+                        {"runner": rs.index + 1},
+                        context=(tctx[0], tracing.new_span_id(), tctx[1]),
+                    )
             if progressed:
                 spins = 0
             else:

@@ -92,7 +92,7 @@ class ReplicaDataplane:
                 self._req = self._req_listener.accept("read", timeout=30.0)
             while True:
                 try:
-                    _tag, frame = self._req.read_value(timeout=None)
+                    _tag, frame, tctx = self._req.read_value_traced(timeout=None)
                 except ChannelCorruptionError as e:
                     # The corrupted frame is consumed and its request id
                     # unknowable — nothing wrong is ever dispatched.
@@ -124,54 +124,94 @@ class ReplicaDataplane:
                         self._loop.call_soon_threadsafe(task.cancel)
                     continue
                 asyncio.run_coroutine_threadsafe(
-                    self._dispatch(kind, rid, method, tuple(args), dict(kwargs or {}), model_id),
+                    self._dispatch(
+                        kind, rid, method, tuple(args), dict(kwargs or {}),
+                        model_id, tctx,
+                    ),
                     self._loop,
                 )
         except (ChannelClosed, Exception):  # noqa: BLE001 — rx death = detach
             self.shutdown()
 
-    async def _dispatch(self, kind, rid, method, args, kwargs, model_id) -> None:
+    async def _dispatch(self, kind, rid, method, args, kwargs, model_id,
+                        tctx=None) -> None:
         import asyncio
+        import time as _time
 
         from ray_tpu import exceptions
+        from ray_tpu.util import tracing
 
+        # Adopt the request frame's trace context PER EXECUTION (the
+        # dispatch task owns a fresh contextvar context, so this never
+        # leaks into other requests); engine spans and the response
+        # frames below then chain under the inbound hop.
+        if tctx is not None:
+            tracing.set_frame_context(tctx)
+        t0 = _time.time()
+        put = self._put_frame
         self._tasks[rid] = asyncio.current_task()
         if rid in self._pre_cancelled:
             # the cancel frame won the race with this coroutine
             self._pre_cancelled.discard(rid)
             self._tasks.pop(rid, None)
-            self._out_q.put(("end", rid, None))
+            put(("end", rid, None))
             return
         try:
             if kind == "call":
                 result = await self._replica.handle_request(
                     method, args, kwargs, model_id
                 )
-                self._out_q.put(("r", rid, result))
+                put(("r", rid, result))
             else:
                 agen = self._replica.handle_request_stream(
                     method, args, kwargs, model_id
                 )
                 async for item in agen:
-                    self._out_q.put(("s", rid, item))
-                self._out_q.put(("end", rid, None))
+                    put(("s", rid, item))
+                put(("end", rid, None))
         except asyncio.CancelledError:
-            self._out_q.put(("end", rid, None))
+            put(("end", rid, None))
         except Exception as e:  # noqa: BLE001 — ships to the caller like RPC
-            self._out_q.put(
+            put(
                 ("e", rid, exceptions.RayTaskError.from_exception(e, f"serve.{method}"))
             )
         finally:
             self._tasks.pop(rid, None)
+            if tctx is not None:
+                # The dispatch's own span: the parent every engine span
+                # and response-frame write span links through.
+                tracing.record_span(
+                    f"serve.replica.{kind}", t0, _time.time(),
+                    {"method": method},
+                    context=tracing.current_context(),
+                )
+
+    def _put_frame(self, frame) -> None:
+        """Enqueue a response frame with the dispatch task's trace
+        context attached, so the tx thread's channel write parents
+        correctly (the tx thread itself has no ambient context)."""
+        from ray_tpu.util import tracing
+
+        self._out_q.put((frame, tracing.current_context()))
 
     # -- response side --------------------------------------------------
     def _tx_loop(self) -> None:
+        from ray_tpu.util import tracing
+
         while True:
-            frame = self._out_q.get()
-            if frame is None:
+            item = self._out_q.get()
+            if item is None:
                 return
+            frame, rctx = item
             try:
-                self._resp.write_value(frame, timeout=None)
+                if rctx is not None:
+                    tok = tracing.adopt_context(rctx)
+                    try:
+                        self._resp.write_value(frame, timeout=None)
+                    finally:
+                        tracing.reset_context(tok)
+                else:
+                    self._resp.write_value(frame, timeout=None)
             except (ChannelClosed, Exception):  # noqa: BLE001
                 self.shutdown()
                 return
@@ -369,7 +409,10 @@ class ChannelClient:
         try:
             while True:
                 try:
-                    _tag, frame = self._resp.read_value(timeout=None)
+                    # read_value_traced records the response hop span
+                    # (write→read queue wait); the frame context itself
+                    # ends here — the waiter thread owns the caller span.
+                    _tag, frame, _tctx = self._resp.read_value_traced(timeout=None)
                 except ChannelCorruptionError:
                     # A response frame is gone and its request id with
                     # it: the waiter would hang, so the affected client

@@ -202,6 +202,18 @@ class Router:
         ChannelFuture, replica_id).  Callers MUST call `done(replica_id)`
         when the response resolves so the in-flight estimate stays
         honest."""
+        from ray_tpu.util import tracing
+
+        if tracing.current_context() is not None:
+            # Traced request: the pick + send ride a router span so the
+            # request frame's channel.write parents here and the
+            # timeline shows the router_queue segment.  Untraced
+            # requests pay one contextvar read.
+            with tracing.start_span("serve.router", {"method": method}):
+                return self._route(method, args, kwargs, multiplexed_model_id)
+        return self._route(method, args, kwargs, multiplexed_model_id)
+
+    def _route(self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""):
         r = self.pick(multiplexed_model_id)
         rid = r["replica_id"]
         # route()/done() run concurrently from proxy executor threads:
@@ -227,6 +239,15 @@ class Router:
         ChannelStream multiplexed over the replica's dataplane when
         attached (one frame per token, no object-store hops), else an
         item-ref generator via the actor streaming plane."""
+        from ray_tpu.util import tracing
+
+        if tracing.current_context() is not None:
+            with tracing.start_span("serve.router", {"method": method}):
+                return self._route_stream(method, args, kwargs, multiplexed_model_id)
+        return self._route_stream(method, args, kwargs, multiplexed_model_id)
+
+    def _route_stream(self, method: str, args: tuple, kwargs: dict,
+                      multiplexed_model_id: str = ""):
         r = self.pick(multiplexed_model_id)
         rid = r["replica_id"]
         with self._lock:

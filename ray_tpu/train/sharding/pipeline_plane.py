@@ -439,7 +439,17 @@ class PipelineStage:
         checkpoint-restart owns that)."""
         while True:
             try:
-                _tag, value = chan.read_value(timeout=5.0)
+                _tag, value, tctx = chan.read_value_traced(timeout=5.0)
+                if tctx is not None:
+                    # Adopt the inbound microbatch's trace context for this
+                    # stage thread: downstream edge writes (act_out/grad_out)
+                    # parent under it, so a step's trace crosses every stage.
+                    # Untraced frames leave the context alone — interleaved
+                    # 1F1B reads on one thread must not sever a traced
+                    # step's chain mid-schedule.
+                    from ray_tpu.util import tracing
+
+                    tracing.set_frame_context(tctx)
                 return value
             except ChannelTimeout:
                 if self._stop.is_set():

@@ -204,6 +204,120 @@ def traces(limit: int = 100_000) -> List[Dict[str, Any]]:
     return group_traces(spans(limit))
 
 
+_DP_HOP_SPANS = ("channel.write", "channel.read", "channel.reattach")
+# Queue-wait histogram bounds (seconds): log-spaced from 10µs to 10s.
+_DP_QW_BOUNDS = (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def build_dataplane(
+    span_records: List[Dict[str, Any]],
+    metric_records: List[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Pure merge of channel hop spans and ``channel_*`` counter
+    aggregates into the live hot-path health view (shared by
+    ``util.state.dataplane()`` and the dashboard's ``/api/dataplane``,
+    which has no connected worker).
+
+    Per-edge stats come from sampled ``channel.write`` / ``channel.read``
+    / ``channel.reattach`` spans grouped by their ``path`` attribute
+    (the channel endpoint is the edge identity); a ``channel.read``
+    span's duration is the frame's queue wait, so each edge carries a
+    queue-wait p50/p95/max plus a log-bucketed histogram.  Cluster-wide
+    counters (every op, not just sampled ones) ride alongside from the
+    GCS metric table."""
+    edges: Dict[str, Dict[str, Any]] = {}
+    for s in span_records:
+        name = s.get("name")
+        if name not in _DP_HOP_SPANS:
+            continue
+        attrs = s.get("attributes") or {}
+        path = str(attrs.get("path", "?"))
+        e = edges.get(path)
+        if e is None:
+            e = edges[path] = {
+                "path": path,
+                "kind": attrs.get("kind"),
+                "writes": 0,
+                "reads": 0,
+                "reattaches": 0,
+                "reattach_failures": 0,
+                "last_epoch": None,
+                "pids": set(),
+                "_qw": [],
+            }
+        if attrs.get("kind"):
+            e["kind"] = attrs["kind"]
+        if s.get("pid") is not None:
+            e["pids"].add(s["pid"])
+        if name == "channel.write":
+            e["writes"] += 1
+        elif name == "channel.read":
+            e["reads"] += 1
+            qw = attrs.get("queue_wait_s")
+            if isinstance(qw, (int, float)):
+                e["_qw"].append(float(qw))
+        else:  # channel.reattach
+            e["reattaches"] += 1
+            if attrs.get("result") != "ok":
+                e["reattach_failures"] += 1
+            if attrs.get("epoch") is not None:
+                e["last_epoch"] = attrs["epoch"]
+    out_edges = []
+    for e in sorted(edges.values(), key=lambda e: e["path"]):
+        qw = sorted(e.pop("_qw"))
+        counts = [0] * (len(_DP_QW_BOUNDS) + 1)
+        for v in qw:
+            for i, b in enumerate(_DP_QW_BOUNDS):
+                if v <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+        e["pids"] = sorted(e["pids"], key=str)
+        e["queue_wait"] = {
+            "count": len(qw),
+            "p50_s": _quantile(qw, 0.50),
+            "p95_s": _quantile(qw, 0.95),
+            "max_s": qw[-1] if qw else 0.0,
+            "histogram": {"bounds_s": list(_DP_QW_BOUNDS), "counts": counts},
+        }
+        out_edges.append(e)
+    counters: Dict[str, Any] = {}
+    for m in metric_records:
+        name = m.get("name", "")
+        if not (name.startswith("channel_") or name.startswith("socket_channel_")):
+            continue
+        if m.get("type") != "counter":
+            continue
+        tags = m.get("tags") or {}
+        if tags:
+            sub = counters.setdefault(name, {})
+            sub["|".join(f"{k}={v}" for k, v in sorted(tags.items()))] = m.get("value", 0)
+        else:
+            counters[name] = m.get("value", 0)
+    return {"edges": out_edges, "counters": counters}
+
+
+def dataplane(limit: int = 100_000) -> Dict[str, Any]:
+    """Live dataplane health: per-channel-edge hop/queue-wait stats
+    derived from sampled trace spans, merged with the cluster-wide
+    ``channel_*`` counters (docs/observability.md, "Dataplane
+    tracing")."""
+    span_records = spans(limit)
+    try:
+        metric_records = metrics()
+    except Exception:
+        metric_records = []
+    return build_dataplane(span_records, metric_records)
+
+
 _CP_OVERLAP_SLACK_S = 1e-6  # clock-jitter tolerance between siblings
 
 

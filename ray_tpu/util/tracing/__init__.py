@@ -53,6 +53,37 @@ def _new_span_id() -> str:
     return secrets.token_hex(8)
 
 
+def new_span_id() -> str:
+    """Mint a span id (public: channel hops mint per-frame write spans)."""
+    return _new_span_id()
+
+
+def set_frame_context(frame_ctx: Optional[Tuple[str, str]]) -> Any:
+    """Adopt an inbound dataplane frame's trace context: enter a child
+    of ``(trace_id, parent_span_id)`` — or CLEAR the context when the
+    frame is untraced (``None``), so an executor that serves many
+    requests never parents one request's spans under a stale context
+    captured at actor start.  Returns a token for :func:`reset_context`."""
+    if frame_ctx is None:
+        return _ctx.set(None)
+    return _ctx.set((frame_ctx[0], _new_span_id(), frame_ctx[1]))
+
+
+def reset_context(token: Any) -> None:
+    """Undo a :func:`set_frame_context` (restores the previous context)."""
+    _ctx.reset(token)
+
+
+def adopt_context(
+    ctx: Optional[Tuple[str, str, Optional[str]]]
+) -> Any:
+    """Set this thread's context to an EXACT ``(trace_id, span_id,
+    parent_span_id)`` tuple (or ``None``) without minting — for worker
+    threads (e.g. a channel tx thread) acting on behalf of a task whose
+    span the tuple names.  Returns a token for :func:`reset_context`."""
+    return _ctx.set(ctx)
+
+
 def format_traceparent(trace_id: str, span_id: str) -> str:
     return f"00-{trace_id}-{span_id}-01"
 
@@ -279,7 +310,14 @@ def flush() -> bool:
         return True
     from ray_tpu.util import metrics as _metrics
 
-    if _metrics.report("span_report", {"reporter": _metrics.reporter_id(), "spans": pending}):
+    payload = {
+        "reporter": _metrics.reporter_id(),
+        # Per-tenant accounting in the GCS span table (the raylet stamps
+        # RAY_TPU_TENANT into worker environments).
+        "tenant": os.environ.get("RAY_TPU_TENANT") or "default",
+        "spans": pending,
+    }
+    if _metrics.report("span_report", payload):
         with _span_lock:
             if _drain_epoch == base_epoch:
                 # Shift the snapshot index by whatever the ring trimmed

@@ -40,8 +40,8 @@ DEFAULT_THRESHOLD = 0.15
 # seconds-ish suffix — they must not match the latency patterns.
 _RATE = re.compile(r"per_s(ec)?$")
 _LOWER_IS_BETTER = re.compile(
-    r"(latency|seconds|_s$|_ms$|p50|p95|p99|ttft|shed|leak|error|fail|drop"
-    r"|evict|timeout|blocks_after)"
+    r"(latency|seconds|_s$|_ms$|_us\b|rtt|p50|p95|p99|ttft|shed|leak|error"
+    r"|fail|drop|evict|timeout|blocks_after)"
 )
 
 

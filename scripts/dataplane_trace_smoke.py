@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Dataplane trace smoke: on a two-raylet cluster, drive one traced
+serve call over the channel dataplane and one traced compiled-DAG
+execution across a socket edge, then assert both come back as SINGLE
+connected traces — every span's parent resolves inside its trace
+(orphan-span count 0) and each trace spans at least two processes.
+
+Run by scripts/verify.sh after tier-1; standalone:
+    JAX_PLATFORMS=cpu python scripts/dataplane_trace_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# sys.path[0] is scripts/; the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _orphans(group):
+    ids = {s["span_id"] for s in group}
+    return [
+        s for s in group
+        if s.get("parent_span_id") and s["parent_span_id"] not in ids
+    ]
+
+
+def _wait_connected(trace_id, want_names, deadline_s=45.0):
+    """Spans ship on the ~1 s flusher cadence from every process: poll
+    until the trace has all of ``want_names`` and zero orphans."""
+    from ray_tpu.util import state
+
+    group, names = [], set()
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        group = [s for s in state.spans() if s.get("trace_id") == trace_id]
+        names = {s.get("name") for s in group}
+        if want_names <= names and not _orphans(group):
+            return group
+        time.sleep(0.5)
+    raise AssertionError(
+        f"trace {trace_id}: wanted {sorted(want_names)}, have {sorted(names)}, "
+        f"orphans {[(s['name'], s['parent_span_id']) for s in _orphans(group)]}"
+    )
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import tracing
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.add_node(num_cpus=2, resources={"edge": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    try:
+        # --- serve call over the channel dataplane -------------------
+        @serve.deployment(name="TraceSmokeDep")
+        class TraceSmokeDep:
+            def __call__(self, x):
+                return x * 10
+
+        h = serve.run(TraceSmokeDep.bind(), name="trace_smoke_app")
+        assert h.remote(1).result(timeout=60) == 10  # attach + warm
+        with tracing.start_span("smoke.serve") as serve_root:
+            assert h.remote(7).result(timeout=60) == 70
+
+        serve_group = _wait_connected(
+            serve_root.trace_id,
+            {"smoke.serve", "serve.router", "channel.write", "channel.read"},
+        )
+        serve_pids = {s.get("pid") for s in serve_group}
+        if len(serve_pids) < 2:
+            print(f"dataplane trace smoke: FAIL (serve trace pids={serve_pids})")
+            return 1
+
+        # --- compiled-DAG execution across a socket edge -------------
+        @ray_tpu.remote(resources={"edge": 0.1})
+        class Far:
+            def step(self, x):
+                return x + 1000
+
+        far = Far.bind()
+        with InputNode() as inp:
+            dag = far.step.bind(inp)
+        compiled = dag.experimental_compile(max_inflight=4)
+        try:
+            assert "socket" in {d["kind"] for d in compiled._descs.values()}
+            assert ray_tpu.get(compiled.execute(0), timeout=60) == 1000  # warm
+            with tracing.start_span("smoke.dag") as dag_root:
+                assert ray_tpu.get(compiled.execute(5), timeout=60) == 1005
+
+            dag_group = _wait_connected(
+                dag_root.trace_id,
+                {"smoke.dag", "channel.write", "channel.read", "dag.op"},
+            )
+            dag_pids = {s.get("pid") for s in dag_group}
+            if len(dag_pids) < 2:
+                print(f"dataplane trace smoke: FAIL (dag trace pids={dag_pids})")
+                return 1
+            kinds = {
+                (s.get("attributes") or {}).get("kind")
+                for s in dag_group if s.get("name", "").startswith("channel.")
+            }
+            if "socket" not in kinds:
+                print(f"dataplane trace smoke: FAIL (no socket hop traced: {kinds})")
+                return 1
+        finally:
+            compiled.teardown()
+
+        orphan_count = len(_orphans(serve_group)) + len(_orphans(dag_group))
+        if orphan_count:
+            print(f"dataplane trace smoke: FAIL (orphan spans: {orphan_count})")
+            return 1
+        print(
+            "dataplane trace smoke: OK "
+            f"(serve trace {len(serve_group)} spans/{len(serve_pids)} pids, "
+            f"dag trace {len(dag_group)} spans/{len(dag_pids)} pids, 0 orphans)"
+        )
+        return 0
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,19 @@ if [ "${RAY_TPU_SKIP_OBS_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Dataplane trace smoke (trace-context propagation end-to-end): 2-raylet
+# cluster, one traced serve call over the channel dataplane + one traced
+# compiled-DAG execution across a socket edge — both come back as single
+# connected traces spanning >=2 processes with zero orphan spans.
+# Skippable via RAY_TPU_SKIP_DATAPLANE_SMOKE=1.
+if [ "${RAY_TPU_SKIP_DATAPLANE_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 150 env JAX_PLATFORMS=cpu \
+      python scripts/dataplane_trace_smoke.py; then
+    echo "dataplane trace smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Drain smoke (graceful node drain end-to-end): 2-node local cluster,
 # drain a node hosting a live actor + sole-copy object, assert the actor
 # migrates, the object survives the kill, and util.state + /api/nodes

@@ -510,6 +510,72 @@ def test_generation_key_exempts_canonical_module(tmp_path):
     assert v == []
 
 
+# ---------------------------------------------------------------- trace-orphan
+
+BAD_AMBIENT_RECORD_SPAN = """
+    from ray_tpu.util import tracing
+
+    def on_frame(t0, t1):
+        tracing.record_span("serve.replica.call", t0, t1, {"method": "f"})
+"""
+
+GOOD_EXPLICIT_FRAME_CONTEXT = """
+    from ray_tpu.util import tracing
+
+    def on_frame(t0, t1, tctx):
+        tracing.record_span(
+            "serve.replica.call", t0, t1, {"method": "f"},
+            context=(tctx[0], tracing.new_span_id(), tctx[1]),
+        )
+"""
+
+GOOD_EXPLICIT_AMBIENT_CONTEXT = """
+    from ray_tpu.util import tracing
+
+    def on_frame(t0, t1):
+        tracing.record_span(
+            "serve.replica.call", t0, t1, None,
+            context=tracing.current_context(),
+        )
+"""
+
+GOOD_EVENT_AND_START_SPAN = """
+    from ray_tpu.util import tracing
+
+    def on_compile(t0, t1):
+        tracing.record_event_span("jax.compile", t0, t1, {"fn": "step"})
+        with tracing.start_span("serve.router", {"method": "f"}):
+            pass
+"""
+
+
+def test_trace_orphan_flags_ambient_record_span(tmp_path):
+    v = lint_source(tmp_path, BAD_AMBIENT_RECORD_SPAN, ["trace-orphan"])
+    assert len(v) == 1 and v[0].check == "trace-orphan"
+    assert "context=" in v[0].message
+
+
+def test_trace_orphan_passes_explicit_frame_context(tmp_path):
+    assert lint_source(tmp_path, GOOD_EXPLICIT_FRAME_CONTEXT, ["trace-orphan"]) == []
+
+
+def test_trace_orphan_passes_explicit_ambient_context(tmp_path):
+    # context=tracing.current_context() is the same read, stated.
+    assert lint_source(tmp_path, GOOD_EXPLICIT_AMBIENT_CONTEXT, ["trace-orphan"]) == []
+
+
+def test_trace_orphan_allows_event_and_start_span(tmp_path):
+    assert lint_source(tmp_path, GOOD_EVENT_AND_START_SPAN, ["trace-orphan"]) == []
+
+
+def test_trace_orphan_exempts_tracing_module(tmp_path):
+    v = lint_source(
+        tmp_path, BAD_AMBIENT_RECORD_SPAN, ["trace-orphan"],
+        filename="ray_tpu/util/tracing/__init__.py",
+    )
+    assert v == []
+
+
 # ------------------------------------------------- suppressions and baseline
 
 def test_inline_disable_with_reason_suppresses(tmp_path):

@@ -358,6 +358,40 @@ def test_instrumentation_overhead_budget(obs):
     )
 
 
+def test_dataplane_trailer_overhead_budget():
+    """Trace propagation must be free when off: an untraced frame is
+    byte-identical to a pre-trailer encode (ZERO trailer bytes on the
+    wire — the strongest possible zero-serialization-cost proof, and
+    deterministic where a timing ratio flakes on a loaded 1-core box),
+    a traced frame pays exactly TRACE_LEN extra, and both decode
+    transparently.  The TIMING half of the guard is the bench gate:
+    bench_micro.py channel_rtt_us_untraced vs the checked-in
+    BENCH_micro_head.json capture, compared like-for-like by
+    bench_gate.py."""
+    from ray_tpu._private import wire
+    from ray_tpu.util import tracing
+
+    payload = {"prompt": list(range(16)), "max_tokens": 8}
+    plain = wire.encode(payload, tag=3)
+    assert plain[0] & wire.TRACE_FLAG == 0
+    # no ambient context -> channels pass trace=None -> identical bytes
+    assert wire.encode(payload, tag=3, trace=None) == plain
+
+    trace = ("ab" * 16, "cd" * 8, 0, time.time())
+    traced = wire.encode(payload, tag=3, trace=trace)
+    assert traced[0] & wire.TRACE_FLAG
+    assert len(traced) == len(plain) + wire.TRACE_LEN
+
+    # both decode transparently; decode_traced surfaces the context
+    assert wire.decode(memoryview(plain))[1] == payload
+    assert wire.decode(memoryview(traced))[1] == payload
+    tag, val, tctx = wire.decode_traced(memoryview(traced))
+    assert (tag, val) == (3, payload) and tctx[0] == "ab" * 16
+    tag, val, tctx = wire.decode_traced(memoryview(plain))
+    assert (tag, val, tctx) == (3, payload, None)
+    assert tracing.current_context() is None
+
+
 def test_telemetry_kill_switch():
     """telemetry_enabled=False turns every instrumentation site into a
     boolean check and records nothing new."""
