@@ -10,9 +10,11 @@ transport, overload behavior — not model quality):
    width, mixed request lengths.  Continuous must win on tokens/s: the
    static batch pays the drain barrier (every batch runs to its LAST
    member while short members' lanes idle).
-2. **stream drill** — 1k+ concurrent token streams through one
-   deployment: p50/p99 end-to-end latency, p50/p99 TTFT, aggregate
-   tokens/s, all streams complete.
+2. **stream drill** — 4k concurrent token streams through one
+   deployment (stepping toward the 10k target): p50/p99 end-to-end
+   latency, p50/p99 TTFT, aggregate tokens/s, all streams complete.
+   Records stamp the stream count so bench_gate --compare refuses to
+   score a resized drill against an older, smaller one.
 3. **shed** — flood a small-queue deployment far past its bound: the
    overflow is shed with typed errors (engine) while every admitted
    request completes; records the shed rate.
@@ -25,7 +27,7 @@ Hardware caveats: same 1-core CI box as BENCH_micro — the transport
 tiny model's decode math, and loadavg swings absolute numbers; every
 record carries the loadavg annotation.
 
-Run: python bench_serve.py [--out BENCH_serve.json] [--streams 1024]
+Run: python bench_serve.py [--out BENCH_serve.json] [--streams 4096]
 """
 
 from __future__ import annotations
@@ -157,9 +159,9 @@ def phase_throughput(out, n_requests=192, concurrency=48, width=16):
 
 
 # ----------------------------------------------------------------------
-# phase 2: 1k+ concurrent stream drill
+# phase 2: 4k concurrent stream drill (toward the 10k target)
 # ----------------------------------------------------------------------
-def phase_stream_drill(out, n_streams=1024, max_tokens=12, width=32):
+def phase_stream_drill(out, n_streams=4096, max_tokens=12, width=32):
     app = llm.build_app(
         llm.LLMConfig(model="tiny", max_batch_size=width, num_blocks=1024,
                       block_size=8, max_queue=n_streams + 64,
@@ -211,14 +213,22 @@ def phase_stream_drill(out, n_streams=1024, max_tokens=12, width=32):
     lat = sorted(s["t_done"] - s["t_open"] for s in done)
     ttft = sorted(s["t_first"] - s["t_open"] for s in done if s["t_first"])
     wall = t_end - t_start
+    # workload provenance: `streams` on every drill record lets
+    # bench_gate --compare refuse latency comparisons across drill
+    # resizes (a 4x-larger drill is a workload change, not a perf one)
     record(out, "serve_stream_drill_streams", len(done), "streams",
            requested=n_streams, open_time_s=round(t_opened - t_start, 2))
     record(out, "serve_stream_drill_tokens_per_s", total_tokens / wall,
-           "tokens/s", total_tokens=total_tokens, wall_s=round(wall, 2))
-    record(out, "serve_stream_drill_latency_p50", _pct(lat, 50), "s")
-    record(out, "serve_stream_drill_latency_p99", _pct(lat, 99), "s")
-    record(out, "serve_stream_drill_ttft_p50", _pct(ttft, 50), "s")
-    record(out, "serve_stream_drill_ttft_p99", _pct(ttft, 99), "s")
+           "tokens/s", total_tokens=total_tokens, wall_s=round(wall, 2),
+           streams=n_streams)
+    record(out, "serve_stream_drill_latency_p50", _pct(lat, 50), "s",
+           streams=n_streams)
+    record(out, "serve_stream_drill_latency_p99", _pct(lat, 99), "s",
+           streams=n_streams)
+    record(out, "serve_stream_drill_ttft_p50", _pct(ttft, 50), "s",
+           streams=n_streams)
+    record(out, "serve_stream_drill_ttft_p99", _pct(ttft, 99), "s",
+           streams=n_streams)
     st = handle.stats.remote().result(timeout=30)
     assert st["kv_blocks_in_use"] == 0, st["kv_leak_report"]
     record(out, "serve_stream_drill_kv_blocks_after", st["kv_blocks_in_use"],
@@ -446,7 +456,7 @@ def phase_chaos(out, n_streams=128, max_tokens=60):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_serve.json")
-    ap.add_argument("--streams", type=int, default=1024)
+    ap.add_argument("--streams", type=int, default=4096)
     ap.add_argument("--skip-chaos", action="store_true")
     args = ap.parse_args()
 

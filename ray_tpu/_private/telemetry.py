@@ -214,8 +214,34 @@ class _Metrics:
         self.serve_shed = m.Counter(
             "serve_shed_total",
             "requests shed by overload protection, by where (proxy = "
-            "per-deployment in-flight bound, engine = waiting-queue bound)",
-            tag_keys=("deployment", "where"),
+            "per-deployment in-flight bound, quota = per-tenant token "
+            "bucket, engine = waiting-queue bound, brownout = degradation "
+            "ladder) and tenant (clamped to quota'd tenants + default/other)",
+            tag_keys=("deployment", "where", "tenant"),
+        )
+        self.serve_preemptions = m.Counter(
+            "serve_preemptions_total",
+            "decode lanes preempted-by-recompute so a higher-priority "
+            "request could run, by the VICTIM's SLO class",
+            tag_keys=("deployment", "slo"),
+        )
+        self.serve_degradation_level = m.Gauge(
+            "serve_degradation_level",
+            "brownout ladder level (0 normal, 1 batch max_tokens clamped, "
+            "2 batch shed, 3 standard shed; interactive is never shed)",
+            tag_keys=("deployment",),
+        )
+        self.serve_tenant_tokens_per_s = m.Gauge(
+            "serve_tenant_tokens_per_s",
+            "tokens generated per second attributed to one tenant (5 s "
+            "sliding window; tenant clamped to quota'd + default/other)",
+            tag_keys=("deployment", "tenant"),
+        )
+        self.serve_multiplex_evictions = m.Counter(
+            "serve_multiplex_evictions_total",
+            "multiplexed model variants evicted from a replica's LRU cache "
+            "to admit a different model_id",
+            tag_keys=("deployment",),
         )
         # --- profiling & bottleneck-attribution plane ---
         self.profile_sessions = m.Counter(
@@ -674,12 +700,60 @@ def observe_serve_ttft(deployment: str, seconds: float) -> None:
     b.observe(max(0.0, seconds))
 
 
-def count_serve_shed(deployment: str, where: str, n: int = 1) -> None:
+def count_serve_shed(deployment: str, where: str, n: int = 1,
+                     tenant: str = "default") -> None:
     if not enabled():
         return
-    b = _serve_shed_bound.get((deployment, where)) or _bind(
-        _serve_shed_bound, (deployment, where), "serve_shed",
-        {"deployment": deployment, "where": where},
+    key = (deployment, where, tenant)
+    b = _serve_shed_bound.get(key) or _bind(
+        _serve_shed_bound, key, "serve_shed",
+        {"deployment": deployment, "where": where, "tenant": tenant},
+    )
+    b.inc(float(n))
+
+
+_serve_preempt_bound: dict = {}
+_serve_tenant_tok_bound: dict = {}
+_serve_mx_evict_bound: dict = {}
+
+
+def count_serve_preemption(deployment: str, slo: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    key = (deployment, slo)
+    b = _serve_preempt_bound.get(key) or _bind(
+        _serve_preempt_bound, key, "serve_preemptions",
+        {"deployment": deployment, "slo": slo},
+    )
+    b.inc(float(n))
+
+
+def set_serve_degradation(deployment: str, level: int) -> None:
+    if not enabled():
+        return
+    _metrics().serve_degradation_level.set(
+        float(level), tags={"deployment": deployment}
+    )
+
+
+def set_serve_tenant_tokens_per_s(deployment: str, tenant: str,
+                                  rate: float) -> None:
+    if not enabled():
+        return
+    key = (deployment, tenant)
+    b = _serve_tenant_tok_bound.get(key) or _bind(
+        _serve_tenant_tok_bound, key, "serve_tenant_tokens_per_s",
+        {"deployment": deployment, "tenant": tenant},
+    )
+    b.set(max(0.0, rate))
+
+
+def count_serve_multiplex_eviction(deployment: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    b = _serve_mx_evict_bound.get(deployment) or _bind(
+        _serve_mx_evict_bound, deployment, "serve_multiplex_evictions",
+        {"deployment": deployment},
     )
     b.inc(float(n))
 

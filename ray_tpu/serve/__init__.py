@@ -31,10 +31,16 @@ from ray_tpu.serve.handle import (
     DeploymentResponseGenerator,
 )
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve._private.request_context import (
+    get_request_slo,
+    get_request_tenant,
+)
 
 __all__ = [
     "multiplexed",
     "get_multiplexed_model_id",
+    "get_request_tenant",
+    "get_request_slo",
     "deploy_config",
     "ServeDeploySchema",
     "ApplicationSchema",

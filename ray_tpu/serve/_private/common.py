@@ -31,6 +31,10 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     version: str = "1"
     user_config: Any = None
+    # per-tenant token-rate quotas {"tenant": {"rate": tok/s, "burst":
+    # tokens}}, enforced at the proxy (flows there via the route table);
+    # empty = no quotas (docs/serving.md "Overload resilience")
+    tenant_quotas: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

@@ -273,6 +273,7 @@ class ServeController(LongPollHost):
                     "max_queued_requests": dep["config"].get(
                         "max_queued_requests", -1
                     ),
+                    "tenant_quotas": dep["config"].get("tenant_quotas") or {},
                 }
                 for name, dep in self.deployments.items()
                 if dep["config"].get("route_prefix") != ""

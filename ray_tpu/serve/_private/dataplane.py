@@ -11,8 +11,13 @@ submission, no object store, no pickling for fast-path payloads.
 
 Frames (wire-encoded tuples):
 
-    router → replica:  (kind, req_id, method, args, kwargs, model_id)
+    router → replica:  (kind, req_id, method, args, kwargs, model_id
+                        [, request_meta])
                        kind = "call" | "stream" | "cancel"
+                       request_meta: optional identity dict ({"tenant",
+                       "slo"}) — receivers slice ``frame[:6]`` and treat
+                       the 7th element as optional, so 6-tuple senders
+                       (cancel frames, older routers) stay compatible
     replica → router:  (kind, req_id, payload)
                        kind = "r" result | "s" stream item |
                               "end" stream end | "e" error (RayTaskError)
@@ -111,7 +116,8 @@ class ReplicaDataplane:
                     if reattach(self._req):
                         continue
                     raise
-                kind, rid, method, args, kwargs, model_id = frame
+                kind, rid, method, args, kwargs, model_id = frame[:6]
+                meta = frame[6] if len(frame) > 6 else None
                 if kind == "cancel":
                     # park-then-recheck (the dispatch does the mirrored
                     # register-then-check): whichever side runs second
@@ -126,7 +132,7 @@ class ReplicaDataplane:
                 asyncio.run_coroutine_threadsafe(
                     self._dispatch(
                         kind, rid, method, tuple(args), dict(kwargs or {}),
-                        model_id, tctx,
+                        model_id, tctx, meta,
                     ),
                     self._loop,
                 )
@@ -134,7 +140,7 @@ class ReplicaDataplane:
             self.shutdown()
 
     async def _dispatch(self, kind, rid, method, args, kwargs, model_id,
-                        tctx=None) -> None:
+                        tctx=None, request_meta=None) -> None:
         import asyncio
         import time as _time
 
@@ -159,12 +165,12 @@ class ReplicaDataplane:
         try:
             if kind == "call":
                 result = await self._replica.handle_request(
-                    method, args, kwargs, model_id
+                    method, args, kwargs, model_id, request_meta
                 )
                 put(("r", rid, result))
             else:
                 agen = self._replica.handle_request_stream(
-                    method, args, kwargs, model_id
+                    method, args, kwargs, model_id, request_meta
                 )
                 async for item in agen:
                     put(("s", rid, item))
@@ -466,24 +472,28 @@ class ChannelClient:
             self._req.write_value(frame)
 
     # -- public ---------------------------------------------------------
-    def call(self, method: str, args: tuple, kwargs: dict, model_id: str = "") -> ChannelFuture:
+    def call(self, method: str, args: tuple, kwargs: dict, model_id: str = "",
+             request_meta: Optional[dict] = None) -> ChannelFuture:
         from ray_tpu._private import telemetry
 
         rid, q = self._register()
         try:
-            self._send(("call", rid, method, tuple(args), dict(kwargs or {}), model_id))
+            self._send(("call", rid, method, tuple(args), dict(kwargs or {}),
+                        model_id, request_meta))
         except Exception:
             self._done(rid)
             raise
         telemetry.count_serve_dataplane_request("call")
         return ChannelFuture(self, rid, q)
 
-    def stream(self, method: str, args: tuple, kwargs: dict, model_id: str = "") -> ChannelStream:
+    def stream(self, method: str, args: tuple, kwargs: dict, model_id: str = "",
+               request_meta: Optional[dict] = None) -> ChannelStream:
         from ray_tpu._private import telemetry
 
         rid, q = self._register()
         try:
-            self._send(("stream", rid, method, tuple(args), dict(kwargs or {}), model_id))
+            self._send(("stream", rid, method, tuple(args), dict(kwargs or {}),
+                        model_id, request_meta))
         except Exception:
             self._done(rid)
             raise

@@ -25,6 +25,12 @@ class ProxyActor:
         # Retry-After) so overload degrades instead of queueing unboundedly
         self._inflight: Dict[str, int] = {}
         self._shed: Dict[str, int] = {}
+        # deployment -> TenantBuckets (token-rate quota admission, built
+        # from the route table's tenant_quotas; rebuilt only when the
+        # quota table actually changes so bucket state survives pushes)
+        self._tenant_buckets: Dict[str, Any] = {}
+        # deployment -> tenant label -> quota-shed count (/-/stats)
+        self._shed_tenant: Dict[str, Dict[str, int]] = {}
         self._started = False
         # Dedicated pool for routing: pick() can block up to 30s during a
         # cold start — on the shared default executor a burst of such
@@ -94,6 +100,7 @@ class ProxyActor:
                     "max_queued_requests": dep["config"].get(
                         "max_queued_requests", -1
                     ),
+                    "tenant_quotas": dep["config"].get("tenant_quotas") or {},
                 }
                 for name, dep in deployments.items()
                 if dep["config"].get("route_prefix") != ""  # "" = unrouted
@@ -101,7 +108,7 @@ class ProxyActor:
         )
 
     # -- load shedding ---------------------------------------------------
-    def _try_admit(self, name: str):
+    def _try_admit(self, name: str, tenant: str = ""):
         """Admit one request against the deployment's in-flight bound;
         returns the 503 response when shed, else None (admitted — the
         caller MUST balance with _release)."""
@@ -111,7 +118,9 @@ class ProxyActor:
             self._shed[name] = self._shed.get(name, 0) + 1
             from ray_tpu._private import telemetry
 
-            telemetry.count_serve_shed(name, "proxy")
+            telemetry.count_serve_shed(
+                name, "proxy", tenant=self._tenant_label(name, tenant)
+            )
             from aiohttp import web
 
             return web.Response(
@@ -124,6 +133,77 @@ class ProxyActor:
 
     def _release(self, name: str):
         self._inflight[name] = max(0, self._inflight.get(name, 1) - 1)
+
+    # -- per-tenant token-rate quotas ------------------------------------
+    def _buckets_for(self, name: str):
+        """The deployment's TenantBuckets, rebuilt only when its quota
+        table changed (bucket levels survive unrelated route pushes)."""
+        from ray_tpu.serve.llm.overload import TenantBuckets
+
+        quotas = self._route_cfg.get(name, {}).get("tenant_quotas") or {}
+        tb = self._tenant_buckets.get(name)
+        if tb is None or tb.quotas != quotas:
+            tb = self._tenant_buckets[name] = TenantBuckets(quotas)
+        return tb
+
+    def _tenant_label(self, name: str, tenant: str) -> str:
+        from ray_tpu._private.tenants import tenant_label
+
+        quotas = self._route_cfg.get(name, {}).get("tenant_quotas") or {}
+        return tenant_label(tenant, quotas.keys())
+
+    @staticmethod
+    def _identity(request, payload) -> tuple:
+        """(tenant, slo) from headers / payload fields (payload wins)."""
+        tenant = request.headers.get("x-serve-tenant", "")
+        slo = request.headers.get("x-serve-slo", "")
+        if isinstance(payload, dict):
+            tenant = str(payload.get("tenant") or tenant)
+            slo = str(payload.get("slo") or payload.get("slo_class") or slo)
+        return tenant, slo
+
+    @staticmethod
+    def _estimate_tokens(payload) -> tuple:
+        """(prompt_est, total_est): the worst-case token cost charged at
+        admission — prompt length (byte-level tokenizer: bytes) plus the
+        requested max_tokens.  Completion refunds the unused part."""
+        prompt = payload.get("prompt", "") if isinstance(payload, dict) else payload
+        if isinstance(prompt, (list, tuple)):
+            prompt_est = len(prompt)
+        elif isinstance(prompt, str):
+            prompt_est = len(prompt.encode("utf-8"))
+        else:
+            prompt_est = 0
+        mt = 32
+        if isinstance(payload, dict):
+            try:
+                mt = max(1, int(payload.get("max_tokens") or 32))
+            except (TypeError, ValueError):
+                mt = 32
+        return prompt_est, prompt_est + mt
+
+    def _quota_admit(self, name: str, tenant: str, est: float):
+        """Charge ``est`` tokens to the tenant's bucket; returns the 429
+        response when over quota (shed lands on THIS tenant's counters),
+        else None (charged — unused tokens must be refunded)."""
+        tb = self._buckets_for(name)
+        ok, retry_after = tb.charge(tenant or "default", est)
+        if ok:
+            return None
+        label = self._tenant_label(name, tenant)
+        per_dep = self._shed_tenant.setdefault(name, {})
+        per_dep[label] = per_dep.get(label, 0) + 1
+        from ray_tpu._private import telemetry
+
+        telemetry.count_serve_shed(name, "quota", tenant=label)
+        from aiohttp import web
+
+        return web.Response(
+            status=429,
+            headers={"Retry-After": str(max(1, int(retry_after)))},
+            text=(f"tenant {label!r} is over its token-rate quota for "
+                  f"deployment {name}; retry"),
+        )
 
     @staticmethod
     def _shed_retry_after(e) -> str:
@@ -160,10 +240,16 @@ class ProxyActor:
         from aiohttp import web
 
         return web.json_response(
-            {"inflight": dict(self._inflight), "shed": dict(self._shed)}
+            {
+                "inflight": dict(self._inflight),
+                "shed": dict(self._shed),
+                "shed_tenant": {k: dict(v) for k, v in self._shed_tenant.items()},
+            }
         )
 
-    async def _handle_stream(self, request, handle, payload, name: str):
+    async def _handle_stream(self, request, handle, payload, name: str,
+                             tenant: str = "", charged: int = 0,
+                             prompt_est: int = 0, buckets=None):
         """Chunked response over a generator deployment: each yielded
         item becomes one chunk (json for dict/list, utf-8 text, raw
         bytes pass through); reference: http_util.py Response streaming.
@@ -181,6 +267,22 @@ class ProxyActor:
 
         from ray_tpu.serve.exceptions import RequestShedError
 
+        # quota refund (satellite: disconnect/cancel must give back the
+        # tenant's in-flight charge): before headers commit the whole
+        # charge comes back; after, only the unstreamed share does
+        streamed = 0
+        committed = False
+
+        def _refund_unused():
+            if buckets is None or charged <= 0:
+                return
+            if not committed:
+                buckets.refund(tenant or "default", charged)
+            else:
+                buckets.refund(
+                    tenant or "default", max(0, charged - (prompt_est + streamed))
+                )
+
         loop = asyncio.get_event_loop()
         if isinstance(payload, dict):
             payload = dict(payload)
@@ -192,6 +294,7 @@ class ProxyActor:
             )
         except Exception as e:  # noqa: BLE001
             logger.exception("proxy stream routing failed")
+            _refund_unused()
             return web.Response(status=500, text=str(e))
         it = iter(gen)
 
@@ -210,6 +313,7 @@ class ProxyActor:
                 cancel_meta = item["__serve_stream_meta__"]
                 more, item = await loop.run_in_executor(None, next_item)
         except RequestShedError as e:
+            _refund_unused()
             return web.Response(
                 status=503,
                 headers={"Retry-After": self._shed_retry_after(e)},
@@ -217,13 +321,17 @@ class ProxyActor:
             )
         except Exception as e:  # noqa: BLE001
             logger.exception("stream failed before first item")
+            _refund_unused()
             return web.Response(status=500, text=str(e))
         resp = web.StreamResponse()
         resp.enable_chunked_encoding()
         await resp.prepare(request)
+        committed = True
         disconnected = False
         try:
             while more:
+                if isinstance(item, dict) and "token" in item:
+                    streamed += 1
                 if isinstance(item, (bytes, bytearray)):
                     chunk = bytes(item)
                 elif isinstance(item, (dict, list)):
@@ -254,6 +362,7 @@ class ProxyActor:
                 gen.close()
             except Exception:  # noqa: BLE001
                 pass
+            _refund_unused()
             try:
                 await resp.write_eof()
             except (ConnectionResetError, ConnectionError):
@@ -296,16 +405,37 @@ class ProxyActor:
         from ray_tpu.serve.exceptions import RequestShedError
 
         loop = asyncio.get_event_loop()
-        shed = self._try_admit(name)
+        # request identity (tenant + SLO class) from headers / payload;
+        # it rides the handle's request_meta all the way to the engine
+        tenant, slo = self._identity(request, payload)
+        shed = self._try_admit(name, tenant)
         if shed is not None:
             return shed
+        prompt_est, est = self._estimate_tokens(payload)
+        buckets = self._buckets_for(name)
+        charged = 0
+        used = None
         try:
+            over = self._quota_admit(name, tenant, est)
+            if over is not None:
+                return over
+            charged = est
+            if tenant or slo:
+                # derive per request, never cache: meta is per-call state
+                handle = handle.options(
+                    tenant=tenant or None, slo_class=slo or None
+                )
             # streaming opt-in (reference: StreamingResponse deployments):
             # chunked transfer, one chunk per yielded item
             if request.headers.get("x-serve-stream") == "1" or request.query.get(
                 "serve_stream"
             ) == "1":
-                return await self._handle_stream(request, handle, payload, name)
+                stream_charge, charged = charged, 0
+                return await self._handle_stream(
+                    request, handle, payload, name,
+                    tenant=tenant, charged=stream_charge,
+                    prompt_est=prompt_est, buckets=buckets,
+                )
             try:
                 # Routing may block (cold start waits for a replica,
                 # refresh does a blocking get) — keep it off the proxy
@@ -335,10 +465,22 @@ class ProxyActor:
                 # request must not permanently bias pow-2 routing and
                 # autoscaling.
                 response._router.done(response._replica_id)
+            if isinstance(result, dict):
+                try:
+                    used = prompt_est + int(result.get("num_tokens") or 0)
+                except (TypeError, ValueError):
+                    used = None
             if isinstance(result, (dict, list)):
                 return web.json_response(result)
             if isinstance(result, bytes):
                 return web.Response(body=result)
             return web.Response(text=str(result))
         finally:
+            if charged > 0:
+                # give back the unused share of the worst-case charge
+                # (the whole thing when the request failed or shed)
+                buckets.refund(
+                    tenant or "default",
+                    charged if used is None else max(0, charged - used),
+                )
             self._release(name)

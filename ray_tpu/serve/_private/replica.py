@@ -49,14 +49,17 @@ class Replica:
         return target
 
     async def handle_request(
-        self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""
+        self, method: str, args: tuple, kwargs: dict,
+        multiplexed_model_id: str = "", request_meta: Optional[dict] = None,
     ):
+        from ray_tpu.serve._private.request_context import _set_request_meta
         from ray_tpu.serve.multiplex import _set_request_model_id
 
         async with self._sem:
             self._ongoing += 1
             self._total += 1
             _set_request_model_id(multiplexed_model_id)
+            _set_request_meta(request_meta)
             try:
                 result = self._resolve_target(method)(*args, **kwargs)
                 if inspect.iscoroutine(result):
@@ -66,18 +69,21 @@ class Replica:
                 self._ongoing -= 1
 
     async def handle_request_stream(
-        self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""
+        self, method: str, args: tuple, kwargs: dict,
+        multiplexed_model_id: str = "", request_meta: Optional[dict] = None,
     ):
         """Streaming requests (reference: replica.py handle_request_streaming
         — generator deployments yield response chunks).  Runs as an actor
         STREAMING method: each yielded item becomes one stream element on
         the caller's side (num_returns=\"streaming\")."""
+        from ray_tpu.serve._private.request_context import _set_request_meta
         from ray_tpu.serve.multiplex import _set_request_model_id
 
         async with self._sem:
             self._ongoing += 1
             self._total += 1
             _set_request_model_id(multiplexed_model_id)
+            _set_request_meta(request_meta)
             try:
                 result = self._resolve_target(method)(*args, **kwargs)
                 if inspect.iscoroutine(result):

@@ -197,11 +197,13 @@ class Router:
             except Exception:  # noqa: BLE001
                 pass
 
-    def route(self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""):
+    def route(self, method: str, args: tuple, kwargs: dict,
+              multiplexed_model_id: str = "", request_meta: Optional[dict] = None):
         """Dispatch to the chosen replica; returns (ObjectRef-or-
         ChannelFuture, replica_id).  Callers MUST call `done(replica_id)`
         when the response resolves so the in-flight estimate stays
-        honest."""
+        honest.  ``request_meta`` is the request's identity dict
+        ({"tenant", "slo"}), carried on the wire to the replica."""
         from ray_tpu.util import tracing
 
         if tracing.current_context() is not None:
@@ -210,10 +212,12 @@ class Router:
             # timeline shows the router_queue segment.  Untraced
             # requests pay one contextvar read.
             with tracing.start_span("serve.router", {"method": method}):
-                return self._route(method, args, kwargs, multiplexed_model_id)
-        return self._route(method, args, kwargs, multiplexed_model_id)
+                return self._route(method, args, kwargs, multiplexed_model_id,
+                                   request_meta)
+        return self._route(method, args, kwargs, multiplexed_model_id, request_meta)
 
-    def _route(self, method: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""):
+    def _route(self, method: str, args: tuple, kwargs: dict,
+               multiplexed_model_id: str = "", request_meta: Optional[dict] = None):
         r = self.pick(multiplexed_model_id)
         rid = r["replica_id"]
         # route()/done() run concurrently from proxy executor threads:
@@ -225,16 +229,18 @@ class Router:
         dp = self._dataplane(r)
         if dp is not None:
             try:
-                return dp.call(method, args, kwargs, multiplexed_model_id), rid
+                return dp.call(method, args, kwargs, multiplexed_model_id,
+                               request_meta), rid
             except Exception:  # noqa: BLE001 — channel died mid-send
                 self._drop_dataplane(rid)
         ref = r["actor"].handle_request.remote(
-            method, args, kwargs, multiplexed_model_id
+            method, args, kwargs, multiplexed_model_id, request_meta
         )
         return ref, rid
 
     def route_stream(self, method: str, args: tuple, kwargs: dict,
-                     multiplexed_model_id: str = ""):
+                     multiplexed_model_id: str = "",
+                     request_meta: Optional[dict] = None):
         """Streaming dispatch: returns (stream, replica_id) — a
         ChannelStream multiplexed over the replica's dataplane when
         attached (one frame per token, no object-store hops), else an
@@ -243,11 +249,14 @@ class Router:
 
         if tracing.current_context() is not None:
             with tracing.start_span("serve.router", {"method": method}):
-                return self._route_stream(method, args, kwargs, multiplexed_model_id)
-        return self._route_stream(method, args, kwargs, multiplexed_model_id)
+                return self._route_stream(method, args, kwargs,
+                                          multiplexed_model_id, request_meta)
+        return self._route_stream(method, args, kwargs, multiplexed_model_id,
+                                  request_meta)
 
     def _route_stream(self, method: str, args: tuple, kwargs: dict,
-                      multiplexed_model_id: str = ""):
+                      multiplexed_model_id: str = "",
+                      request_meta: Optional[dict] = None):
         r = self.pick(multiplexed_model_id)
         rid = r["replica_id"]
         with self._lock:
@@ -257,11 +266,12 @@ class Router:
         dp = self._dataplane(r)
         if dp is not None:
             try:
-                return dp.stream(method, args, kwargs, multiplexed_model_id), rid
+                return dp.stream(method, args, kwargs, multiplexed_model_id,
+                                 request_meta), rid
             except Exception:  # noqa: BLE001
                 self._drop_dataplane(rid)
         gen = r["actor"].handle_request_stream.options(num_returns="streaming").remote(
-            method, args, kwargs, multiplexed_model_id
+            method, args, kwargs, multiplexed_model_id, request_meta
         )
         return gen, rid
 

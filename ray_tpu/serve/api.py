@@ -70,6 +70,7 @@ def deployment(
     ray_actor_options: Optional[dict] = None,
     version: str = "1",
     user_config: Any = None,
+    tenant_quotas: Optional[dict] = None,
 ):
     """@serve.deployment decorator (reference: serve/api.py deployment)."""
 
@@ -88,6 +89,7 @@ def deployment(
             ray_actor_options=ray_actor_options or {},
             version=version,
             user_config=user_config,
+            tenant_quotas=tenant_quotas or {},
         )
         return Deployment(target, cfg)
 

@@ -133,12 +133,16 @@ class _MethodCaller:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller=None,
-                 multiplexed_model_id: str = "", stream: bool = False):
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 request_meta: Optional[dict] = None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._router = None
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        # per-request identity ({"tenant", "slo"}) threaded through the
+        # router + dataplane frames to the replica's request context
+        self._request_meta = dict(request_meta) if request_meta else None
 
     def _ensure_router(self):
         if self._router is None:
@@ -156,31 +160,46 @@ class DeploymentHandle:
         router = self._ensure_router()
         if self._stream:
             gen, rid = router.route_stream(
-                method, args, kwargs, self._multiplexed_model_id
+                method, args, kwargs, self._multiplexed_model_id,
+                request_meta=self._request_meta,
             )
             return DeploymentResponseGenerator(gen, router, rid)
-        ref, rid = router.route(method, args, kwargs, self._multiplexed_model_id)
+        ref, rid = router.route(
+            method, args, kwargs, self._multiplexed_model_id,
+            request_meta=self._request_meta,
+        )
         return DeploymentResponse(ref, router, rid)
 
     def remote(self, *args, **kwargs):
         return self._call("__call__", args, kwargs)
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None, **kwargs) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                tenant: Optional[str] = None,
+                slo_class: Optional[str] = None, **kwargs) -> "DeploymentHandle":
         """A derived handle with per-call options (reference:
         serve/handle.py options — multiplexed_model_id routes to a
         replica holding that model; stream=True makes remote() return a
-        DeploymentResponseGenerator over the target's yields).  The
-        derived handle SHARES this handle's router so queue estimates
-        and model affinity stay coherent."""
-        if multiplexed_model_id is None and stream is None:
+        DeploymentResponseGenerator over the target's yields; tenant/
+        slo_class stamp request identity for the engine's fair queue,
+        quotas, and brownout — docs/serving.md).  The derived handle
+        SHARES this handle's router so queue estimates and model
+        affinity stay coherent."""
+        if (multiplexed_model_id is None and stream is None
+                and tenant is None and slo_class is None):
             return self
+        meta = dict(self._request_meta or {})
+        if tenant is not None:
+            meta["tenant"] = tenant
+        if slo_class is not None:
+            meta["slo"] = slo_class
         h = DeploymentHandle(
             self.deployment_name,
             self._controller,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._multiplexed_model_id,
             stream=self._stream if stream is None else stream,
+            request_meta=meta or None,
         )
         h._router = self._ensure_router()
         return h
@@ -192,8 +211,10 @@ class DeploymentHandle:
 
     def __reduce__(self):
         # handles cross process boundaries by name (the router
-        # re-resolves); per-call options like the model id must survive
+        # re-resolves); per-call options like the model id and request
+        # identity must survive
         return (
             DeploymentHandle,
-            (self.deployment_name, None, self._multiplexed_model_id, self._stream),
+            (self.deployment_name, None, self._multiplexed_model_id,
+             self._stream, self._request_meta),
         )

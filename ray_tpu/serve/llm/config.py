@@ -4,7 +4,7 @@ LLMConfig, scaled down to the knobs this engine actually has)."""
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 
@@ -50,6 +50,24 @@ class LLMConfig:
     temperature: float = 0.0  # <= 0 means greedy
     top_k: int = 0  # 0 = off (static engine-wide truncation)
     eos_token: int = -1  # -1 = generate to max_tokens
+
+    # multi-tenant overload armor (docs/serving.md "Overload resilience").
+    # tenant_weights: DRF weight per tenant for the engine's fair waiting
+    # queue (absent tenant -> weight 1.0).  tenant_quotas: per-tenant
+    # token-rate quota {"rate": tokens/s, "burst": tokens} enforced at the
+    # PROXY (flows there via the route table); the key set also bounds the
+    # tenant metric-label domain.  preempt_wait_s: how long a
+    # higher-priority request may starve before a lower-priority decode
+    # lane is preempted-by-recompute.  slo_ttft_s: TTFT p95 SLO bound
+    # driving the brownout ladder — 0 disables brownout entirely.
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    tenant_quotas: Dict[str, dict] = field(default_factory=dict)
+    preempt_wait_s: float = 0.25
+    slo_ttft_s: float = 0.0
+    brownout_queue_high: int = 0  # 0 -> 4 * max_batch_size
+    brownout_down_ticks: int = 3
+    brownout_up_ticks: int = 5
+    brownout_batch_max_tokens: int = 8
 
     # observability
     name: str = "llm"  # metrics label (the deployment name, bounded)

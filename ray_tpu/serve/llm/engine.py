@@ -24,6 +24,17 @@ Request spans (``serve.request`` -> ``serve.queue`` / ``serve.prefill``
 / ``serve.decode``) are recorded per request so ``state.traces()``
 critical-path analysis attributes end-to-end latency to queue vs prefill
 vs decode.
+
+Overload armor (docs/serving.md "Overload resilience"): requests carry
+tenant + SLO-class identity.  The waiting queue is a weighted fair queue
+over KV blocks and decode lanes (DRF, reusing ``_private/tenants.py``
+math) with an intra-tenant order of priority-then-FIFO; a starved
+higher-priority request preempts the cheapest lower-priority decode lane
+by recompute (KV pages freed, generated-so-far folded into the prompt,
+prefill-resume is token-exact under greedy sampling); and a brownout
+ladder driven by observed TTFT/queue depth degrades batch before
+standard and never sheds interactive.  All of it is inert for anonymous
+traffic: identity-free requests take the original FIFO fast path.
 """
 
 from __future__ import annotations
@@ -38,9 +49,15 @@ from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu._private import tenants as tenants_mod
 from ray_tpu.serve.exceptions import RequestShedError
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.kv_cache import BlockManager
+from ray_tpu.serve.llm.overload import (
+    DegradationController,
+    SLO_PRIORITY,
+    normalize_slo,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +85,14 @@ class _Request:
     join_step: int = -1
     finish_step: int = -1
     tokens: List[int] = field(default_factory=list)
+    # overload identity + preemption state
+    tenant: str = tenants_mod.DEFAULT_TENANT
+    slo: str = "standard"
+    priority: int = 1
+    seq: int = 0  # admission order — the intra-tenant FIFO tiebreak
+    preemptions: int = 0
+    folded: int = 0  # tokens already folded into prompt by past preemptions
+    t_enqueue: float = 0.0  # last (re)queue time — the starvation clock
 
 
 class LLMEngine:
@@ -102,8 +127,31 @@ class LLMEngine:
         self._tok_window: Deque[tuple] = collections.deque(maxlen=512)
         self._total_tokens = 0
         self._shed_total = 0
-        self._shed_unreported = 0
+        # shed attribution: {(where, tenant_label): n}, flushed at 1 Hz
+        self._shed_unreported: Dict[tuple, int] = {}
         self._last_metrics_push = 0.0
+        # -- overload armor state (docs/serving.md) --
+        self._seq_counter = 0
+        # False -> every waiting request is anonymous default-tenant
+        # standard-class traffic, so admission takes the original FIFO
+        # fast path (zero overhead for identity-free workloads)
+        self._fair_dirty = False
+        self._preempt_total = 0
+        self._events: Deque[Dict[str, Any]] = collections.deque(maxlen=128)
+        self._ttft_recent: Deque[float] = collections.deque(maxlen=64)
+        # (wall time, tenant, tokens) for the per-tenant rate gauge
+        self._tenant_tok_window: Deque[tuple] = collections.deque(maxlen=2048)
+        self._registered_tenants = (
+            set(self.config.tenant_quotas) | set(self.config.tenant_weights)
+        )
+        self._degrade = DegradationController(
+            ttft_slo_s=self.config.slo_ttft_s,
+            queue_high=(self.config.brownout_queue_high
+                        or 4 * self.config.max_batch_size),
+            down_ticks=self.config.brownout_down_ticks,
+            up_ticks=self.config.brownout_up_ticks,
+            batch_max_tokens=self.config.brownout_batch_max_tokens,
+        )
 
     # -- model / jit ----------------------------------------------------
     def _build_model(self):
@@ -205,43 +253,75 @@ class LLMEngine:
 
         return tokenize_prompt(prompt, self.model_cfg.vocab_size)
 
+    def _tenant_label(self, tenant: str) -> str:
+        """Clamp a wire-supplied tenant to the bounded metric domain."""
+        return tenants_mod.tenant_label(tenant, self._registered_tenants)
+
+    def _shed(self, where: str, tenant: str, message: str,
+              retry_after_s: float = 1.0) -> None:
+        self._shed_total += 1
+        key = (where, self._tenant_label(tenant))
+        self._shed_unreported[key] = self._shed_unreported.get(key, 0) + 1
+        self._push_metrics(force=True)
+        raise RequestShedError(message, retry_after_s=retry_after_s)
+
     async def add_request(
         self,
         prompt: Any,
         max_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        slo: Optional[str] = None,
     ) -> _Request:
         """Admit one request; its ``.out`` queue streams token events
         ending with the FINISHED sentinel.  Sheds (typed, retryable) when
-        the waiting queue is at its bound."""
+        the waiting queue is at its bound or the brownout ladder sheds
+        the request's SLO class."""
         self.ensure_started()
+        tenant = tenants_mod.normalize_tenant(tenant)
+        slo = normalize_slo(slo)
+        if self._degrade.should_shed(slo):
+            self._shed(
+                "brownout", tenant,
+                f"brownout level {self._degrade.level} sheds {slo}-class "
+                "requests (interactive is never shed)",
+                retry_after_s=2.0,
+            )
         if len(self.waiting) >= self.config.max_queue:
-            self._shed_total += 1
-            self._shed_unreported += 1
-            self._push_metrics(force=True)
-            raise RequestShedError(
+            self._shed(
+                "engine", tenant,
                 f"engine queue full ({len(self.waiting)} waiting, "
-                f"bound {self.config.max_queue})"
+                f"bound {self.config.max_queue})",
             )
         tokens = self.tokenize(prompt)
         if len(tokens) >= self.max_ctx:
             tokens = tokens[: self.max_ctx - 1]
         mt = max_tokens if max_tokens is not None else self.config.default_max_tokens
+        mt = self._degrade.max_tokens_cap(slo, mt)
         mt = max(1, min(int(mt), self.max_ctx - len(tokens)))
         temp = self.config.temperature if temperature is None else float(temperature)
         rid = request_id or uuid.uuid4().hex[:16]
         if rid in self._by_id:
             raise ValueError(f"duplicate request id {rid!r}")
+        now = time.time()
+        self._seq_counter += 1
         req = _Request(
             request_id=rid,
             prompt=tokens,
             max_tokens=mt,
             temperature=temp,
             out=asyncio.Queue(),
-            t_submit=time.time(),
+            t_submit=now,
             trace=self._mint_trace(),
+            tenant=tenant,
+            slo=slo,
+            priority=SLO_PRIORITY[slo],
+            seq=self._seq_counter,
+            t_enqueue=now,
         )
+        if tenant != tenants_mod.DEFAULT_TENANT or req.priority != 1:
+            self._fair_dirty = True
         self._by_id[rid] = req
         self.waiting.append(req)
         self._wake.set()
@@ -270,6 +350,22 @@ class LLMEngine:
 
     def stats(self) -> Dict[str, Any]:
         running = sum(1 for r in self.slots if r is not None)
+        tenants: Dict[str, Dict[str, int]] = {}
+        for r in self.slots:
+            if r is None:
+                continue
+            u = tenants.setdefault(
+                self._tenant_label(r.tenant),
+                {"waiting": 0, "running": 0, "kv_blocks": 0},
+            )
+            u["running"] += 1
+            u["kv_blocks"] += self.bm.blocks_held(r.request_id)
+        for r in self.waiting:
+            u = tenants.setdefault(
+                self._tenant_label(r.tenant),
+                {"waiting": 0, "running": 0, "kv_blocks": 0},
+            )
+            u["waiting"] += 1
         return {
             "waiting": len(self.waiting),
             "running": running,
@@ -281,6 +377,10 @@ class LLMEngine:
             "total_tokens": self._total_tokens,
             "shed_total": self._shed_total,
             "steps": self.step_count,
+            "preemptions_total": self._preempt_total,
+            "degradation_level": self._degrade.level,
+            "tenants": tenants,
+            "events": list(self._events),
         }
 
     def queued_depth(self) -> int:
@@ -328,6 +428,7 @@ class LLMEngine:
         """Admit waiting requests into free lanes — the continuous-batch
         join point: new requests enter at a step boundary instead of
         waiting for the running batch to drain."""
+        self._maybe_preempt()
         joined = 0
         for i in range(len(self.slots)):
             if self.slots[i] is not None:
@@ -350,21 +451,170 @@ class LLMEngine:
             joined += 1
         return joined
 
+    @staticmethod
+    def _kv_need(req: _Request) -> int:
+        """Remaining KV reservation.  Invariant under preemption folds:
+        after a fold, len(prompt) grew by exactly the generated tokens it
+        absorbed, so the need is always len(prompt0) + max_tokens."""
+        return len(req.prompt) + req.max_tokens - req.generated
+
     def _next_admissible(self) -> Optional[_Request]:
-        while self.waiting:
-            req = self.waiting.popleft()
+        if not self._fair_dirty:
+            # fast path: all waiting traffic is anonymous default-tenant
+            # standard class — plain FIFO, identical to the pre-tenant
+            # engine (this is the high-throughput bench path)
+            while self.waiting:
+                req = self.waiting.popleft()
+                if req.cancelled:
+                    self._finish(req, "cancelled")
+                    continue
+                need = self._kv_need(req)
+                if not self.bm.can_allocate(need):
+                    # head-of-line blocks until capacity frees: put it
+                    # back and stop (FIFO — no small-request overtaking)
+                    self.waiting.appendleft(req)
+                    return None
+                self.bm.allocate(req.request_id, need)
+                return req
+            return None
+        return self._next_admissible_fair()
+
+    def _next_admissible_fair(self) -> Optional[_Request]:
+        """Weighted-fair admission: per tenant, the head is its best
+        (priority desc, then admission order — no intra-tenant
+        overtaking) waiting request; across tenants, heads are served in
+        ascending DRF dominant share over {KV blocks, decode lanes}
+        (weights from ``tenant_weights``).  Work-conserving: a head that
+        does not fit the pool is skipped, and the skipped tenant's low
+        share makes it first in line once capacity frees."""
+        if not self.waiting:
+            self._fair_dirty = False
+            return None
+        alive = []
+        for req in self.waiting:
             if req.cancelled:
                 self._finish(req, "cancelled")
+            else:
+                alive.append(req)
+        if len(alive) != len(self.waiting):
+            self.waiting = collections.deque(alive)
+        if not alive:
+            self._fair_dirty = False
+            return None
+        heads: Dict[str, _Request] = {}
+        for req in alive:
+            cur = heads.get(req.tenant)
+            if cur is None or (-req.priority, req.seq) < (-cur.priority, cur.seq):
+                heads[req.tenant] = req
+        usage: Dict[str, Dict[str, float]] = {}
+        for r in self.slots:
+            if r is None:
                 continue
-            need = len(req.prompt) + req.max_tokens
-            if not self.bm.can_allocate(need):
-                # head-of-line blocks until capacity frees: put it back
-                # and stop (FIFO fairness — no small-request overtaking)
-                self.waiting.appendleft(req)
-                return None
-            self.bm.allocate(req.request_id, need)
-            return req
+            u = usage.setdefault(r.tenant, {"kv": 0.0, "lanes": 0.0})
+            u["kv"] += self.bm.blocks_held(r.request_id)
+            u["lanes"] += 1.0
+        totals = {
+            "kv": float(self.bm.num_blocks - 1),
+            "lanes": float(self.config.max_batch_size),
+        }
+        weights = self.config.tenant_weights
+
+        def rank(t: str):
+            share = tenants_mod.dominant_share(
+                usage.get(t, {}), totals, float(weights.get(t, 1.0))
+            )
+            h = heads[t]
+            return (share, -h.priority, h.seq)
+
+        for t in sorted(heads, key=rank):
+            req = heads[t]
+            need = self._kv_need(req)
+            if self.bm.can_allocate(need):
+                self.waiting.remove(req)
+                self.bm.allocate(req.request_id, need)
+                return req
         return None
+
+    # -- priority preemption (preempt-by-recompute) ----------------------
+    def _maybe_preempt(self):
+        """When a higher-priority request has starved past
+        ``preempt_wait_s`` and cannot join (no lane, or KV pool full),
+        evict the cheapest strictly-lower-priority running lane.  At most
+        one victim per step boundary — the loop converges over steps
+        instead of mass-evicting on a transient spike."""
+        if not self._fair_dirty or not self.waiting:
+            return
+        cand = None
+        for req in self.waiting:
+            if req.cancelled:
+                continue
+            if cand is None or (-req.priority, req.seq) < (-cand.priority, cand.seq):
+                cand = req
+        if cand is None:
+            return
+        now = time.time()
+        if now - (cand.t_enqueue or cand.t_submit) < self.config.preempt_wait_s:
+            return
+        if (any(r is None for r in self.slots)
+                and self.bm.can_allocate(self._kv_need(cand))):
+            return  # joins normally this boundary; nothing to evict
+        victims = [
+            r for r in self.slots
+            if r is not None and not r.cancelled and r.priority < cand.priority
+        ]
+        if not victims:
+            return
+        # cheapest recompute first: lowest priority, least generated
+        # (smallest refill), youngest lane
+        victim = min(victims, key=lambda r: (r.priority, r.generated, -r.t_join))
+        self._preempt(victim, cand)
+
+    def _preempt(self, req: _Request, for_req: Optional[_Request] = None):
+        """Evict a running lane by recompute: free its KV pages, fold the
+        tokens generated so far into its prompt, and re-queue it.  On
+        resume, prefill replays the folded context and samples the next
+        token — under greedy decoding that argmax is exactly the token
+        the uninterrupted run would have produced (parity-tested)."""
+        import os
+
+        from ray_tpu._private.chaos import CHAOS
+
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+        req.slot = -1
+        self.bm.free(req.request_id)
+        # Chaos fault point: "@serve.preempt.evict:kill:at=N" dies after
+        # the pages are freed but before the requeue — the replica-crash
+        # window the zero-leak drill drives.
+        if CHAOS.active and CHAOS.maybe_kill("serve.preempt.evict"):
+            logger.warning("chaos: killing replica mid-preemption (evict)")
+            os._exit(1)
+        req.prompt = list(req.prompt) + req.tokens[req.folded:]
+        req.folded = len(req.tokens)
+        req.t_enqueue = time.time()
+        req.preemptions += 1
+        self._preempt_total += 1
+        self._events.append({
+            "type": "preemption",
+            "t": req.t_enqueue,
+            "victim": req.request_id,
+            "victim_slo": req.slo,
+            "victim_tenant": self._tenant_label(req.tenant),
+            "for": for_req.request_id if for_req is not None else "",
+            "generated": req.generated,
+            "preemptions": req.preemptions,
+        })
+        try:
+            from ray_tpu._private import telemetry
+
+            telemetry.count_serve_preemption(self.config.name, req.slo)
+        except Exception:  # noqa: BLE001
+            pass
+        if CHAOS.active and CHAOS.maybe_kill("serve.preempt.requeue"):
+            logger.warning("chaos: killing replica mid-preemption (requeue)")
+            os._exit(1)
+        self.waiting.append(req)
+        self._fair_dirty = True
 
     async def _prefill(self, loop, req: _Request):
         n = len(req.prompt)
@@ -443,6 +693,8 @@ class LLMEngine:
         req.tokens.append(token)
         req.generated += 1
         self._total_tokens += 1
+        if self._fair_dirty or req.tenant != tenants_mod.DEFAULT_TENANT:
+            self._tenant_tok_window.append((now or time.time(), req.tenant, 1))
         if req.t_first_token == 0.0:
             req.t_first_token = now or time.time()
         req.out.put_nowait(
@@ -525,6 +777,7 @@ class LLMEngine:
     def _observe_ttft(self, req: _Request):
         if not req.t_first_token:
             return
+        self._ttft_recent.append(req.t_first_token - req.t_submit)
         try:
             from ray_tpu._private import telemetry
 
@@ -542,11 +795,34 @@ class LLMEngine:
         span = max(now - window[0][0], 1e-3)
         return sum(n for _, n in window) / span
 
+    def _ttft_p95(self) -> Optional[float]:
+        if not self._ttft_recent:
+            return None
+        vals = sorted(self._ttft_recent)
+        return vals[min(len(vals) - 1, int(0.95 * len(vals)))]
+
     def _push_metrics(self, force: bool = False):
         now = time.time()
         if not force and now - self._last_metrics_push < 1.0:
             return
         self._last_metrics_push = now
+        # brownout control tick rides the 1 Hz metrics cadence (inert
+        # when slo_ttft_s == 0 — the controller is disabled)
+        if self._degrade.enabled:
+            before = self._degrade.level
+            level = self._degrade.tick(self._ttft_p95(), len(self.waiting))
+            if level != before:
+                self._events.append({
+                    "type": "degradation",
+                    "t": now,
+                    "from": before,
+                    "to": level,
+                    "queue": len(self.waiting),
+                })
+                logger.info(
+                    "brownout level %d -> %d (queue=%d)",
+                    before, level, len(self.waiting),
+                )
         try:
             from ray_tpu._private import telemetry
 
@@ -554,13 +830,34 @@ class LLMEngine:
             telemetry.set_serve_queue_depth(name, len(self.waiting))
             telemetry.set_serve_kv_blocks(name, self.bm.blocks_in_use)
             telemetry.set_serve_tokens_per_s(name, self._tokens_per_s())
+            if self._degrade.enabled:
+                telemetry.set_serve_degradation(name, self._degrade.level)
+            for tenant, rate in self._tenant_tokens_per_s().items():
+                telemetry.set_serve_tenant_tokens_per_s(name, tenant, rate)
             # Device memory attribution for the paged KV cache (no-op on
             # backends without memory_stats; internally rate-limited).
             from ray_tpu._private import profiling as profiling_mod
 
             profiling_mod.report_device_memory()
             if self._shed_unreported:
-                telemetry.count_serve_shed(name, "engine", self._shed_unreported)
-                self._shed_unreported = 0
+                pending, self._shed_unreported = self._shed_unreported, {}
+                for (where, tenant), n in pending.items():
+                    telemetry.count_serve_shed(name, where, n, tenant=tenant)
         except Exception:  # noqa: BLE001
             pass
+
+    def _tenant_tokens_per_s(self) -> Dict[str, float]:
+        """Per-tenant token rate over the 5 s window, labels clamped to
+        the registered domain (empty for pure anonymous traffic — the
+        window is only fed once identity appears)."""
+        now = time.time()
+        window = [(t, ten, n) for t, ten, n in self._tenant_tok_window
+                  if now - t <= 5.0]
+        if not window:
+            return {}
+        span = max(now - window[0][0], 1e-3)
+        out: Dict[str, float] = {}
+        for _, ten, n in window:
+            label = self._tenant_label(ten)
+            out[label] = out.get(label, 0.0) + n
+        return {k: v / span for k, v in out.items()}

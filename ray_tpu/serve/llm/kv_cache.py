@@ -98,6 +98,12 @@ class BlockManager:
         self.total_frees += 1
         return len(table)
 
+    def blocks_held(self, seq_id: str) -> int:
+        """Blocks currently reserved by seq_id (0 when unknown) — the
+        fair queue's per-tenant KV usage signal."""
+        table = self._tables.get(seq_id)
+        return len(table) if table is not None else 0
+
     # -- position -> physical slot mapping ------------------------------
     def seq_len(self, seq_id: str) -> int:
         return self._lens.get(seq_id, 0)

@@ -36,6 +36,7 @@ _OVERRIDABLE = (
     "user_config",
     "version",
     "ray_actor_options",
+    "tenant_quotas",
 )
 
 
@@ -52,6 +53,7 @@ class DeploymentSchema:
     user_config: Any = None
     version: Optional[str] = None
     ray_actor_options: Optional[dict] = None
+    tenant_quotas: Optional[dict] = None
 
     def overrides(self) -> Dict[str, Any]:
         out = {}
@@ -176,6 +178,7 @@ def build_app_schema(import_path: str, *, name: str = "default",
                 user_config=cfg.user_config,
                 version=cfg.version,
                 ray_actor_options=cfg.ray_actor_options or None,
+                tenant_quotas=cfg.tenant_quotas or None,
             )
         )
     return ApplicationSchema(

@@ -217,6 +217,34 @@ def _score(metric: str, prev: Dict[str, Any], cap: Dict[str, Any], threshold: fl
 # ----------------------------------------------------------------------
 # metric-dict comparison (BENCH_micro / BENCH_serve style captures)
 # ----------------------------------------------------------------------
+
+# Workload-shape provenance: captures stamping different values for one
+# of these keys measured different workloads (a 4096-stream drill vs a
+# 1024-stream one) — the comparison is skipped loudly, same discipline
+# as the cross-platform refusal.  Unlike on_tpu there is nothing to
+# derive a MISSING stamp from, and a one-sided stamp appears exactly
+# when the bench script changed between the captures — the moment the
+# workload may have been resized — so one-sided is also not comparable.
+_WORKLOAD_KEYS = ("streams", "requests", "requested", "concurrency",
+                  "batch_width")
+
+
+def _workload_mismatch(
+    old_rec: Dict[str, Any], new_rec: Dict[str, Any]
+) -> Optional[str]:
+    for key in _WORKLOAD_KEYS:
+        ov, nv = old_rec.get(key), new_rec.get(key)
+        if ov is None and nv is None:
+            continue
+        if ov != nv:
+            side = "old" if ov is None else "new"
+            if ov is None or nv is None:
+                return (f"{key} stamped on one capture only (missing on "
+                        f"{side}) — shape unknown across a bench change")
+            return f"{key} {ov} -> {nv}"
+    return None
+
+
 def compare_metric_dicts(
     old: Dict[str, Any], new: Dict[str, Any], threshold: float = DEFAULT_THRESHOLD
 ) -> Dict[str, List[Dict[str, Any]]]:
@@ -270,6 +298,19 @@ def compare_metric_dicts(
                     "reason": (
                         f"CROSS-PLATFORM: {_prov_label(old_rec)} -> "
                         f"{_prov_label(new_rec)} — not comparable"
+                    ),
+                }
+            )
+            continue
+        mismatch = _workload_mismatch(old_rec, new_rec)
+        if mismatch:
+            skips.append(
+                {
+                    "metric": metric,
+                    "reason": (
+                        f"WORKLOAD CHANGED ({mismatch}): the runs measured "
+                        "different workloads — a value delta here is a "
+                        "resize artifact, not a perf change"
                     ),
                 }
             )

@@ -83,6 +83,19 @@ if [ "${RAY_TPU_SKIP_SERVE_LLM_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Serve overload smoke (overload armor end-to-end over HTTP): hostile
+# tenant floods at many times its token-rate quota while a victim tenant
+# streams interactively — assert 429s attributed to the hostile tenant
+# only, victim TTFT bounded, KV pool balanced to zero.  Skippable via
+# RAY_TPU_SKIP_SERVE_OVERLOAD_SMOKE=1.
+if [ "${RAY_TPU_SKIP_SERVE_OVERLOAD_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/serve_overload_smoke.py; then
+    echo "serve overload smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Compiled-DAG smoke (zero-copy dataplane end-to-end): 2-raylet cluster,
 # 3-actor fan-out with one socket edge + shm rings, exact results over
 # 200 executions, sub-ms local round-trip p50 (multicore), teardown
