@@ -356,9 +356,10 @@ def nodes() -> List[dict]:
         out.append(
             {
                 "NodeID": NodeID(n["node_id"]).hex(),
-                # DRAINING nodes are still up (running out their notice)
-                # but schedulable-nowhere; State carries the distinction.
-                "Alive": n["state"] in ("ALIVE", "DRAINING"),
+                # DRAINING/SUSPECT/QUARANTINED nodes are still up (paying
+                # out a notice or degraded-but-serving); State carries
+                # the distinction.
+                "Alive": n["state"] in ("ALIVE", "SUSPECT", "DRAINING", "QUARANTINED"),
                 "State": n["state"],
                 "DrainReason": n.get("drain_reason"),
                 "Resources": n["resources_total"],

@@ -15,9 +15,10 @@ inherits)::
     rule    := pattern:action[:key=value]...
     pattern := fnmatch glob over the RPC method name ("submit_task",
                "store_*", "*"), a pubsub channel ("pubsub:nodes",
-               "pubsub:actors" — one decision per published message), or
-               a process fault point ("@worker.exec", "@raylet.tick",
-               "@gcs.tick")
+               "pubsub:actors" — one decision per published message), a
+               process fault point ("@worker.exec", "@raylet.tick",
+               "@gcs.tick"), or a directional link
+               ("net:<src-glob>-><dst-glob>" — see below)
     action  := drop_req | drop_rep | delay_req | delay_rep | dup_req |
                kill | preempt
     keys    := n=<max firings, -1 unlimited; default 1>
@@ -27,6 +28,14 @@ inherits)::
                after=<skip the first K matches; default 0>
                at=<fire exactly on the K-th match; shorthand for
                   after=K-1:n=1>
+               start=<seconds after rule parse before the rule arms;
+                  default 0>
+               for=<seconds the rule stays armed once started; absent =
+                  forever.  start/for are WALL-CLOCK windows — they
+                  trade ordinal-replay determinism for time-shaped
+                  faults, which is how a spawn-time spec expresses "hold
+                  this partition for 20 s, then heal" or a flapping link
+                  (several staggered cut windows on one pattern)>
 
 Examples::
 
@@ -38,6 +47,28 @@ Examples::
     @raylet.tick:preempt:at=5:ms=3000  # on its 5th report tick the
                                        # raylet receives a 3 s preemption
                                        # notice (drain), then dies
+    net:raylet*->gcs:cut               # asymmetric partition: every
+                                       # frame traveling raylet->GCS is
+                                       # blackholed (GCS->raylet flows)
+    net:*->node2:flaky:p=0.3           # 30% of frames INTO node2 lost
+    net:node1->node2:slow:ms=500       # sustained half-second one-way
+                                       # delay (the gray-failure model)
+    net:raylet*->gcs:cut:for=20        # the partition heals after 20 s
+    net:node2->gcs:cut:start=5:for=3   # one flap window: the link cuts
+                                       # at t+5 and recovers at t+8
+
+Link-level rules (``net:<src-glob>-><dst-glob>:{cut|flaky|slow}``)
+match the *direction of travel* of one frame: ``src`` is the sending
+process's net identity (``net_name()`` — the ``chaos_net_name`` config
+if set, else a role default like "gcs"/"raylet-<id8>"), ``dst`` is the
+receiver's.  They are consulted at the rpc.py transport send paths
+(requests, replies, pushes, dials) and at the SocketChannel dial/frame
+paths (where ``dst`` is ``addr:<host>:<port>`` — an RPC-plane
+partition leaves the compiled dataplane connected unless a rule targets
+it).  ``cut`` blackholes every matching frame (default ``n=-1``);
+``flaky`` drops each with seeded probability ``p`` (default 0.5);
+``slow`` adds ``ms`` of one-way delay per frame.  An asymmetric
+partition is one rule; a full partition is the two directed rules.
 
 Determinism: every rule owns a ``random.Random`` seeded from
 (``testing_chaos_seed``, rule index) and its own match counter, so a
@@ -70,7 +101,11 @@ _ACTIONS = ("drop_req", "drop_rep", "delay_req", "delay_rep", "dup_req", "kill",
             # checkpoint-write fault (pattern "ckpt:<phase-glob>",
             # consulted in train/checkpoint_plane.py; kill/torn_write
             # are shared with the families above)
-            "bit_flip")
+            "bit_flip",
+            # directional link faults (pattern "net:<src>-><dst>",
+            # consulted at rpc.py send/dial paths and SocketChannel
+            # dial/frame paths)
+            "cut", "flaky", "slow")
 
 # The dataplane subset of _ACTIONS: rules carrying one of these only
 # ever match channel writes (decide() skips them and they skip RPCs).
@@ -82,6 +117,12 @@ _CHANNEL_ACTIONS = ("drop_frame", "delay_frame", "corrupt_frame",
 # kill = SIGKILL mid-phase; torn_write = truncated bytes published under
 # the final name; bit_flip = one byte of a committed shard flipped.
 _CKPT_ACTIONS = ("kill", "torn_write", "bit_flip")
+
+# The link-level subset: matched only by decide_net() against
+# "net:<src-glob>-><dst-glob>" patterns.  cut = blackhole (sustained by
+# default: n=-1 unless given); flaky = seeded p-drop per frame (p
+# defaults to 0.5); slow = sustained one-way delay (ms key).
+_NET_ACTIONS = ("cut", "flaky", "slow")
 
 # Bound on the in-memory schedule log; fired entries past this are
 # counted but not stored.
@@ -148,12 +189,57 @@ class CkptDecision(NamedTuple):
 _CKPT_CLEAN = CkptDecision(False, False, False)
 
 
+class NetDecision(NamedTuple):
+    """Fault verdict for one frame traveling a directed link.  ``drop``
+    models a blackhole (the frame vanishes on the wire: calls time out,
+    pushes disappear, channel sends surface a connection error and take
+    the reattach path); ``delay_s`` models sustained one-way latency —
+    the gray-failure signal the suspicion scorer must read as SUSPECT,
+    never as a clean death."""
+
+    drop: bool
+    delay_s: float
+
+    @property
+    def clean(self) -> bool:
+        return not self.drop and self.delay_s <= 0
+
+
+_NET_CLEAN = NetDecision(False, 0.0)
+
+# This process's identity on chaos links.  ``chaos_net_name`` (env-
+# propagated to spawned processes, so every process on a drilled "node"
+# shares the host-granularity name) wins; else the role the process
+# registered at startup ("gcs", "raylet-<id8>", "driver", "worker");
+# else a pid-stable fallback.
+_net_role = ""
+
+
+def set_net_role(role: str) -> None:
+    """Record this process's default link identity (startup, once)."""
+    global _net_role
+    _net_role = role
+
+
+def net_name() -> str:
+    """This process's identity for ``net:`` rule matching."""
+    name = CONFIG.chaos_net_name
+    if name:
+        return name
+    if _net_role:
+        return _net_role
+    import os
+
+    return f"proc-{os.getpid()}"
+
+
 class _Rule:
     __slots__ = ("index", "pattern", "action", "n", "p", "delay_s", "after",
-                 "matches", "fired", "rng")
+                 "start_s", "for_s", "t0", "matches", "fired", "rng")
 
     def __init__(self, index: int, pattern: str, action: str, n: int,
-                 p: float, delay_s: float, after: int, seed: int):
+                 p: float, delay_s: float, after: int, seed: int,
+                 start_s: float = 0.0, for_s: Optional[float] = None):
         self.index = index
         self.pattern = pattern
         self.action = action
@@ -161,6 +247,14 @@ class _Rule:
         self.p = p
         self.delay_s = delay_s
         self.after = after
+        # Wall-clock arming window (start=/for= keys), anchored at rule
+        # parse — i.e. the process's first chaos consultation, which for
+        # spawned cluster processes is effectively process start.
+        self.start_s = start_s
+        self.for_s = for_s
+        import time as _time
+
+        self.t0 = _time.monotonic()
         self.matches = 0
         self.fired = 0
         # Per-rule stream: verdicts depend only on this rule's match
@@ -174,7 +268,17 @@ class _Rule:
 
     def evaluate(self) -> bool:
         """One match of this rule's pattern: fire or skip (deterministic
-        in the match ordinal)."""
+        in the match ordinal, except the optional start/for wall-clock
+        arming window — a disarmed match consumes no counters and no RNG
+        draw, so the in-window schedule still replays)."""
+        if self.start_s > 0 or self.for_s is not None:
+            import time as _time
+
+            dt = _time.monotonic() - self.t0
+            if dt < self.start_s:
+                return False
+            if self.for_s is not None and dt > self.start_s + self.for_s:
+                return False
         self.matches += 1
         if self.matches <= self.after:
             return False
@@ -202,14 +306,27 @@ def _parse_rule(index: int, text: str, seed: int) -> _Rule:
     for part in parts[action_idx + 1:]:
         k, _, v = part.partition("=")
         kv[k] = v
-    n = int(kv.get("n", 1))
-    p = float(kv.get("p", 1.0))
+    if action in _NET_ACTIONS:
+        # Link rules are sustained by nature: a partition holds until
+        # the spec changes, so n defaults to unlimited, and flaky drops
+        # half its frames unless told otherwise.
+        if not pattern.startswith("net:") or "->" not in pattern:
+            raise ValueError(
+                f"{action} needs a net:<src>-><dst> pattern, got {text!r}")
+        n_default, p_default = -1, (0.5 if action == "flaky" else 1.0)
+    else:
+        n_default, p_default = 1, 1.0
+    n = int(kv.get("n", n_default))
+    p = float(kv.get("p", p_default))
     delay_s = float(kv.get("ms", 50)) / 1000.0
     after = int(kv.get("after", 0))
     if "at" in kv:
         after = int(kv["at"]) - 1
         n = 1
-    return _Rule(index, pattern, action, n, p, delay_s, after, seed)
+    start_s = float(kv.get("start", 0.0))
+    for_s = float(kv["for"]) if "for" in kv else None
+    return _Rule(index, pattern, action, n, p, delay_s, after, seed,
+                 start_s=start_s, for_s=for_s)
 
 
 class ChaosPlane:
@@ -235,6 +352,9 @@ class ChaosPlane:
         self.has_channel_rules = False
         # Same fast-path flag for the checkpoint plane's ckpt:* family.
         self.has_ckpt_rules = False
+        # And for the link-level net:* family (rpc send paths + channel
+        # dials consult per frame).
+        self.has_net_rules = False
 
     # ------------------------------------------------------------------
     def _ensure(self):
@@ -289,6 +409,9 @@ class ChaosPlane:
             self.has_ckpt_rules = any(
                 r.pattern.startswith("ckpt:") and r.action in _CKPT_ACTIONS
                 for r in rules
+            )
+            self.has_net_rules = any(
+                r.action in _NET_ACTIONS for r in rules
             )
             self.schedule = []
             self.schedule_len = 0
@@ -427,6 +550,43 @@ class ChaosPlane:
             return _CKPT_CLEAN
         return CkptDecision(kill, torn, bit_flip)
 
+    def decide_net(self, src: str, dst: str) -> NetDecision:
+        """Fault decision for one frame traveling the directed link
+        ``src -> dst``.  Rules match with pattern
+        ``net:<src-glob>-><dst-glob>`` and one of ``_NET_ACTIONS``; both
+        globs must match their endpoint.  Verdicts are deterministic in
+        each rule's match ordinal (seeded ``flaky`` schedules replay),
+        and directionality is real: ``net:a->b:cut`` blackholes a→b
+        while b→a keeps flowing — the asymmetric-partition model."""
+        if not self.active or not self.has_net_rules:
+            return _NET_CLEAN
+        drop = False
+        delay_s = 0.0
+        fired_rules = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.action not in _NET_ACTIONS:
+                    continue
+                src_glob, _, dst_glob = rule.pattern[4:].partition("->")
+                if not fnmatch.fnmatchcase(src, src_glob):
+                    continue
+                if not fnmatch.fnmatchcase(dst, dst_glob):
+                    continue
+                fired = rule.evaluate()
+                self._log(rule, "fire" if fired else "skip")
+                if not fired:
+                    continue
+                fired_rules.append(rule)
+                if rule.action == "slow":
+                    delay_s += rule.delay_s
+                else:  # cut, flaky
+                    drop = True
+        for rule in fired_rules:  # outside the lock: metric writes lock too
+            _count_injection(rule)
+        if not fired_rules:
+            return _NET_CLEAN
+        return NetDecision(drop, delay_s)
+
     # ------------------------------------------------------------------
     def maybe_kill(self, point: str) -> bool:
         """Process fault points ("worker.exec", "raylet.tick",
@@ -496,6 +656,8 @@ class ChaosPlane:
                     "p": r.p,
                     "delay_ms": round(r.delay_s * 1000, 3),
                     "after": r.after,
+                    "start_s": r.start_s,
+                    "for_s": r.for_s,
                     "matches": r.matches,
                     "fired": r.fired,
                 }
@@ -517,6 +679,8 @@ def _count_injection(rule: _Rule) -> None:
         from ray_tpu._private import telemetry
 
         telemetry.count_chaos(rule.pattern, rule.action)
+        if rule.action in _NET_ACTIONS:
+            telemetry.count_chaos_net(rule.pattern, rule.action)
     except Exception:
         pass
 

@@ -166,10 +166,25 @@ class NodeInfo:
     object_store_dir: str
     resources_total: ResourceSet
     labels: Dict[str, str] = field(default_factory=dict)
-    state: str = "ALIVE"  # ALIVE | DRAINING | DEAD
+    state: str = "ALIVE"  # ALIVE | SUSPECT | DRAINING | QUARANTINED | DEAD
     start_time: float = field(default_factory=time.time)
     is_head: bool = False
     hostname: str = ""
+    # Membership incarnation, stamped by the GCS at registration and
+    # monotonic per node_id across re-registrations (and across GCS
+    # restarts — derived from wall time).  Raylet-originated writes
+    # carry it; stale writes are fenced (NodeFencedError).
+    incarnation: int = 0
+    # Directional-chaos identity reported by the raylet (net: rules).
+    net_name: str = ""
+    # Gray-failure ladder: last computed suspicion score (0..1) and the
+    # monotonic time the node entered SUSPECT/QUARANTINED (0 when not).
+    suspicion: float = 0.0
+    suspect_since: float = 0.0
+    quarantined_since: float = 0.0
+    # Times this node completed a QUARANTINED -> ALIVE recovery; above
+    # the flap budget the node stays quarantined until operator action.
+    flap_count: int = 0
     # Drain plane (reference: gcs_node_manager DrainNode + autoscaler
     # drain API): set when the node enters DRAINING.  reason is
     # "PREEMPTION" (spot/preemptible termination notice) or

@@ -83,6 +83,32 @@ _CONFIG_DEFS: Dict[str, Any] = {
     "health_check_period_ms": 1_000,
     "health_check_timeout_ms": 10_000,
     "health_check_failure_threshold": 5,
+    # --- gray-failure suspicion ladder (ALIVE -> SUSPECT -> QUARANTINED)
+    # Suspicion score (0..1) at which a node is soft-cordoned SUSPECT;
+    # below the clear threshold it returns to ALIVE (hysteresis band).
+    "suspect_score_threshold": 0.5,
+    "suspect_clear_threshold": 0.2,
+    # Raylet-measured GCS report RTT (ewma, ms) that saturates the gray
+    # score component; likewise consecutive failed report calls.
+    "suspect_rtt_ms": 2_000.0,
+    "suspect_rpc_errors": 5,
+    # Worker-channel degradation rates that saturate the gray component:
+    # blocked-seconds per wall second, and failed reattaches per window.
+    "suspect_channel_blocked_ratio": 0.5,
+    "suspect_channel_reattach_fails": 3,
+    # Sustained-SUSPECT duration before escalation to QUARANTINED (rides
+    # the drain machinery: migrate actors, re-replicate sole copies).
+    "quarantine_after_s": 5.0,
+    "quarantine_drain_deadline_s": 10.0,
+    # A QUARANTINED node must look healthy this long before it is
+    # readmitted ALIVE, and may recover at most node_flap_budget times —
+    # past the budget it stays quarantined until operator action.
+    "unquarantine_hysteresis_s": 5.0,
+    "node_flap_budget": 3,
+    # An asymmetric partition (raylet->gcs frames dropped, TCP conn still
+    # open) never closes the connection: heartbeat silence past
+    # timeout * this factor declares the node DEAD anyway.
+    "dead_conn_open_factor": 2.0,
     "task_retry_delay_ms": 100,
     # Default max retries for normal tasks.
     "task_max_retries": 3,
@@ -124,6 +150,12 @@ _CONFIG_DEFS: Dict[str, Any] = {
     # Seed for the chaos plane's per-rule RNG streams and retry jitter;
     # >= 0 makes the fault schedule replayable, -1 = unseeded.
     "testing_chaos_seed": -1,
+    # This process's identity for directional net:<src>-><dst> chaos
+    # rules.  Env-propagated, so a raylet spawned with
+    # RAY_TPU_chaos_net_name=node2 passes the name to its workers —
+    # every process on the drilled "node" shares one host-granularity
+    # link identity.  Empty = role default (gcs / raylet-<id8> / ...).
+    "chaos_net_name": "",
     # Artificial delay injected into every rpc handler, microseconds.
     "testing_asio_delay_us": 0,
     # --- task events / observability ---

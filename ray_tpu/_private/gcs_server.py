@@ -39,6 +39,14 @@ from ray_tpu._private.common import (
 )
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu.exceptions import NodeFencedError
+
+# Node states whose raylet is up and serving: its object copies are
+# readable and its reported usage counts.  SUSPECT/QUARANTINED nodes are
+# degraded-but-alive (soft-cordoned from NEW placement, which considers
+# only ALIVE) — treating them as dead here is exactly the false-DEAD
+# failure mode the gray-failure ladder exists to avoid.
+_LIVE_STATES = ("ALIVE", "SUSPECT", "DRAINING", "QUARANTINED")
 
 
 class _TenantTable:
@@ -117,6 +125,23 @@ class GcsServer:
         self.node_clients: Dict[NodeID, rpc.AsyncRpcClient] = {}
         self.available: Dict[NodeID, ResourceSet] = {}  # latest reported
         self.last_heartbeat: Dict[NodeID, float] = {}
+        # Membership incarnations: monotonic per node_id, ACROSS deaths —
+        # the fence that rejects a zombie raylet's writes after a healed
+        # partition.  Never popped on death (a dead incarnation must stay
+        # fenceable until the node re-registers with a higher one).
+        self.node_incarnations: Dict[NodeID, int] = {}
+        # Gray-failure ladder inputs: raylet-reported health from each
+        # resource_report ({"gcs_rtt_ms", "gcs_errors"}), and channel
+        # blocked/reattach totals snooped from worker metric snapshots
+        # (node -> worker_id -> (blocked_s, reattach_failed)).
+        self.node_health: Dict[NodeID, dict] = {}
+        self._chan_stats: Dict[NodeID, Dict[bytes, Tuple[float, float]]] = {}
+        # Per-node (prev_blocked_sum, prev_reattach_sum, t) for windowed
+        # channel-degradation rates in the suspicion score.
+        self._chan_prev: Dict[NodeID, Tuple[float, float, float]] = {}
+        # Monotonic time a QUARANTINED/SUSPECT node has looked healthy
+        # (score below the clear threshold) — the unquarantine hysteresis.
+        self._recover_since: Dict[NodeID, float] = {}
 
         # --- actor manager ---
         self.actors: Dict[ActorID, ActorInfo] = {}
@@ -239,6 +264,9 @@ class GcsServer:
         self._actor_node_deadline: Dict[ActorID, float] = {}
 
     async def start(self):
+        from ray_tpu._private.chaos import set_net_role
+
+        set_net_role("gcs")
         if CONFIG.gcs_storage == "file":
             self._load_snapshot()
         # This process's own metric/span reports (rpc handler latency,
@@ -471,11 +499,43 @@ class GcsServer:
             "drain_reason": info.drain_reason,
             "drain_deadline": info.drain_deadline,
             "drain_complete": info.drain_complete,
+            "incarnation": info.incarnation,
+            "suspicion": round(info.suspicion, 3),
+            "flap_count": info.flap_count,
         }
 
     # ------------------------------------------------------------------
     # node manager
     # ------------------------------------------------------------------
+    def _check_fence(self, method: str, node_id, incarnation) -> None:
+        """Reject a raylet-originated write carrying a stale (node_id,
+        incarnation).  Fenced when the stamp is below the current
+        incarnation, or equal to it but the node was declared DEAD at
+        that incarnation — the zombie-on-the-far-side-of-a-partition
+        case.  Unstamped payloads (workers, legacy callers) pass."""
+        if node_id is None or incarnation is None:
+            return
+        if not isinstance(node_id, NodeID):
+            node_id = NodeID(bytes(node_id))
+        cur = self.node_incarnations.get(node_id)
+        if cur is None:
+            return
+        incarnation = int(incarnation)
+        info = self.nodes.get(node_id)
+        dead = info is None or info.state == "DEAD"
+        if incarnation < cur or (incarnation == cur and dead):
+            telemetry.count_fence_rejection(method)
+            logger.warning(
+                "fenced %s from node %s: incarnation %d (current %d%s)",
+                method, node_id.hex()[:8], incarnation, cur,
+                ", DEAD" if dead else "",
+            )
+            raise NodeFencedError(
+                f"{method} from node {node_id.hex()[:8]} fenced: "
+                f"incarnation {incarnation} is stale (current {cur})",
+                node_id=node_id.binary(),
+                incarnation=incarnation,
+            )
     async def rpc_register_node(self, payload, conn):
         info = NodeInfo(
             node_id=NodeID(payload["node_id"]),
@@ -485,17 +545,38 @@ class GcsServer:
             labels=payload.get("labels", {}),
             is_head=payload.get("is_head", False),
             hostname=payload.get("hostname", ""),
+            net_name=payload.get("net_name", ""),
         )
+        # Stamp a fresh incarnation: strictly above every prior one for
+        # this node_id, and wall-clock-derived so monotonicity survives a
+        # GCS restart that lost the map (a rebooted GCS must never hand
+        # out an incarnation a zombie from before the crash still holds).
+        prev = self.nodes.get(info.node_id)
+        inc = max(self.node_incarnations.get(info.node_id, 0) + 1, int(time.time()))
+        self.node_incarnations[info.node_id] = inc
+        info.incarnation = inc
+        if prev is not None:
+            # Re-registration carries over the flap history: quarantine's
+            # flap budget must not reset just because the raylet bounced.
+            info.flap_count = prev.flap_count
         self.nodes[info.node_id] = info
         self.available[info.node_id] = info.resources_total.copy()
         self.node_conns[info.node_id] = conn
         self.last_heartbeat[info.node_id] = time.monotonic()
+        self.node_health.pop(info.node_id, None)
+        self._chan_stats.pop(info.node_id, None)
+        self._chan_prev.pop(info.node_id, None)
+        self._recover_since.pop(info.node_id, None)
         conn.meta["node_id"] = info.node_id
-        client = rpc.AsyncRpcClient(info.raylet_address)
+        conn.meta["incarnation"] = inc
+        client = rpc.AsyncRpcClient(info.raylet_address, peer_name=info.net_name)
         await client.connect()
         self.node_clients[info.node_id] = client
         self.publish("nodes", ("ALIVE", self._node_dict(info)))
-        logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
+        logger.info(
+            "node %s registered (%s, incarnation %d)",
+            info.node_id.hex()[:8], info.raylet_address, inc,
+        )
         # Reconciliation for re-registration after a GCS restart: the
         # raylet reports which actors it still hosts and which objects it
         # holds; actors this GCS believes live on that node but the raylet
@@ -512,13 +593,21 @@ class GcsServer:
             self.sealed_ever.add(bytes(oid))
         # Re-schedule anything that was waiting for resources.
         self._kick_pending()
-        return {"session_info": self.session_info}
+        return {"session_info": self.session_info, "incarnation": inc}
 
     async def rpc_resource_report(self, payload, conn):
         """Periodic per-raylet load report (reference: ray_syncer)."""
         node_id = NodeID(payload["node_id"])
+        # Fence BEFORE the heartbeat touch: a zombie incarnation must not
+        # keep its successor's liveness fresh (or resurrect a DEAD entry).
+        self._check_fence("resource_report", node_id, payload.get("incarnation"))
         self.last_heartbeat[node_id] = time.monotonic()
-        if node_id in self.nodes and self.nodes[node_id].state == "ALIVE":
+        # Raylet-measured health (report RTT ewma, consecutive GCS call
+        # failures) feeds the gray-failure suspicion score; accepted in
+        # every live state — a SUSPECT node recovering must be heard.
+        if node_id in self.nodes and self.nodes[node_id].state != "DEAD":
+            self.node_health[node_id] = payload.get("health") or {}
+        if node_id in self.nodes and self.nodes[node_id].state in ("ALIVE", "SUSPECT"):
             self.pending_shapes[node_id] = payload.get("pending_shapes", [])
             self.tenant_usage_by_node[node_id] = payload.get("tenant_usage", {})
             # Reconcile the lease-admission ledger: this report's
@@ -549,6 +638,41 @@ class GcsServer:
                 self._kick_pending()
         return True
 
+    def _suspicion_score(self, node_id: NodeID, now: float, threshold: float) -> float:
+        """Blended gray-failure suspicion for one node (0..1).
+
+        Hard silence — the heartbeat gap against the death threshold —
+        is the only component allowed to reach 1.0.  Gray signals
+        (raylet-measured GCS report RTT/consecutive errors, worker-
+        reported channel blocked-seconds and failed-reattach rates) cap
+        at 0.9: a slow-but-alive link can push a node to SUSPECT and
+        QUARANTINED, but never to a false DEAD."""
+        gap = now - self.last_heartbeat.get(node_id, now)
+        score = min(1.0, gap / threshold) if threshold > 0 else 0.0
+        gray = 0.0
+        h = self.node_health.get(node_id) or {}
+        if float(CONFIG.suspect_rtt_ms) > 0:
+            gray = max(gray, float(h.get("gcs_rtt_ms", 0.0)) / float(CONFIG.suspect_rtt_ms))
+        if int(CONFIG.suspect_rpc_errors) > 0:
+            gray = max(gray, float(h.get("gcs_errors", 0)) / int(CONFIG.suspect_rpc_errors))
+        stats = self._chan_stats.get(node_id)
+        if stats:
+            blocked = sum(b for b, _ in stats.values())
+            refail = sum(r for _, r in stats.values())
+            pb, pr, pt = self._chan_prev.get(node_id, (blocked, refail, now))
+            dt = now - pt
+            if dt > 0:
+                rate = max(0.0, blocked - pb) / dt
+                if float(CONFIG.suspect_channel_blocked_ratio) > 0:
+                    gray = max(gray, rate / float(CONFIG.suspect_channel_blocked_ratio))
+                if int(CONFIG.suspect_channel_reattach_fails) > 0:
+                    gray = max(
+                        gray,
+                        max(0.0, refail - pr) / int(CONFIG.suspect_channel_reattach_fails),
+                    )
+            self._chan_prev[node_id] = (blocked, refail, now)
+        return max(score, min(0.9, gray))
+
     async def _health_loop(self):
         period = CONFIG.health_check_period_ms / 1000
         threshold = CONFIG.health_check_timeout_ms / 1000
@@ -556,15 +680,59 @@ class GcsServer:
             await asyncio.sleep(period)
             now = time.monotonic()
             for node_id, info in list(self.nodes.items()):
-                # DRAINING nodes stay under heartbeat watch: the reactive
-                # path is the fallback when the drain notice (or the whole
-                # drain) is lost — a preempted node that dies at its
-                # deadline is detected here like any other death.
-                if info.state not in ("ALIVE", "DRAINING"):
+                # DRAINING/SUSPECT/QUARANTINED nodes stay under heartbeat
+                # watch: the reactive path is the fallback when the drain
+                # notice (or the whole drain) is lost — a preempted node
+                # that dies at its deadline is detected here like any
+                # other death.
+                if info.state == "DEAD":
                     continue
                 conn = self.node_conns.get(node_id)
-                if (conn is None or conn.closed) and now - self.last_heartbeat.get(node_id, now) > threshold:
+                gap = now - self.last_heartbeat.get(node_id, now)
+                score = self._suspicion_score(node_id, now, threshold)
+                info.suspicion = score
+                telemetry.set_node_suspicion(node_id.hex()[:12], score)
+                # Hard-silence death.  An asymmetric partition (this
+                # node's frames dropped, TCP conn still open at our end)
+                # never closes the connection — sustained silence past
+                # dead_conn_open_factor x timeout kills it anyway.
+                if gap > threshold and (
+                    conn is None
+                    or conn.closed
+                    or gap > threshold * float(CONFIG.dead_conn_open_factor)
+                ):
                     await self._mark_node_dead(node_id, "health check: heartbeat timeout")
+                    continue
+                if info.state == "DRAINING":
+                    continue  # the drain task owns the next transition
+                if info.state == "ALIVE":
+                    if score >= float(CONFIG.suspect_score_threshold):
+                        info.state = "SUSPECT"
+                        info.suspect_since = now
+                        self._recover_since.pop(node_id, None)
+                        logger.warning(
+                            "node %s SUSPECT (score %.2f): soft-cordoned",
+                            node_id.hex()[:8], score,
+                        )
+                        self.publish("nodes", ("SUSPECT", self._node_dict(info)))
+                elif info.state == "SUSPECT":
+                    if score < float(CONFIG.suspect_clear_threshold):
+                        info.state = "ALIVE"
+                        info.suspect_since = 0.0
+                        logger.info(
+                            "node %s recovered from SUSPECT (score %.2f)",
+                            node_id.hex()[:8], score,
+                        )
+                        self.publish("nodes", ("ALIVE", self._node_dict(info)))
+                        self._kick_pending()
+                    elif score < float(CONFIG.suspect_score_threshold):
+                        # Dipped into the hysteresis band: hold SUSPECT
+                        # but restart the escalation clock.
+                        info.suspect_since = now
+                    elif now - info.suspect_since >= float(CONFIG.quarantine_after_s):
+                        await self._quarantine_node(info, "gray_failure")
+                elif info.state == "QUARANTINED":
+                    self._maybe_unquarantine(info, score, now)
             # Jobs restored from a snapshot whose driver never reattached.
             for job_id, deadline in list(self._job_reattach_deadline.items()):
                 if now > deadline:
@@ -624,6 +792,14 @@ class GcsServer:
         self.pending_shapes.pop(node_id, None)
         self.tenant_usage_by_node.pop(node_id, None)
         self.pending_tenant_demand.pop(node_id, None)
+        # Suspicion-plane state dies with the node; node_incarnations
+        # survives on purpose — the fence outlives the corpse.
+        self.node_health.pop(node_id, None)
+        self._chan_stats.pop(node_id, None)
+        self._chan_prev.pop(node_id, None)
+        self._recover_since.pop(node_id, None)
+        info.suspicion = 1.0
+        telemetry.set_node_suspicion(node_id.hex()[:12], 1.0)
         client = self.node_clients.pop(node_id, None)
         if client:
             client.close()
@@ -817,6 +993,10 @@ class GcsServer:
                 "object(s) still unreplicated",
                 node_id.hex()[:8], elapsed, len(current_doomed()),
             )
+            # A quarantine drain still parks the node: nothing is about
+            # to kill it, and its copies keep serving reads from
+            # QUARANTINED exactly as they did from DRAINING.
+            self._finish_quarantine(info)
             return
         info.drain_complete = True
         telemetry.observe_drain_migration(elapsed)
@@ -826,6 +1006,80 @@ class GcsServer:
             node_id.hex()[:8], elapsed, migrated, len(requested),
         )
         self.publish("nodes", ("DRAINING", self._node_dict(info)))
+        self._finish_quarantine(info)
+
+    # ------------------------------------------------------------------
+    # quarantine plane: sustained gray failure rides the drain machinery
+    # (stop placement, migrate restartable actors, re-replicate sole
+    # copies) but parks in QUARANTINED instead of being terminated, and
+    # is readmitted with hysteresis under a bounded flap budget.
+    # ------------------------------------------------------------------
+    async def _quarantine_node(self, info: NodeInfo, reason: str):
+        node_id = info.node_id
+        telemetry.count_quarantine(reason, "enter")
+        logger.warning(
+            "node %s QUARANTINED (%s, score %.2f): draining work off it",
+            node_id.hex()[:8], reason, info.suspicion,
+        )
+        await self.rpc_drain_node(
+            {
+                "node_id": node_id.binary(),
+                "reason": "QUARANTINE",
+                "deadline_s": float(CONFIG.quarantine_drain_deadline_s),
+            },
+            None,
+        )
+
+    def _finish_quarantine(self, info: NodeInfo):
+        """A completed (or deadline-expired) QUARANTINE drain parks the
+        node in QUARANTINED; other drains end in termination instead."""
+        if info.drain_reason != "QUARANTINE" or info.state != "DRAINING":
+            return
+        info.state = "QUARANTINED"
+        info.quarantined_since = time.monotonic()
+        self._recover_since.pop(info.node_id, None)
+        logger.warning("node %s parked in QUARANTINED", info.node_id.hex()[:8])
+        self.publish("nodes", ("QUARANTINED", self._node_dict(info)))
+
+    def _maybe_unquarantine(self, info: NodeInfo, score: float, now: float):
+        node_id = info.node_id
+        if score >= float(CONFIG.suspect_clear_threshold):
+            self._recover_since.pop(node_id, None)  # hysteresis resets
+            return
+        since = self._recover_since.setdefault(node_id, now)
+        if now - since < float(CONFIG.unquarantine_hysteresis_s):
+            return
+        if info.flap_count >= int(CONFIG.node_flap_budget):
+            # Budget exhausted: a link that oscillates every few seconds
+            # must not keep yanking the node in and out of the placement
+            # pool.  Stays quarantined until re-registration/operator.
+            return
+        info.flap_count += 1
+        info.state = "ALIVE"
+        info.suspect_since = 0.0
+        info.quarantined_since = 0.0
+        info.drain_reason = None
+        info.drain_deadline = 0.0
+        info.drain_complete = False
+        self._recover_since.pop(node_id, None)
+        telemetry.count_quarantine("gray_failure", "exit")
+        logger.warning(
+            "node %s un-quarantined (flap %d/%d)",
+            node_id.hex()[:8], info.flap_count, int(CONFIG.node_flap_budget),
+        )
+        # The raylet was told to drain when quarantine entered — tell it
+        # to resume granting leases (best-effort; its next lease attempt
+        # would otherwise be rejected forever).
+        client = self.node_clients.get(node_id)
+        if client is not None:
+            async def _undrain():
+                try:
+                    await client.push("undrain", {})
+                except Exception:
+                    pass
+            self.loop.create_task(_undrain())
+        self.publish("nodes", ("ALIVE", self._node_dict(info)))
+        self._kick_pending()
 
     # ------------------------------------------------------------------
     # job manager
@@ -952,7 +1206,7 @@ class GcsServer:
     def _cluster_totals(self) -> Dict[str, float]:
         totals: Dict[str, float] = {}
         for info in self.nodes.values():
-            if info.state in ("ALIVE", "DRAINING"):
+            if info.state in _LIVE_STATES:
                 for k, v in info.resources_total.items():
                     totals[k] = totals.get(k, 0.0) + v
         return totals
@@ -966,7 +1220,7 @@ class GcsServer:
         usage: Dict[str, Dict[str, float]] = {}
         for node_id, per_tenant in self.tenant_usage_by_node.items():
             info = self.nodes.get(node_id)
-            if info is None or info.state not in ("ALIVE", "DRAINING"):
+            if info is None or info.state not in _LIVE_STATES:
                 continue
             for tenant, res in per_tenant.items():
                 tenants_mod.add_usage(usage, tenant, res)
@@ -983,7 +1237,7 @@ class GcsServer:
         # never over-admit.)
         for node_id, entries in list(self._lease_charges.items()):
             info = self.nodes.get(node_id)
-            if info is None or info.state not in ("ALIVE", "DRAINING"):
+            if info is None or info.state not in _LIVE_STATES:
                 self._lease_charges.pop(node_id, None)
                 continue
             entries[:] = [e for e in entries if now - e[2] < 5.0]
@@ -1020,6 +1274,12 @@ class GcsServer:
         is reconciled away when the granting node's next resource_report
         arrives carrying the lease (and time-capped for nodes that die
         first)."""
+        # Fence BEFORE the enforcement short-circuit: a zombie raylet's
+        # lease confirmation must fail typed (the raylet reacts by
+        # tearing down), never silently succeed.
+        self._check_fence(
+            "tenant_charge_lease", payload.get("node_id"), payload.get("incarnation")
+        )
         if not CONFIG.tenant_quota_enforcement:
             return {"ok": True}
         node_id = NodeID(payload["node_id"])
@@ -1349,14 +1609,20 @@ class GcsServer:
     # object directory
     # ------------------------------------------------------------------
     async def rpc_object_location_add(self, payload, conn):
-        oid, node_bytes = payload
+        # (oid, node_id[, incarnation]) — a fenced add can never
+        # resurrect a freed/re-owned copy from a zombie raylet.
+        oid, node_bytes = payload[0], payload[1]
+        inc = payload[2] if len(payload) > 2 else None
+        self._check_fence("object_location_add", node_bytes, inc)
         self.object_locations[oid].add(NodeID(node_bytes))
         self.sealed_ever.add(bytes(oid))
         self.publish(f"obj:{oid.hex() if isinstance(oid, ObjectID) else bytes(oid).hex()}", True)
         return True
 
     async def rpc_object_location_remove(self, payload, conn):
-        oid, node_bytes = payload
+        oid, node_bytes = payload[0], payload[1]
+        inc = payload[2] if len(payload) > 2 else None
+        self._check_fence("object_location_remove", node_bytes, inc)
         locs = self.object_locations.get(oid)
         if locs:
             locs.discard(NodeID(node_bytes))
@@ -1370,10 +1636,10 @@ class GcsServer:
         out = []
         for n in locs:
             info = self.nodes.get(n)
-            # DRAINING nodes still serve reads: their copies are valid
-            # until the deadline, and drain-time re-replication pulls
-            # FROM them.
-            if info and info.state in ("ALIVE", "DRAINING"):
+            # DRAINING / SUSPECT / QUARANTINED nodes still serve reads:
+            # their copies are valid while the raylet is up, and drain-
+            # time re-replication pulls FROM them.
+            if info and info.state in _LIVE_STATES:
                 out.append({"node_id": n.binary(), "raylet_address": info.raylet_address})
         return out
 
@@ -1405,7 +1671,7 @@ class GcsServer:
         locs = self.object_locations.get(oid) or ()
         return not any(
             (info := self.nodes.get(n)) is not None
-            and info.state in ("ALIVE", "DRAINING")
+            and info.state in _LIVE_STATES
             for n in locs
         )
 
@@ -1732,6 +1998,9 @@ class GcsServer:
 
     async def rpc_actor_death_report(self, payload, conn):
         """Raylet reports an actor's worker exited."""
+        self._check_fence(
+            "actor_death_report", payload.get("node_id"), payload.get("incarnation")
+        )
         actor_id = ActorID(payload["actor_id"])
         info = self.actors.get(actor_id)
         if info is None:
@@ -2226,10 +2495,10 @@ class GcsServer:
                 demands.extend(dict(b.resources) for b in pg.bundles)
         nodes = {}
         for node_id, info in self.nodes.items():
-            # DRAINING nodes stay visible (state-tagged) so the autoscaler
-            # can poll drain progress before terminating; consumers must
-            # not count them as free capacity.
-            if info.state not in ("ALIVE", "DRAINING"):
+            # DRAINING/SUSPECT/QUARANTINED nodes stay visible (state-
+            # tagged) so the autoscaler can poll drain progress before
+            # terminating; consumers must not count them as free capacity.
+            if info.state not in _LIVE_STATES:
                 continue
             nodes[node_id.hex()] = {
                 "total": dict(info.resources_total),
@@ -2286,6 +2555,9 @@ class GcsServer:
     async def rpc_task_event_report(self, payload, conn):
         """Batched task events from a worker's event buffer (reference:
         core_worker/task_event_buffer.h)."""
+        self._check_fence(
+            "task_event_report", payload.get("node_id"), payload.get("incarnation")
+        )
         for e in payload.get("events", ()):
             self.task_events.append(e)
         return True
@@ -2296,8 +2568,40 @@ class GcsServer:
         return events[-limit:]
 
     async def rpc_metrics_report(self, payload, conn):
+        self._check_fence(
+            "metrics_report", payload.get("node_id"), payload.get("incarnation")
+        )
         self.metrics[payload.get("worker_id", b"")] = payload.get("metrics", [])
+        nid = payload.get("node_id")
+        if nid is not None:
+            self._note_channel_health(
+                NodeID(bytes(nid)),
+                payload.get("worker_id", b""),
+                payload.get("metrics", []),
+            )
         return True
+
+    def _note_channel_health(self, node_id: NodeID, worker_id: bytes, metrics):
+        """Snoop channel blocked-seconds / failed-reattach totals out of
+        a node's worker metric snapshots — the dataplane's contribution
+        to that node's gray-failure suspicion score."""
+        if node_id not in self.nodes:
+            return
+        blocked = refail = 0.0
+        seen = False
+        for rec in metrics:
+            name = rec.get("name")
+            if name == "channel_blocked_seconds_total":
+                blocked += float(rec.get("value", 0.0))
+                seen = True
+            elif (
+                name == "channel_reattach_total"
+                and rec.get("tags", {}).get("result") == "failed"
+            ):
+                refail += float(rec.get("value", 0.0))
+                seen = True
+        if seen:
+            self._chan_stats.setdefault(node_id, {})[worker_id] = (blocked, refail)
 
     def _local_report(self, method: str, payload: dict):
         """In-process report channel for the GCS's own flusher threads.
@@ -2329,6 +2633,9 @@ class GcsServer:
     async def rpc_span_report(self, payload, conn):
         """Batched finished spans from a process's span flusher
         (util/tracing.flush — the off-box half of the flight recorder)."""
+        self._check_fence(
+            "span_report", payload.get("node_id"), payload.get("incarnation")
+        )
         self.spans.extend(self._report_tenant(payload), payload.get("spans", ()))
         return True
 
@@ -2336,6 +2643,9 @@ class GcsServer:
         """A finished sampling-profiler capture shipped by the profiled
         process (profiling.py) — recoverable by session_id even after
         the process dies."""
+        self._check_fence(
+            "profile_report", payload.get("node_id"), payload.get("incarnation")
+        )
         rec = payload.get("profile")
         if rec:
             self.profiles.append(self._report_tenant(payload), rec)

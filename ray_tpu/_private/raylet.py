@@ -33,6 +33,7 @@ from ray_tpu._private.common import ResourceSet, TaskSpec
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, WorkerID
 from ray_tpu._private.object_store import ObjectStoreCore
+from ray_tpu.exceptions import NodeFencedError
 
 logger = logging.getLogger(__name__)
 
@@ -172,6 +173,19 @@ class Raylet:
         self.cluster_view: Dict[bytes, dict] = {}
         self.gcs: Optional[rpc.AsyncRpcClient] = None
         self.peer_clients: Dict[str, rpc.AsyncRpcClient] = {}
+        # Membership incarnation, stamped by the GCS at registration and
+        # carried on every raylet-originated write.  A NodeFencedError
+        # reply means the GCS declared this incarnation dead while we
+        # were partitioned: tear down and re-register fresh (see
+        # _fenced_teardown).
+        self.incarnation = 0
+        self._fencing_task: Optional[asyncio.Task] = None
+        # Raylet-measured GCS health: resource_report round-trip ewma and
+        # the current consecutive-failure streak, shipped back to the GCS
+        # inside every report as its gray-failure suspicion input (a
+        # sustained `slow` link shows up here long before heartbeats die).
+        self._gcs_rtt_ms = 0.0
+        self._gcs_call_errors = 0
 
         # Placement group bundles: (pg_id bytes, idx) -> reservation state
         self.bundles: Dict[Tuple[bytes, int], dict] = {}
@@ -245,6 +259,9 @@ class Raylet:
     # lifecycle
     # ------------------------------------------------------------------
     async def start(self):
+        from ray_tpu._private.chaos import set_net_role
+
+        set_net_role(f"raylet-{self.node_id.hex()[:8]}")
         await self.server.start()
         await self._connect_gcs(first=True)
         # Route this process's metric/span reports through the raylet's
@@ -468,12 +485,16 @@ class Raylet:
             self.loop.create_task(
                 self._safe_gcs_push(
                     "actor_death_report",
-                    {"actor_id": actor_id.binary(), "intended": False, "reason": f"oom: {detail}"},
+                    self._stamped(
+                        {"actor_id": actor_id.binary(), "intended": False, "reason": f"oom: {detail}"}
+                    ),
                 )
             )
         self._schedule_dispatch()
 
     def _register_payload(self) -> dict:
+        from ray_tpu._private.chaos import net_name
+
         return {
             "node_id": self.node_id.binary(),
             "raylet_address": self.address,
@@ -482,21 +503,108 @@ class Raylet:
             "labels": self.labels,
             "is_head": self.is_head,
             "hostname": os.uname().nodename,
+            # Directional-chaos identity: lets the GCS consult net:
+            # rules for its node-client frames (gcs -> this raylet).
+            "net_name": net_name(),
             # Resync state for (re-)registration after a GCS restart.
             "live_actors": [a.binary() for a in self.actor_workers],
             "sealed_objects": [o.binary() for o in self.store.objects],
         }
 
     async def _connect_gcs(self, first: bool = False):
-        client = rpc.AsyncRpcClient(self.gcs_address)
+        client = rpc.AsyncRpcClient(self.gcs_address, peer_name="gcs")
         client.on_push = self._on_gcs_push
         client.on_close = self._on_gcs_lost
         await client.connect()
-        await client.call("register_node", self._register_payload())
+        reply = await client.call("register_node", self._register_payload())
+        # The GCS stamps a fresh incarnation at every registration; all
+        # raylet-originated writes carry it so a fenced zombie's reports
+        # are rejected typed (see _fenced_teardown).
+        if isinstance(reply, dict):
+            self.incarnation = int(reply.get("incarnation", self.incarnation))
         await client.call("subscribe", "resources")
         await client.call("subscribe", "nodes")
         await client.call("subscribe", "tenant_usage")
         self.gcs = client
+
+    def _stamped(self, payload: dict) -> dict:
+        """Stamp a raylet-originated write with this node's membership
+        identity so the GCS can fence it if the incarnation went stale."""
+        payload["node_id"] = self.node_id.binary()
+        payload["incarnation"] = self.incarnation
+        return payload
+
+    def _on_fenced(self):
+        """A GCS reply carried NodeFencedError: this raylet's incarnation
+        was declared dead while it was partitioned, and a successor view
+        of the cluster no longer includes it.  Tear down exactly once
+        (concurrent fenced replies from the report loop, location pushes
+        and telemetry flushers all funnel here)."""
+        if self._stopping or (
+            self._fencing_task is not None and not self._fencing_task.done()
+        ):
+            return
+        self._fencing_task = self.loop.create_task(self._fenced_teardown())
+
+    async def _fenced_teardown(self):
+        fenced_inc = self.incarnation
+        logger.warning(
+            "raylet %s fenced (incarnation %d was declared dead): killing "
+            "workers, reaping channel shm, re-registering fresh",
+            self.node_id.hex()[:8], fenced_inc,
+        )
+        # 1. Everything admitted under the dead incarnation is void: the
+        # GCS already restarted those actors elsewhere and failed the
+        # tasks — a surviving worker here would be a split-brain zombie.
+        for w in list(self.workers.values()):
+            self._kill_worker_proc(w)
+        self.queue.clear()
+        self.infeasible.clear()
+        while self.lease_waiters:
+            waiter = self.lease_waiters.popleft()
+            if not waiter.fut.done():
+                waiter.fut.set_result("draining")
+        self.bundles.clear()
+        self.resources_available = self.resources_total.copy()
+        self._inflight_lease_usage.clear()
+        self.draining = False
+        self.drain_reason = None
+        self.drain_deadline = 0.0
+        # 2. Reap orphaned dataplane shm the killed workers left behind
+        # (same sweeper the idle reaper runs on cadence).
+        try:
+            from ray_tpu.experimental.channel import sweep_orphan_ring_dirs
+
+            reclaimed = sweep_orphan_ring_dirs()
+            if reclaimed:
+                logger.info(
+                    "fenced teardown reclaimed %d orphaned channel shm files",
+                    reclaimed,
+                )
+        except Exception:
+            logger.exception("fenced shm sweep failed")
+        # 3. Re-register as a fresh incarnation.  The old client must not
+        # fire its on_close reconnect path on top of this one.
+        old = self.gcs
+        if old is not None:
+            old.on_close = None
+            old.close()
+        bo = retry.RECONNECT.start(deadline_s=CONFIG.gcs_reconnect_timeout_s)
+        while not self._stopping:
+            try:
+                await self._connect_gcs()
+                logger.info(
+                    "raylet %s re-registered after fencing: incarnation %d -> %d",
+                    self.node_id.hex()[:8], fenced_inc, self.incarnation,
+                )
+                return
+            except Exception:
+                delay = bo.next_delay()
+                if delay is None:
+                    break
+                await asyncio.sleep(delay)
+        if not self._stopping and self.on_fatal:
+            self.on_fatal()
 
     def _on_gcs_lost(self):
         """GCS connection dropped: retry with backoff — the GCS restarts
@@ -658,11 +766,19 @@ class Raylet:
                 except Exception:
                     logger.exception("tenant quota reconciliation failed")
             local_tenant_usage = self._local_tenant_usage()
+            t_report = time.monotonic()
             try:
                 await self.gcs.call(
                     "resource_report",
                     {
                         "node_id": self.node_id.binary(),
+                        "incarnation": self.incarnation,
+                        # Self-measured GCS link health (previous ticks):
+                        # the suspicion score's gray-failure input.
+                        "health": {
+                            "gcs_rtt_ms": round(self._gcs_rtt_ms, 1),
+                            "gcs_errors": self._gcs_call_errors,
+                        },
                         "available": dict(self.resources_available),
                         "total": dict(self.resources_total),
                         "has_pending": bool(self.queue or self.infeasible),
@@ -702,8 +818,13 @@ class Raylet:
                     timeout=10,
                 )
                 self._published_tenant_usage = local_tenant_usage
+                rtt_ms = (time.monotonic() - t_report) * 1000
+                self._gcs_rtt_ms = 0.7 * self._gcs_rtt_ms + 0.3 * rtt_ms
+                self._gcs_call_errors = 0
+            except NodeFencedError:
+                self._on_fenced()
             except rpc.RpcError:
-                pass
+                self._gcs_call_errors += 1
             # Periodically retry infeasible tasks (cluster membership or
             # resources may have changed); doing this here rather than in
             # _dispatch avoids a hot requeue loop for never-satisfiable
@@ -980,8 +1101,12 @@ class Raylet:
             try:
                 await self.gcs.call(
                     "actor_death_report",
-                    {"actor_id": w.actor_id.binary(), "intended": False, "reason": "actor worker process died"},
+                    self._stamped(
+                        {"actor_id": w.actor_id.binary(), "intended": False, "reason": "actor worker process died"}
+                    ),
                 )
+            except NodeFencedError:
+                self._on_fenced()
             except rpc.RpcError:
                 pass
         self._schedule_dispatch()
@@ -1250,7 +1375,7 @@ class Raylet:
     async def _peer(self, address: str) -> rpc.AsyncRpcClient:
         client = self.peer_clients.get(address)
         if client is None or not client._connected:
-            client = rpc.AsyncRpcClient(address)
+            client = rpc.AsyncRpcClient(address, peer_name="raylet")
             await client.connect()
             self.peer_clients[address] = client
         return client
@@ -1500,6 +1625,7 @@ class Raylet:
                 "tenant_charge_lease",
                 {
                     "node_id": self.node_id.binary(),
+                    "incarnation": self.incarnation,
                     "tenant": tenant,
                     "resources": dict(res),
                     "check": True,
@@ -1507,6 +1633,12 @@ class Raylet:
                 timeout=2,
             )
             return bool(out.get("ok", True)) if isinstance(out, dict) else True
+        except NodeFencedError:
+            # This incarnation was declared dead behind a partition: the
+            # optimistic-True fallback would admit work the GCS already
+            # restarted elsewhere.  Refuse the grant and tear down.
+            self._on_fenced()
+            return False
         except Exception:  # noqa: BLE001 — reconcile/revocation mop up
             return True
 
@@ -2167,7 +2299,9 @@ class Raylet:
         async def run():
             if prev is not None:
                 await prev
-            await self._safe_gcs_push(method, (key, self.node_id.binary()))
+            await self._safe_gcs_push(
+                method, (key, self.node_id.binary(), self.incarnation)
+            )
 
         task = self.loop.create_task(run())
         self._loc_chain[key] = task
@@ -2188,6 +2322,11 @@ class Raylet:
         while True:
             try:
                 await self.gcs.call(method, payload, timeout=10)
+                return
+            except NodeFencedError:
+                # Typed rejection, not a transient drop: retrying a
+                # fenced write can never succeed.
+                self._on_fenced()
                 return
             except rpc.RpcError:
                 delay = bo.next_delay()
@@ -2321,6 +2460,19 @@ class Raylet:
             if not waiter.fut.done():
                 waiter.fut.set_result("draining")
         # Queued tasks re-run the spill decision (now drain-aware).
+        self._schedule_dispatch()
+
+    async def push_undrain(self, payload, conn):
+        """From GCS: the quarantine that drained this node lifted — the
+        node is ALIVE again and must resume granting leases."""
+        if not self.draining:
+            return
+        logger.warning(
+            "raylet %s un-drained: resuming lease grants", self.node_id.hex()[:8]
+        )
+        self.draining = False
+        self.drain_reason = None
+        self.drain_deadline = 0.0
         self._schedule_dispatch()
 
     async def push_replicate_objects(self, payload, conn):
@@ -2503,9 +2655,14 @@ class Raylet:
             or not self.loop.is_running()
         ):
             raise rpc.ConnectionLost("gcs not reachable for telemetry report")
+        payload = self._stamped(dict(payload))
         fut = asyncio.run_coroutine_threadsafe(gcs.call(method, payload), self.loop)
         try:
             fut.result(timeout=5)
+        except NodeFencedError:
+            # Runs on a flusher thread: the teardown must hop to the loop.
+            self.loop.call_soon_threadsafe(self._on_fenced)
+            raise
         except Exception:
             fut.cancel()
             raise

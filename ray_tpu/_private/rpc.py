@@ -26,12 +26,24 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from ray_tpu._private.chaos import CHAOS
+from ray_tpu._private.chaos import CHAOS, net_name as _net_name
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private import retry, telemetry
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
+
+
+def _net_decision(peer_name: str):
+    """Link verdict for one frame leaving this process toward
+    ``peer_name`` (None on the no-net-chaos fast path).  Every send
+    site — request, reply, push, dial — consults its own direction of
+    travel exactly once, so ``net:a->b:cut`` blackholes a→b while b→a
+    keeps flowing (the asymmetric-partition model)."""
+    if not (CHAOS.active and CHAOS.has_net_rules):
+        return None
+    d = CHAOS.decide_net(_net_name(), peer_name or "?")
+    return None if d.clean else d
 
 # Sentinel distinguishing "caller did not pass a timeout" (use the config
 # default) from an explicit None (wait forever).
@@ -75,6 +87,12 @@ class ClientConn:
 
     def push(self, method: str, payload: Any):
         if self.closed:
+            return
+        # Link chaos, drop only: this runs on the event loop, so a slow
+        # link cannot sleep here — server-side delays are modeled on the
+        # reply path (_deliver) instead.
+        nd = _net_decision(self.meta.get("net_name", ""))
+        if nd is not None and nd.drop:
             return
         data = pickle.dumps(("push", method, payload), protocol=5)
         try:
@@ -155,6 +173,15 @@ class RpcServer:
                     await res
 
     async def _dispatch(self, msg, conn: ClientConn):
+        if msg[0] == "hello":
+            # Connection identity frame (first thing a client sends):
+            # carries the peer's chaos net name so server-originated
+            # frames (replies, pushes) can be matched against
+            # directional net: rules.  Never itself faulted — a link
+            # that admits the connect admits the hello.
+            if isinstance(msg[1], dict):
+                conn.meta.update(msg[1])
+            return
         delay_us = CONFIG.testing_asio_delay_us
         if delay_us:
             await asyncio.sleep(delay_us / 1e6)
@@ -197,6 +224,14 @@ class RpcServer:
                     await asyncio.sleep(rep.delay_s)
                 if rep.drop:
                     return
+                # The reply travels server→client: its own link
+                # direction, consulted independently of the request's.
+                nd = _net_decision(conn.meta.get("net_name", ""))
+                if nd is not None:
+                    if nd.delay_s > 0:
+                        await asyncio.sleep(nd.delay_s)
+                    if nd.drop:
+                        return
             if conn.closed:
                 return
             try:
@@ -233,8 +268,9 @@ class RpcServer:
 # Async client (service ↔ service, runs inside an asyncio loop)
 # --------------------------------------------------------------------------
 class AsyncRpcClient:
-    def __init__(self, address: str):
+    def __init__(self, address: str, peer_name: str = ""):
         self.address = address
+        self.peer_name = peer_name
         self._reader = None
         self._writer = None
         self._req_id = 0
@@ -250,6 +286,16 @@ class AsyncRpcClient:
         kind, target = _parse_address(self.address)
         bo = retry.CONNECT.start(deadline_s=timeout)
         while True:
+            nd = _net_decision(self.peer_name)
+            if nd is not None and nd.drop:
+                # A cut link refuses dials exactly like a dead listener:
+                # take the backoff path until the spec heals or the
+                # deadline expires.
+                delay = bo.next_delay()
+                if delay is None:
+                    raise ConnectionLost(f"cannot connect to {self.address}")
+                await asyncio.sleep(delay)
+                continue
             try:
                 if kind == "unix":
                     self._reader, self._writer = await asyncio.open_unix_connection(target)
@@ -263,6 +309,8 @@ class AsyncRpcClient:
                 await asyncio.sleep(delay)
         self._connected = True
         self._read_task = asyncio.ensure_future(self._read_loop())
+        data = pickle.dumps(("hello", {"net_name": _net_name()}), protocol=5)
+        self._writer.write(_LEN.pack(len(data)) + data)
         return self
 
     async def _read_loop(self):
@@ -311,9 +359,17 @@ class AsyncRpcClient:
         self._pending[req_id] = fut
         data = pickle.dumps(("req", req_id, method, payload), protocol=5)
         t0 = time.perf_counter()
-        async with self._wlock:
-            self._writer.write(_LEN.pack(len(data)) + data)
-            await self._writer.drain()
+        nd = _net_decision(self.peer_name)
+        if nd is not None and nd.delay_s > 0:
+            await asyncio.sleep(nd.delay_s)
+        if nd is not None and nd.drop:
+            # Blackholed on the wire: the caller waits out its timeout
+            # exactly as with a real partition.
+            pass
+        else:
+            async with self._wlock:
+                self._writer.write(_LEN.pack(len(data)) + data)
+                await self._writer.drain()
         if timeout is _UNSET_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
         try:
@@ -340,6 +396,12 @@ class AsyncRpcClient:
     async def push(self, method: str, payload: Any = None):
         if not self._connected:
             raise ConnectionLost(f"not connected to {self.address}")
+        nd = _net_decision(self.peer_name)
+        if nd is not None:
+            if nd.delay_s > 0:
+                await asyncio.sleep(nd.delay_s)
+            if nd.drop:
+                return  # a push into a cut link vanishes silently
         data = pickle.dumps(("push", method, payload), protocol=5)
         async with self._wlock:
             self._writer.write(_LEN.pack(len(data)) + data)
@@ -361,10 +423,11 @@ class AsyncRpcClient:
 # --------------------------------------------------------------------------
 class RpcClient:
     def __init__(self, address: str, on_push: Callable[[str, Any], None] = None,
-                 on_close: Callable[[], None] = None):
+                 on_close: Callable[[], None] = None, peer_name: str = ""):
         self.address = address
         self.on_push = on_push
         self.on_close = on_close
+        self.peer_name = peer_name
         self._sock = self._connect()
         self._req_id = 0
         self._lock = threading.Lock()
@@ -378,6 +441,14 @@ class RpcClient:
         kind, target = _parse_address(self.address)
         bo = retry.CONNECT.start(deadline_s=CONFIG.rpc_connect_timeout_s)
         while True:
+            nd = _net_decision(self.peer_name)
+            if nd is not None and nd.drop:
+                # A cut link refuses dials exactly like a dead listener.
+                delay = bo.next_delay()
+                if delay is None:
+                    raise ConnectionLost(f"cannot connect to {self.address}")
+                time.sleep(delay)
+                continue
             try:
                 if kind == "unix":
                     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -385,6 +456,9 @@ class RpcClient:
                 else:
                     s = socket.create_connection(target)
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                data = pickle.dumps(("hello", {"net_name": _net_name()}),
+                                    protocol=5)
+                s.sendall(_LEN.pack(len(data)) + data)
                 return s
             except (ConnectionRefusedError, FileNotFoundError):
                 delay = bo.next_delay()
@@ -449,14 +523,22 @@ class RpcClient:
             self._pending[req_id] = ev
         data = pickle.dumps(("req", req_id, method, payload), protocol=5)
         t0 = time.perf_counter()
-        try:
-            with self._lock:
-                self._sock.sendall(_LEN.pack(len(data)) + data)
-        except OSError as e:
-            with self._lock:
-                self._pending.pop(req_id, None)
-            telemetry.count_rpc_error(method, "connection_lost")
-            raise ConnectionLost(f"send to {self.address} failed: {e}") from e
+        nd = _net_decision(self.peer_name)
+        if nd is not None and nd.delay_s > 0:
+            time.sleep(nd.delay_s)
+        if nd is not None and nd.drop:
+            # Blackholed on the wire: skip the send and wait out the
+            # timeout below, exactly as with a real partition.
+            pass
+        else:
+            try:
+                with self._lock:
+                    self._sock.sendall(_LEN.pack(len(data)) + data)
+            except OSError as e:
+                with self._lock:
+                    self._pending.pop(req_id, None)
+                telemetry.count_rpc_error(method, "connection_lost")
+                raise ConnectionLost(f"send to {self.address} failed: {e}") from e
         if timeout is _UNSET_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
         if not ev.wait(timeout):
@@ -475,6 +557,12 @@ class RpcClient:
     def push(self, method: str, payload: Any = None):
         if self._closed:
             raise ConnectionLost(f"not connected to {self.address}")
+        nd = _net_decision(self.peer_name)
+        if nd is not None:
+            if nd.delay_s > 0:
+                time.sleep(nd.delay_s)
+            if nd.drop:
+                return  # a push into a cut link vanishes silently
         data = pickle.dumps(("push", method, payload), protocol=5)
         try:
             with self._lock:
@@ -513,15 +601,17 @@ class RpcClient:
 class ReconnectingRpcClient:
     def __init__(self, address: str, on_push: Callable[[str, Any], None] = None,
                  on_reconnect: Callable[[], None] = None,
-                 on_giveup: Callable[[], None] = None):
+                 on_giveup: Callable[[], None] = None, peer_name: str = ""):
         self.address = address
         self.on_push = on_push
         self.on_reconnect = on_reconnect
         self.on_giveup = on_giveup
+        self.peer_name = peer_name
         self._closed = False
         self._ready = threading.Event()
         self._lock = threading.Lock()
-        self._inner = RpcClient(address, on_push=on_push, on_close=self._on_inner_close)
+        self._inner = RpcClient(address, on_push=on_push, on_close=self._on_inner_close,
+                                peer_name=peer_name)
         self._ready.set()
 
     def _on_inner_close(self):
@@ -536,7 +626,8 @@ class ReconnectingRpcClient:
         while not self._closed:
             try:
                 inner = RpcClient(self.address, on_push=self.on_push,
-                                  on_close=self._on_inner_close)
+                                  on_close=self._on_inner_close,
+                                  peer_name=self.peer_name)
             except RpcError:
                 delay = bo.next_delay()
                 if delay is None:

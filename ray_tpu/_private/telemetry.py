@@ -78,6 +78,12 @@ class _Metrics:
             "fault injections fired by the chaos plane",
             tag_keys=("pattern", "action"),
         )
+        self.chaos_net = m.Counter(
+            "chaos_net_injections_total",
+            "link-level (net:<src>-><dst>) fault injections fired: frames "
+            "blackholed by cut/flaky or delayed by slow, per rule",
+            tag_keys=("pattern", "action"),
+        )
         self.task_phase = m.Histogram(
             "task_phase_seconds",
             "task lifecycle phases: submit (driver push), lease (worker grant), "
@@ -182,6 +188,35 @@ class _Metrics:
             "lost_capacity_records_total",
             "preempted/lost worker-node capacity records published to the "
             "autoscaler replacement feed, by reason",
+            tag_keys=("reason",),
+        )
+        self.node_suspicion = m.Gauge(
+            "node_suspicion_score",
+            "GCS suspicion score per node (0 = healthy .. 1 = presumed "
+            "dead), blended from heartbeat gap, RPC error/latency and "
+            "channel-health signals; crossing the suspect threshold "
+            "soft-cordons the node (SUSPECT), sustained suspicion "
+            "escalates to QUARANTINED or DEAD",
+            tag_keys=("node",),
+        )
+        self.node_fence_rejections = m.Counter(
+            "node_fence_rejections_total",
+            "raylet-originated RPCs rejected because they carried a stale "
+            "(node_id, incarnation) — writes from a fenced zombie can "
+            "never admit work or resurrect freed object copies",
+            tag_keys=("method",),
+        )
+        self.node_quarantine = m.Counter(
+            "node_quarantine_total",
+            "node quarantine transitions (direction = enter, exit); "
+            "reason = gray_failure on entry, recovered / flap_budget on "
+            "exit decisions",
+            tag_keys=("reason", "direction"),
+        )
+        self.telemetry_dropped = m.Counter(
+            "telemetry_dropped_total",
+            "client-side records dropped instead of delivered to the GCS "
+            "(bounded buffers tripping across an outage), by reason",
             tag_keys=("reason",),
         )
         # --- LLM serving plane (deployment label values are deployment
@@ -464,6 +499,7 @@ _rpc_bound: dict = {}
 _rpc_err_bound: dict = {}
 _retry_bound: dict = {}
 _chaos_bound: dict = {}
+_chaos_net_bound: dict = {}
 _phase_bound: dict = {}
 _store_bound: dict = {}
 _store_bytes_bound: dict = {}
@@ -510,6 +546,16 @@ def count_chaos(pattern: str, action: str) -> None:
         return
     b = _chaos_bound.get((pattern, action)) or _bind(
         _chaos_bound, (pattern, action), "chaos", {"pattern": pattern, "action": action}
+    )
+    b.inc(1.0)
+
+
+def count_chaos_net(pattern: str, action: str) -> None:
+    if not enabled():
+        return
+    b = _chaos_net_bound.get((pattern, action)) or _bind(
+        _chaos_net_bound, (pattern, action), "chaos_net",
+        {"pattern": pattern, "action": action},
     )
     b.inc(1.0)
 
@@ -663,6 +709,52 @@ def count_lost_capacity(reason: str) -> None:
         _lost_capacity_bound, reason, "lost_capacity_records", {"reason": reason}
     )
     b.inc(1.0)
+
+
+# ----------------------------------------------------------------------
+# Membership plane: suspicion scoring, incarnation fencing, quarantine.
+# Node labels are short (8-hex) node-id prefixes — bounded by cluster
+# size; method labels come from the fixed fenced-handler set.
+# ----------------------------------------------------------------------
+_fence_bound: dict = {}
+_quarantine_bound: dict = {}
+_tele_dropped_bound: dict = {}
+
+
+def set_node_suspicion(node: str, score: float) -> None:
+    if not enabled():
+        return
+    # Gauge: last-value-wins on the health-loop cadence — the unbound
+    # set() path is fine here (matches the tenant gauges).
+    _metrics().node_suspicion.set(float(score), tags={"node": node})
+
+
+def count_fence_rejection(method: str) -> None:
+    if not enabled():
+        return
+    b = _fence_bound.get(method) or _bind(
+        _fence_bound, method, "node_fence_rejections", {"method": method}
+    )
+    b.inc(1.0)
+
+
+def count_quarantine(reason: str, direction: str) -> None:
+    if not enabled():
+        return
+    b = _quarantine_bound.get((reason, direction)) or _bind(
+        _quarantine_bound, (reason, direction), "node_quarantine",
+        {"reason": reason, "direction": direction},
+    )
+    b.inc(1.0)
+
+
+def count_telemetry_dropped(reason: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    b = _tele_dropped_bound.get(reason) or _bind(
+        _tele_dropped_bound, reason, "telemetry_dropped", {"reason": reason}
+    )
+    b.inc(float(n))
 
 
 # ----------------------------------------------------------------------

@@ -197,8 +197,14 @@ class ReferenceCounter:
             # GCS unreachable (e.g. reconnecting): keep the batch for the
             # background flusher to retry — frees must not silently vanish
             # across a GCS restart.  Bounded so a permanently dead GCS
-            # can't grow this without limit.
-            self._to_free = (batch + self._to_free)[:100_000]
+            # can't grow this without limit; records the bound sheds are
+            # counted (telemetry_dropped_total) so an outage that trips
+            # it is visible instead of a silent free leak.
+            merged = batch + self._to_free
+            shed = len(merged) - 100_000
+            if shed > 0:
+                telemetry.count_telemetry_dropped("gcs_outage_bound", shed)
+            self._to_free = merged[:100_000]
             self._ensure_flusher_locked()
 
     def _ensure_flusher_locked(self):
@@ -440,9 +446,13 @@ class Worker:
         self.mode = "driver"
         import sys as _sys
 
+        from ray_tpu._private.chaos import set_net_role
+
+        set_net_role("driver")
         job_config = dict(job_config, driver_sys_path=[p for p in _sys.path if p])
         self.gcs_client = rpc.ReconnectingRpcClient(
-            gcs_address, on_push=self._on_gcs_push, on_reconnect=self._on_gcs_reconnected
+            gcs_address, on_push=self._on_gcs_push,
+            on_reconnect=self._on_gcs_reconnected, peer_name="gcs"
         )
         reply = self.gcs_client.call(
             "register_driver",
@@ -467,7 +477,8 @@ class Worker:
             # Worker stdout/stderr of this job streams here (reference:
             # log_monitor.py → driver printing with worker prefixes).
             self.gcs_client.call("subscribe", f"logs:{self.job_id.hex()}")
-        self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push)
+        self.raylet_client = rpc.RpcClient(raylet_address, on_push=self._on_raylet_push,
+                                           peer_name="raylet")
         # Workers mirror the driver's import paths (driver_sys_path, set
         # above) so functions pickled by reference resolve there too; the
         # same config is stored in the GCS job table for other raylets.
@@ -498,17 +509,22 @@ class Worker:
         self.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
         self.job_id = JobID.from_hex(os.environ["RAY_TPU_JOB_ID"])
         self.node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+        from ray_tpu._private.chaos import set_net_role
+
+        set_net_role(f"worker-{self.node_id.hex()[:8]}")
         self.gcs_client = rpc.ReconnectingRpcClient(
             os.environ["RAY_TPU_GCS_ADDRESS"],
             on_push=self._on_gcs_push,
             on_reconnect=self._on_gcs_reconnected,
+            peer_name="gcs",
         )
         self.gcs_client.call("subscribe", "actors")
         self.gcs_client.call("subscribe", "nodes")
         # The raylet owns this worker's lifetime: if it dies, exit
         # (reference: workers suicide when their raylet disappears).
         self.raylet_client = rpc.RpcClient(
-            raylet_address, on_push=self._on_raylet_push, on_close=self._on_raylet_lost
+            raylet_address, on_push=self._on_raylet_push, on_close=self._on_raylet_lost,
+            peer_name="raylet",
         )
         # Stage this worker's runtime env (set by the raylet at spawn)
         # BEFORE registering: a staging failure is reported in the

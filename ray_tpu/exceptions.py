@@ -102,6 +102,30 @@ class NodeDiedError(RayError):
     pass
 
 
+class NodeFencedError(RayError):
+    """A raylet-originated write carried a stale (node_id, incarnation).
+
+    The GCS stamps an incarnation at every node registration; after it
+    declares an incarnation dead, writes still carrying it (a zombie
+    raylet on the far side of a healed partition) are rejected with this
+    error and counted (``node_fence_rejections_total``) — a fenced
+    lease confirmation can never admit work, and a fenced object
+    location report can never resurrect a freed copy.  The raylet reacts
+    by tearing down its workers, reaping its channel shm, and
+    re-registering as a fresh incarnation."""
+
+    def __init__(self, message: str = "node incarnation fenced",
+                 node_id=None, incarnation: int = -1):
+        self.node_id = node_id
+        self.incarnation = incarnation
+        super().__init__(message)
+
+    def __reduce__(self):
+        # Default exception pickling only replays args[0]; the fenced
+        # raylet needs node_id/incarnation intact across the RPC wire.
+        return (type(self), (str(self), self.node_id, self.incarnation))
+
+
 class RaySystemError(RayError):
     pass
 

@@ -313,6 +313,23 @@ def _chaos_decide(path: str):
     return None if d.clean else d
 
 
+def _chaos_net_decide(peer_addr):
+    """Directional link verdict for one socket-channel dial or frame
+    toward ``peer_addr`` (None on the no-net-chaos fast path).  The dst
+    identity is ``addr:<host>:<port>`` — an RPC-plane partition
+    (``net:raylet*->gcs:cut``) leaves the compiled dataplane connected
+    unless a rule targets the channel address explicitly
+    (``net:node1->addr:*:cut``)."""
+    if peer_addr is None:
+        return None
+    from ray_tpu._private.chaos import CHAOS, net_name
+
+    if not (CHAOS.active and CHAOS.has_net_rules):
+        return None
+    d = CHAOS.decide_net(net_name(), f"addr:{peer_addr[0]}:{peer_addr[1]}")
+    return None if d.clean else d
+
+
 def _count_corruption() -> None:
     try:
         from ray_tpu._private import telemetry
@@ -1016,6 +1033,24 @@ def dial(addr: Tuple[str, int], role: str, timeout: float = 15.0) -> "SocketChan
     bo = retry.CONNECT.start(deadline_s=timeout)
     last: Optional[Exception] = None
     while True:
+        nd = _chaos_net_decide(tuple(addr))
+        if nd is not None:
+            if nd.delay_s > 0:
+                time.sleep(nd.delay_s)
+            if nd.drop:
+                # A cut link refuses dials exactly like a dead listener:
+                # retry on the CONNECT policy until heal or deadline.
+                last = OSError("chaos net cut")
+                delay = bo.next_delay()
+                if delay is None:
+                    telemetry.count_socket_connect("refused")
+                    raise ChannelConnectionError(
+                        f"socket channel endpoint {addr} refused ({last}); "
+                        "the reader endpoint is gone — the edge must be "
+                        "reattached from a live listener or rebuilt"
+                    ) from last
+                time.sleep(delay)
+                continue
         try:
             sock = _socket.create_connection(tuple(addr), timeout=min(timeout, 5.0))
             try:
@@ -1312,6 +1347,9 @@ class SocketChannel:
                 self._sock.close()
             except OSError:
                 pass
+            nd = _chaos_net_decide(self._peer_addr)
+            if nd is not None and nd.drop:
+                raise OSError("chaos net cut")  # re-dial blocked by the partition
             sock = _socket.create_connection(self._peer_addr, timeout=min(timeout, 5.0))
             try:
                 sock.settimeout(timeout)
@@ -1472,6 +1510,19 @@ class SocketChannel:
                 # Abrupt connection loss (no poison): the send below
                 # fails and takes the real reattach path — the drill
                 # exercises exactly what a transient TCP drop does.
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+        nd = _chaos_net_decide(self._peer_addr)
+        if nd is not None:
+            if nd.delay_s > 0:
+                time.sleep(nd.delay_s)
+            if nd.drop:
+                # A cut link looks like a dead connection to TCP: close
+                # the socket so the send below takes the reattach path,
+                # whose re-dial keeps failing through the same cut until
+                # the link heals.
                 try:
                     self._sock.close()
                 except OSError:

@@ -47,9 +47,43 @@ class ServeController(LongPollHost):
         self._last_pushed: Dict[str, Any] = {}
         # replicas draining toward kill: [{replica, stop_ref, deadline}]
         self._stopping: List[dict] = []
+        # node_id hex -> state, fed by the "nodes" pubsub (gray-failure
+        # ladder): replica snapshots carry the host node's state so
+        # routers demote replicas on SUSPECT/QUARANTINED nodes and
+        # re-promote them when the node returns ALIVE.  Unknown nodes
+        # default to ALIVE — demotion is advisory, never a liveness call.
+        self._node_states: Dict[str, str] = {}
+        from ray_tpu._private.worker import global_worker_maybe
+
+        w = global_worker_maybe()
+        if w is not None and getattr(w, "connected", False):
+            w.add_node_listener(self._on_node_event)
+
+    def _on_node_event(self, state: str, node: dict):
+        # Runs on the worker's node-event thread; plain dict writes are
+        # atomic, the reconcile tick reads the latest view.
+        nid = node.get("node_id")
+        nid_hex = nid.hex() if isinstance(nid, (bytes, bytearray)) else str(nid or "")
+        if not nid_hex:
+            return
+        if state == "DEAD":
+            # Dead nodes leave the map: their replicas fail stats probes
+            # and are replaced; a reused node_id starts ALIVE again.
+            self._node_states.pop(nid_hex, None)
+        else:
+            self._node_states[nid_hex] = state
 
     async def _ensure_loop(self):
         if self._loop_task is None:
+            # Seed node states before the first reconcile: a node already
+            # SUSPECT/QUARANTINED at controller start must demote from
+            # the first snapshot, not from its next state transition.
+            try:
+                for n in self._ray.nodes():
+                    if n.get("State") not in (None, "DEAD"):
+                        self._node_states[n["NodeID"]] = n["State"]
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
             self._loop_task = asyncio.get_event_loop().create_task(self._reconcile_loop())
 
     # -- API (called by serve.run / handles) ----------------------------
@@ -85,8 +119,20 @@ class ServeController(LongPollHost):
         dep = self.deployments.get(name)
         if not dep:
             return []
+        return self._replica_snapshot(dep)
+
+    def _replica_snapshot(self, dep: dict) -> List[dict]:
+        """Routable replicas with their host node's membership state.
+        node_state changes alter the snapshot, so a node going SUSPECT/
+        QUARANTINED (or recovering) long-polls to routers like any
+        membership change."""
         return [
-            {"replica_id": r["replica_id"], "actor_name": r["actor_name"]}
+            {
+                "replica_id": r["replica_id"],
+                "actor_name": r["actor_name"],
+                "node_id": r.get("node_id", ""),
+                "node_state": self._node_states.get(r.get("node_id", ""), "ALIVE"),
+            }
             for r in dep["replicas"]
             if r["state"] == "RUNNING" and not r.get("stale")
         ]
@@ -176,7 +222,9 @@ class ServeController(LongPollHost):
                 ready, _ = self._ray.wait([r["ping_ref"]], num_returns=1, timeout=0)
                 if ready:
                     try:
-                        self._ray.get(r.pop("ping_ref"))
+                        pong = self._ray.get(r.pop("ping_ref"))
+                        if isinstance(pong, dict):
+                            r["node_id"] = pong.get("node_id") or ""
                         r["state"] = "RUNNING"
                     except Exception:
                         r["state"] = "DEAD"
@@ -188,13 +236,11 @@ class ServeController(LongPollHost):
             # routers
             self._poll_replica_stats(name, dep)
         self._reap_stopping()
-        # push replica-set changes to long-poll listeners (routers)
+        # push replica-set changes to long-poll listeners (routers);
+        # the snapshot embeds node_state, so gray-failure transitions
+        # push too (demotion reaches routers within one reconcile tick)
         for name, dep in self.deployments.items():
-            snapshot = [
-                {"replica_id": r["replica_id"], "actor_name": r["actor_name"]}
-                for r in dep["replicas"]
-                if r["state"] == "RUNNING" and not r.get("stale")
-            ]
+            snapshot = self._replica_snapshot(dep)
             if self._last_pushed.get(name) != snapshot:
                 self._last_pushed[name] = snapshot
                 self.notify_changed(lp_replicas_key(name), snapshot)

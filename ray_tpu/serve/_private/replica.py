@@ -139,8 +139,16 @@ class Replica:
                 pass
         return out
 
-    def ping(self) -> str:
-        return "pong"
+    def ping(self) -> Dict[str, Any]:
+        """Liveness probe.  Returns placement identity so the controller
+        can map this replica to its host node — the gray-failure ladder
+        demotes replicas on SUSPECT/QUARANTINED nodes at the router."""
+        try:
+            from ray_tpu.runtime_context import get_runtime_context
+
+            return {"node_id": get_runtime_context().get_node_id()}
+        except Exception:  # noqa: BLE001 — a probe must never fail on identity
+            return {"node_id": ""}
 
     async def prepare_shutdown(self):
         """Graceful teardown: cancel @serve.batch worker tasks (they are

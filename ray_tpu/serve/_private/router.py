@@ -14,6 +14,11 @@ from typing import Any, Dict, List, Optional
 _DP_FAILED = object()
 _DP_ATTACHING = object()
 
+# Node states that demote a replica: still alive and kept in the set
+# (its node may recover), but only routed to when no replica on a
+# healthy node remains.
+_DEMOTED_NODE_STATES = ("SUSPECT", "QUARANTINED")
+
 
 class Router:
     """Caches the replica set from the controller; picks replicas by
@@ -54,23 +59,9 @@ class Router:
 
     def _on_replicas_pushed(self, snapshot: List[dict]):
         """Apply a pushed replica-set snapshot."""
-        with self._lock:
-            by_id = {r["replica_id"]: r for r in self._replicas}
-        new = []
-        for rinfo in snapshot:
-            cur = by_id.get(rinfo["replica_id"])
-            if cur is not None:
-                new.append(cur)
-            else:
-                try:
-                    actor = self._ray.get_actor(rinfo["actor_name"], "serve")
-                    new.append({"replica_id": rinfo["replica_id"], "actor": actor})
-                except Exception:
-                    pass
+        new = self._apply_snapshot(snapshot)
         live = {r["replica_id"] for r in new}
         with self._lock:
-            self._replicas = new
-            self._last_refresh = time.monotonic()
             for mid, rids in list(self._model_locations.items()):
                 rids &= live
                 if not rids:
@@ -79,6 +70,33 @@ class Router:
             gone = [rid for rid in self._dataplanes if rid not in live]
         for rid in gone:
             self._drop_dataplane(rid)
+
+    def _apply_snapshot(self, snapshot: List[dict]) -> List[dict]:
+        """Merge a controller snapshot into the cached replica set,
+        keeping existing records (their actor handles) and refreshing
+        each replica's host-node state — the demotion signal."""
+        with self._lock:
+            by_id = {r["replica_id"]: r for r in self._replicas}
+        new = []
+        for rinfo in snapshot:
+            cur = by_id.get(rinfo["replica_id"])
+            if cur is not None:
+                cur["node_state"] = rinfo.get("node_state", "ALIVE")
+                new.append(cur)
+            else:
+                try:
+                    actor = self._ray.get_actor(rinfo["actor_name"], "serve")
+                    new.append({
+                        "replica_id": rinfo["replica_id"],
+                        "actor": actor,
+                        "node_state": rinfo.get("node_state", "ALIVE"),
+                    })
+                except Exception:
+                    pass
+        with self._lock:
+            self._replicas = new
+            self._last_refresh = time.monotonic()
+        return new
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -89,22 +107,7 @@ class Router:
         replicas = self._ray.get(
             self.controller.get_replicas.remote(self.deployment_name)
         )
-        with self._lock:
-            by_id = {r["replica_id"]: r for r in self._replicas}
-        new = []
-        for rinfo in replicas:
-            cur = by_id.get(rinfo["replica_id"])
-            if cur is not None:
-                new.append(cur)
-            else:
-                try:
-                    actor = self._ray.get_actor(rinfo["actor_name"], "serve")
-                    new.append({"replica_id": rinfo["replica_id"], "actor": actor})
-                except Exception:
-                    pass
-        with self._lock:
-            self._replicas = new
-            self._last_refresh = now
+        self._apply_snapshot(replicas)
         # report average load for autoscaling
         if self._replicas:
             avg = sum(self._queue_estimate.get(r["replica_id"], 0) for r in self._replicas) / len(self._replicas)
@@ -125,22 +128,30 @@ class Router:
                 raise RuntimeError(f"no running replicas for deployment {self.deployment_name}")
             time.sleep(delay)
             self._refresh(force=True)
+        # Gray-failure demotion: replicas on SUSPECT/QUARANTINED nodes
+        # stay in the set (the node is alive and may recover) but only
+        # take traffic when no replica on a healthy node remains — a
+        # re-promotion is just the next snapshot marking the node ALIVE.
+        with self._lock:
+            replicas = list(self._replicas)
+        healthy = [
+            r for r in replicas
+            if r.get("node_state", "ALIVE") not in _DEMOTED_NODE_STATES
+        ]
+        pool = healthy or replicas
         if multiplexed_model_id:
             # soft affinity: among replicas that already hold the model,
             # pick the shortest queue; fall through when none do
             with self._lock:
-                holders = [
-                    r
-                    for r in self._replicas
-                    if r["replica_id"] in self._model_locations.get(multiplexed_model_id, ())
-                ]
+                rids = set(self._model_locations.get(multiplexed_model_id, ()))
+            holders = [r for r in pool if r["replica_id"] in rids]
             if holders:
                 return min(
                     holders, key=lambda r: self._queue_estimate.get(r["replica_id"], 0)
                 )
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = self._rng.sample(self._replicas, 2)
+        if len(pool) == 1:
+            return pool[0]
+        a, b = self._rng.sample(pool, 2)
         qa = self._queue_estimate.get(a["replica_id"], 0)
         qb = self._queue_estimate.get(b["replica_id"], 0)
         return a if qa <= qb else b

@@ -54,6 +54,11 @@ def report(method: str, payload: dict) -> bool:
     if w is None or not w.connected or w.gcs_client is None:
         return False
     try:
+        # Node attribution (no incarnation: workers are not fenced — the
+        # GCS uses this to fold channel blocked/reattach counters into
+        # the host node's gray-failure suspicion score).
+        if getattr(w, "node_id", None) is not None:
+            payload.setdefault("node_id", w.node_id.binary())
         # Bounded: this runs on flusher threads and at interpreter exit —
         # it must never park a dying worker on the full rpc call timeout.
         w.gcs_client.call(method, payload, timeout=10)

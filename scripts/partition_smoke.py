@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Partition smoke: the two end-to-end membership drills from the
+partition-tolerance plane, against real spawned clusters with link-level
+chaos (``net:<src>-><dst>`` rules).
+
+Drill A — asymmetric partition + incarnation fencing:
+  node2's frames TO the GCS are blackholed (``net:node2->gcs:cut``)
+  while every other direction keeps flowing.  Asserts:
+    * the driver->node2 data path still answers while the control link
+      is down (an RPC-plane partition is not a dataplane partition),
+    * the GCS declares the silent node DEAD despite the still-open TCP
+      conn (dead_conn_open_factor),
+    * when the link heals, the zombie raylet's stale write is rejected
+      with a typed, counted NodeFencedError and the raylet re-registers
+      as a NEW incarnation of the SAME node id.
+
+Drill B — gray failure (slow, never dead):
+  node2's frames to the GCS are delayed 2.5 s one-way
+  (``net:node2->gcs:slow``).  Asserts the suspicion ladder reads
+  sustained slowness as SUSPECT -> QUARANTINED — never as a false DEAD —
+  and readmits the node (ALIVE, one flap spent) after the link heals and
+  health holds through the hysteresis window.
+
+Run by scripts/verify.sh after tier-1; standalone:
+    JAX_PLATFORMS=cpu python scripts/partition_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# sys.path[0] is scripts/; the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait_for(pred, timeout: float, what: str, poll: float = 0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _set_env(env: dict):
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    return saved
+
+
+def _restore_env(saved: dict):
+    for k, old in saved.items():
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+
+
+def _spawn(env: dict):
+    """Head + one worker node whose processes carry net identity
+    'node2' (chaos_net_name is frozen into children at spawn)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    saved = _set_env(env)
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    tag = _set_env({"RAY_TPU_chaos_net_name": "node2"})
+    try:
+        cluster.add_node(num_cpus=1, resources={"side": 1})
+    finally:
+        _restore_env(tag)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    return cluster, saved
+
+
+def _side_node(info: dict) -> dict:
+    return next(n for n in info["nodes"].values() if not n.get("is_head"))
+
+
+def drill_a_asymmetric_partition() -> None:
+    import ray_tpu
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.util import state
+
+    cluster, saved = _spawn(
+        {
+            # Fast death detection: 2 s heartbeat threshold; with the
+            # conn held open by the asymmetric cut, death needs
+            # dead_conn_open_factor (2x) => ~4 s of silence.
+            "RAY_TPU_health_check_timeout_ms": "2000",
+            "RAY_TPU_health_check_period_ms": "300",
+            # Cut arms 8 s after node2's raylet starts (registration and
+            # the probe actor must land first) and heals 18 s later.
+            "RAY_TPU_testing_chaos_spec": "net:node2->gcs:cut:start=8:for=18",
+            "RAY_TPU_testing_chaos_seed": "7",
+        }
+    )
+    try:
+        w = get_global_worker()
+
+        @ray_tpu.remote(resources={"side": 0.5})
+        class Probe:
+            def ping(self):
+                return "pong"
+
+        probe = Probe.remote()
+        assert ray_tpu.get(probe.ping.remote(), timeout=30) == "pong"
+
+        info = w.gcs_client.call("get_cluster_info")
+        side = _side_node(info)
+        side_hex = bytes(side["node_id"]).hex()
+        inc0 = side["incarnation"]
+        assert inc0 > 0, side
+
+        def side_view():
+            return _side_node(w.gcs_client.call("get_cluster_info"))
+
+        # The control link goes dark: suspicion climbs from the
+        # heartbeat gap while the node is still listed alive.
+        _wait_for(
+            lambda: side_view()["suspicion"] >= 0.5
+            and side_view()["state"] != "DEAD",
+            40,
+            "suspicion to climb under the cut",
+        )
+        # ... and the DATA path still answers: the partition is an
+        # RPC-plane (node2->gcs) cut, not a dataplane cut.
+        assert ray_tpu.get(probe.ping.remote(), timeout=10) == "pong"
+        print("drill A: dataplane answered while the control link was cut")
+
+        # Sustained silence past dead_conn_open_factor x timeout kills
+        # the node even though its TCP conn never closed.
+        _wait_for(lambda: side_view()["state"] == "DEAD", 40, "DEAD under cut")
+        print("drill A: asymmetric silence declared DEAD (conn still open)")
+
+        # Heal: the zombie's next report is fenced (typed + counted) and
+        # the raylet re-registers the SAME node id as a NEW incarnation.
+        def rejoined():
+            n = side_view()
+            return (
+                bytes(n["node_id"]).hex() == side_hex
+                and n["state"] == "ALIVE"
+                and n["incarnation"] > inc0
+            )
+
+        _wait_for(rejoined, 60, "fenced raylet to rejoin as a new incarnation")
+        inc1 = side_view()["incarnation"]
+        print(
+            f"drill A: node {side_hex[:8]} rejoined, incarnation "
+            f"{inc0} -> {inc1}"
+        )
+
+        def fence_counted():
+            return any(
+                r["name"] == "node_fence_rejections_total"
+                and r.get("value", 0) >= 1
+                for r in state.metrics()
+            )
+
+        _wait_for(fence_counted, 30, "node_fence_rejections_total >= 1")
+        print("drill A: stale write rejection visible in metrics")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        _restore_env(saved)
+
+
+def drill_b_gray_failure() -> None:
+    import ray_tpu
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.util import state
+
+    cluster, saved = _spawn(
+        {
+            # Default 10 s death threshold: the delayed heartbeats keep
+            # arriving well inside it — DEAD would be a ladder bug.
+            "RAY_TPU_health_check_timeout_ms": "10000",
+            "RAY_TPU_health_check_period_ms": "300",
+            "RAY_TPU_quarantine_after_s": "3",
+            "RAY_TPU_quarantine_drain_deadline_s": "5",
+            "RAY_TPU_unquarantine_hysteresis_s": "4",
+            # 2.5 s one-way delay on node2->gcs: above suspect_rtt_ms
+            # (2 s), far below the death threshold.
+            "RAY_TPU_testing_chaos_spec": (
+                "net:node2->gcs:slow:ms=2500:start=6:for=18"
+            ),
+            "RAY_TPU_testing_chaos_seed": "7",
+        }
+    )
+    try:
+        w = get_global_worker()
+
+        def side_view():
+            return _side_node(w.gcs_client.call("get_cluster_info"))
+
+        seen = set()
+
+        def watch(target_states):
+            def pred():
+                n = side_view()
+                seen.add(n["state"])
+                assert n["state"] != "DEAD", (
+                    f"gray failure escalated to false DEAD (seen {seen})"
+                )
+                return n["state"] in target_states
+
+            return pred
+
+        _wait_for(watch({"SUSPECT"}), 45, "sustained slowness -> SUSPECT")
+        print("drill B: slow link read as SUSPECT (soft cordon)")
+        _wait_for(
+            watch({"QUARANTINED"}), 45, "sustained suspicion -> QUARANTINED"
+        )
+        print("drill B: sustained gray failure parked in QUARANTINED")
+
+        # Heal: health holds through the hysteresis window, the node is
+        # readmitted with exactly one flap spent.
+        _wait_for(watch({"ALIVE"}), 60, "readmission after the link heals")
+        n = side_view()
+        assert n["flap_count"] == 1, n
+        assert "DEAD" not in seen, seen
+        print(
+            f"drill B: readmitted ALIVE (flap {n['flap_count']}, "
+            f"states seen: {sorted(seen)})"
+        )
+
+        def suspicion_exported():
+            return any(
+                r["name"] == "node_suspicion_score" for r in state.metrics()
+            )
+
+        _wait_for(suspicion_exported, 30, "node_suspicion_score gauge")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        _restore_env(saved)
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    drill_a_asymmetric_partition()
+    drill_b_gray_failure()
+    print(
+        f"partition smoke: OK (asymmetric-partition fencing + gray-failure "
+        f"quarantine, {time.monotonic() - t0:.1f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

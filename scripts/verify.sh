@@ -185,6 +185,21 @@ if [ "${RAY_TPU_SKIP_SHARDED_SMOKE:-0}" != "1" ]; then
   fi
 fi
 
+# Partition smoke (membership plane end-to-end): asymmetric-partition
+# drill (net:node2->gcs:cut — dataplane stays up, silent node declared
+# DEAD past dead_conn_open_factor, zombie write fenced typed+counted,
+# raylet rejoins as a new incarnation) and gray-failure drill
+# (net:...:slow — SUSPECT -> QUARANTINED, never false DEAD, readmitted
+# after heal within the flap budget).  Skippable via
+# RAY_TPU_SKIP_PARTITION_SMOKE=1.
+if [ "${RAY_TPU_SKIP_PARTITION_SMOKE:-0}" != "1" ]; then
+  if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+      python scripts/partition_smoke.py; then
+    echo "partition smoke step failed"
+    [ "$rc" -eq 0 ] && rc=1
+  fi
+fi
+
 # Elastic smoke (resize-on-preemption end-to-end): 2-node local cluster,
 # elastic JaxTrainer (min_workers=1), preempt one rank's node mid-run,
 # assert shrink -> resume -> completion with zero failure charges and
