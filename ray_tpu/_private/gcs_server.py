@@ -536,6 +536,7 @@ class GcsServer:
                 node_id=node_id.binary(),
                 incarnation=incarnation,
             )
+    # graftlint: disable=rpc-contract -- registration MINTS the incarnation the fence checks against; there is no prior incarnation to validate, and fencing here would deadlock every (re)join
     async def rpc_register_node(self, payload, conn):
         info = NodeInfo(
             node_id=NodeID(payload["node_id"]),
@@ -824,6 +825,7 @@ class GcsServer:
     # and preemption notices turn planned node loss into a cheap,
     # proactive path instead of a heartbeat-timeout + lineage repair)
     # ------------------------------------------------------------------
+    # graftlint: disable=rpc-contract -- drain originates from the driver/autoscaler, not the node: payload node_id names the TARGET, so a sender-incarnation fence does not apply; stale drains are bounded by the state check below
     async def rpc_drain_node(self, payload, conn):
         """Start draining a node: ALIVE -> DRAINING.  The node stops
         receiving new work (its raylet rejects leases and bundle
@@ -1568,6 +1570,7 @@ class GcsServer:
         return True
 
     async def rpc_kv_get(self, payload, conn):
+        """rpc-contract: read-only — pure KV lookup, safe to retry."""
         ns, key = payload
         return self.kv.get(ns, {}).get(key)
 
@@ -1631,6 +1634,7 @@ class GcsServer:
         return True
 
     async def rpc_object_locations_get(self, payload, conn):
+        """rpc-contract: read-only — location lookup, safe to retry."""
         oid = payload
         locs = self.object_locations.get(oid, set())
         out = []
@@ -2051,9 +2055,12 @@ class GcsServer:
         return {"actor_id": actor_id.binary(), "spec": info.creation_spec, "info": self._actor_dict(info)}
 
     async def rpc_list_named_actors(self, payload, conn):
-        all_namespaces = payload
+        """rpc-contract: read-only — registry scan, safe to retry."""
+        all_namespaces, ns_filter = payload
         out = []
         for (ns, name), aid in self.named_actors.items():
+            if not all_namespaces and ns != ns_filter:
+                continue
             info = self.actors.get(aid)
             if info and info.state != "DEAD":
                 out.append({"namespace": ns, "name": name})

@@ -226,7 +226,8 @@ class SamplingProfiler:
             with self._lock:
                 self._errors.append(f"sampler died: {type(e).__name__}: {e}")
         finally:
-            self.ended_at = time.time()
+            with self._lock:
+                self.ended_at = time.time()
             if self._on_finish is not None:
                 try:
                     self._on_finish(self)
@@ -285,6 +286,7 @@ class SamplingProfiler:
             errors = list(self._errors)
             ticks, count = self._ticks, self._sample_count
             idle, nthreads = self._idle_dropped, len(self._threads_seen)
+            ended_at = self.ended_at
         return {
             "session_id": self.session_id,
             "label": self.label,
@@ -293,7 +295,7 @@ class SamplingProfiler:
             "mode": self.mode,
             "duration_s": self.duration_s,
             "started_at": self.started_at,
-            "ended_at": self.ended_at,
+            "ended_at": ended_at,
             "running": self.running if partial is None else partial,
             "ticks": ticks,
             "sample_count": count,

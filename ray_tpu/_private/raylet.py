@@ -2506,16 +2506,6 @@ class Raylet:
         (reference: plasma create-request queue + eviction policy)."""
         return self.store.reserve(int(payload))
 
-    async def rpc_store_pin(self, payload, conn):
-        for oid in payload:
-            self.store.pin(ObjectID(oid))
-        return True
-
-    async def rpc_store_unpin(self, payload, conn):
-        for oid in payload:
-            self.store.unpin(ObjectID(oid))
-        return True
-
     async def rpc_store_stats(self, payload, conn):
         return self.store.stats()
 
@@ -2627,10 +2617,6 @@ class Raylet:
         (total_size, bytes) so the first chunk also conveys the size."""
         oid_bytes, offset, length = payload
         return self.store.read_chunk(ObjectID(oid_bytes), offset, length)
-
-    async def rpc_om_fetch(self, payload, conn):
-        """Whole-object fetch (kept for small objects / compat)."""
-        return self.store.read_bytes(ObjectID(payload))
 
     # ------------------------------------------------------------------
     # introspection

@@ -546,7 +546,8 @@ class RpcClient:
                 self._pending.pop(req_id, None)
             telemetry.count_rpc_error(method, "timeout")
             raise CallTimeout(f"{method} on {self.address} timed out after {timeout}s")
-        ok, result = self._results.pop(req_id)
+        with self._lock:
+            ok, result = self._results.pop(req_id)
         telemetry.observe_rpc(method, "client", time.perf_counter() - t0)
         if not ok:
             if isinstance(result, ConnectionLost):
@@ -690,8 +691,10 @@ class ReconnectingRpcClient:
     def close(self):
         self._closed = True
         self._ready.set()
+        with self._lock:
+            inner = self._inner
         try:
-            self._inner.close()
+            inner.close()
         except Exception:
             pass
 

@@ -429,6 +429,9 @@ class Worker:
         # backend executor uses this to checkpoint-and-shrink instead of
         # waiting for the escalation (graceful actor restart).
         self._job_preempt_listeners: list = []
+        # Guards both listener lists: registration happens on user
+        # threads while dispatch runs on the pubsub reader thread.
+        self._listener_lock = threading.Lock()
         self.job_preempt_notice: Optional[dict] = None
         # Executor side: cancel requests for tasks queued/running here,
         # plus live execution registries so a cancel targets exactly the
@@ -604,16 +607,18 @@ class Worker:
 
         sock_dir = os.path.dirname(raylet_address.split("unix:", 1)[-1])
         path = os.path.join(sock_dir, f"w_{self.worker_id.hex()[:16]}.sock")
-        self.direct_address = f"unix:{path}"
-        self._direct_loop = asyncio.new_event_loop()
+        address = f"unix:{path}"
+        loop = asyncio.new_event_loop()
+        self.direct_address = address
+        self._direct_loop = loop
         started = threading.Event()
 
         def run():
-            asyncio.set_event_loop(self._direct_loop)
-            self._direct_server = rpc.RpcServer(self, self.direct_address, self._direct_loop)
-            self._direct_loop.run_until_complete(self._direct_server.start())
+            asyncio.set_event_loop(loop)
+            self._direct_server = rpc.RpcServer(self, address, loop)
+            loop.run_until_complete(self._direct_server.start())
             started.set()
-            self._direct_loop.run_forever()
+            loop.run_forever()
 
         threading.Thread(target=run, daemon=True, name="direct-server").start()
         if not started.wait(10):
@@ -689,6 +694,15 @@ class Worker:
             return
         self.reference_counter.flush()
         self.connected = False
+        # Drop pubsub registrations explicitly: a clean shutdown should
+        # not leave the GCS fanning events at a half-closed connection
+        # until its next push notices the dead socket.
+        if self.gcs_client is not None:
+            for channel in ("actors", "nodes", f"logs:{self.job_id.hex()}"):
+                try:
+                    self.gcs_client.call("unsubscribe", channel, timeout=2)
+                except Exception:
+                    break
         if self._direct_submitter is not None:
             try:
                 self._direct_submitter.shutdown()
@@ -734,8 +748,9 @@ class Worker:
         self._oom_worker_kills.clear()
         self._cancelled_tasks.clear()
         self._cancel_requested.clear()
-        self._node_listeners.clear()
-        self._job_preempt_listeners.clear()
+        with self._listener_lock:
+            self._node_listeners.clear()
+            self._job_preempt_listeners.clear()
         self.job_preempt_notice = None
         self.job_runtime_env = None
         self.memory_store = MemoryStore()
@@ -793,7 +808,9 @@ class Worker:
                 self._direct_submitter.on_node_draining(node.get("raylet_address"))
             except Exception:
                 logger.exception("drain handoff to direct submitter failed")
-        for cb in list(self._node_listeners):
+        with self._listener_lock:
+            listeners = list(self._node_listeners)
+        for cb in listeners:
             try:
                 cb(state, node)
             except Exception:
@@ -802,13 +819,15 @@ class Worker:
     def add_node_listener(self, cb) -> None:
         """Register cb(state, node_dict) for cluster node lifecycle
         events (every connected process subscribes to "nodes")."""
-        self._node_listeners.append(cb)
+        with self._listener_lock:
+            self._node_listeners.append(cb)
 
     def remove_node_listener(self, cb) -> None:
-        try:
-            self._node_listeners.remove(cb)
-        except ValueError:
-            pass
+        with self._listener_lock:
+            try:
+                self._node_listeners.remove(cb)
+            except ValueError:
+                pass
 
     def _on_job_preempt(self, payload: dict):
         logger.warning(
@@ -816,7 +835,9 @@ class Worker:
             payload.get("reason"), float(payload.get("deadline_s") or 0),
             payload.get("release_workers"),
         )
-        for cb in list(self._job_preempt_listeners):
+        with self._listener_lock:
+            listeners = list(self._job_preempt_listeners)
+        for cb in listeners:
             try:
                 cb(payload)
             except Exception:
@@ -825,13 +846,15 @@ class Worker:
     def add_job_preempt_listener(self, cb) -> None:
         """Register cb(notice_dict) for GCS priority-preemption notices
         targeting this driver's job."""
-        self._job_preempt_listeners.append(cb)
+        with self._listener_lock:
+            self._job_preempt_listeners.append(cb)
 
     def remove_job_preempt_listener(self, cb) -> None:
-        try:
-            self._job_preempt_listeners.remove(cb)
-        except ValueError:
-            pass
+        with self._listener_lock:
+            try:
+                self._job_preempt_listeners.remove(cb)
+            except ValueError:
+                pass
 
     def _on_gcs_reconnected(self):
         """The GCS restarted: re-subscribe and re-bind this driver's job so
@@ -1831,10 +1854,12 @@ class Worker:
     def _task_event_flush_loop(self):
         while not self._shutdown_event.is_set():
             time.sleep(1.0)
-            if not self._task_events or self.gcs_client is None:
+            if self.gcs_client is None:
                 continue
             with self._task_event_lock:
                 events, self._task_events = self._task_events, []
+            if not events:
+                continue
             try:
                 self.gcs_client.call("task_event_report", {"events": events})
             except Exception:
@@ -2019,14 +2044,15 @@ class Worker:
             if has_async:
                 import asyncio
 
-                self._async_loop = asyncio.new_event_loop()
+                loop = asyncio.new_event_loop()
+                self._async_loop = loop
                 self._async_sem = None
                 mc = spec.max_concurrency if spec.max_concurrency > 1 else 1000
                 self._async_concurrency = mc
 
                 def run_loop():
-                    asyncio.set_event_loop(self._async_loop)
-                    self._async_loop.run_forever()
+                    asyncio.set_event_loop(loop)
+                    loop.run_forever()
 
                 self._async_loop_thread = threading.Thread(target=run_loop, daemon=True, name="actor-async-loop")
                 self._async_loop_thread.start()

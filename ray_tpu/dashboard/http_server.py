@@ -572,7 +572,24 @@ def start_dashboard(
     handler = type("BoundHandler", (_Handler,), {})
     handler.state = _DashboardState(gcs_client)
     handler.jobs = JobManager(jobs_gcs_client, gcs_address, session_dir)
+    # The launcher only hands us session_dir; the GCS session record
+    # (ray version, node ip, etc.) fills in the rest for usage reports.
+    # Fetched off-thread: start_dashboard runs ON the head process's
+    # event loop, so a synchronous self-call to the GCS here would block
+    # the loop (and the raylet's heartbeats) for the full timeout.
     handler.session_info = {"session_dir": session_dir}
+
+    def _enrich_session_info():
+        try:
+            extra = dict(gcs_client.call("get_session_info", None, timeout=5) or {})
+        except rpc.RpcError:
+            return
+        extra["session_dir"] = session_dir
+        handler.session_info = extra  # atomic class-attr rebind
+
+    threading.Thread(
+        target=_enrich_session_info, daemon=True, name="dashboard-session-info"
+    ).start()
     handler.start_time = time.time()
     try:
         server = ThreadingHTTPServer((host, port), handler)

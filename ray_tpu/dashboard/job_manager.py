@@ -46,11 +46,12 @@ class JobManager:
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         keys = self._gcs.call("kv_keys", (JOB_KV_NS, b"")) or []
-        out = []
-        for k in keys:
-            info = self._get(k.decode())
-            if info:
-                out.append(info)
+        # Batched fetch instead of a kv_get round-trip per job.  The
+        # stop:<id> tombstones share the namespace but are not job
+        # records (their b"1" blob is not a dict) — skip them.
+        keys = [k for k in keys if not k.startswith(b"stop:")]
+        table = self._gcs.call("kv_multi_get", (JOB_KV_NS, keys)) or {}
+        out = [json.loads(blob) for blob in table.values() if blob]
         return sorted(out, key=lambda j: j.get("start_time", 0))
 
     def _log_path(self, submission_id: str) -> str:

@@ -9,6 +9,8 @@ from ray_tpu.devtools.lint.checkers import (
     lock_order,
     metrics_drift,
     retry_gate,
+    rpc_contract,
+    shared_state_race,
     thread_lifecycle,
     trace_orphan,
 )
@@ -22,6 +24,8 @@ ALL_CHECKERS = [
     generation_key,
     import_cycle,
     trace_orphan,
+    rpc_contract,
+    shared_state_race,
 ]
 
 CHECK_NAMES = [c.name for c in ALL_CHECKERS]

@@ -54,6 +54,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--format", choices=("text", "json"), default="text", dest="fmt"
     )
     ap.add_argument(
+        "--json",
+        action="store_const",
+        const="json",
+        dest="fmt",
+        help="shorthand for --format json (machine-readable report)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="parse fresh instead of using the content-hash AST cache",
+    )
+    ap.add_argument(
         "--show-suppressed",
         action="store_true",
         help="also print suppressed violations and their reasons",
@@ -112,7 +124,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"graftlint: bad baseline: {e}", file=sys.stderr)
             return 2
 
-    result = core.run_lint(paths, root=root, baseline=bl, select=select)
+    result = core.run_lint(
+        paths, root=root, baseline=bl, select=select,
+        use_cache=not args.no_cache,
+    )
 
     if args.write_baseline:
         n = baseline_mod.write(args.write_baseline, result.unsuppressed)
@@ -121,10 +136,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.fmt == "json":
+        run_checks = select if select is not None else CHECK_NAMES
+        by_check = {c: 0 for c in run_checks}
+        for v in result.unsuppressed:
+            by_check[v.check] = by_check.get(v.check, 0) + 1
         print(json.dumps(
             {
                 "files_checked": result.files_checked,
                 "elapsed_s": round(result.elapsed_s, 3),
+                "checks_run": list(run_checks),
+                "unsuppressed": len(result.unsuppressed),
+                "suppressed": len(result.suppressed),
+                "by_check": by_check,
+                "cache": {"hits": result.cache_hits, "misses": result.cache_misses},
                 "violations": [v.__dict__ for v in result.violations],
                 "parse_errors": [v.__dict__ for v in result.parse_errors],
                 "unused_baseline": result.unused_baseline,

@@ -195,6 +195,8 @@ class LintResult:
     unused_baseline: List[dict]
     files_checked: int
     elapsed_s: float
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def unsuppressed(self) -> List[Violation]:
@@ -245,17 +247,21 @@ def run_lint(
     root: Optional[str] = None,
     baseline: Optional[object] = None,
     select: Optional[Sequence[str]] = None,
+    use_cache: bool = True,
 ) -> LintResult:
     """Parse every file under ``paths`` and run the checkers.
 
     ``baseline`` is a ``baseline.Baseline`` (or None to skip baseline
-    matching); ``select`` limits to the named checks.
+    matching); ``select`` limits to the named checks; ``use_cache``
+    memoizes ``ast.parse`` on source content hash (see cache.py).
     """
     from ray_tpu.devtools.lint import checkers as _checkers
+    from ray_tpu.devtools.lint.cache import AstCache
 
     t0 = time.perf_counter()
     root = os.path.abspath(root or repo_root_for(paths[0] if paths else "."))
     files = _discover(paths)
+    ast_cache = AstCache(root, enabled=use_cache)
     modules: List[Module] = []
     parse_errors: List[Violation] = []
     for f in files:
@@ -263,7 +269,7 @@ def run_lint(
         try:
             with open(f, "r", encoding="utf-8") as fh:
                 src = fh.read()
-            tree = ast.parse(src, filename=f)
+            tree = ast_cache.parse(src, filename=f)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             parse_errors.append(
                 Violation(
@@ -275,6 +281,7 @@ def run_lint(
             )
             continue
         modules.append(Module(f, rel, src, tree))
+    ast_cache.prune()
 
     project = Project(root=root, modules=modules)
     selected = set(select) if select else None
@@ -313,6 +320,8 @@ def run_lint(
         unused_baseline=unused,
         files_checked=len(modules),
         elapsed_s=time.perf_counter() - t0,
+        cache_hits=ast_cache.hits,
+        cache_misses=ast_cache.misses,
     )
 
 

@@ -9,6 +9,27 @@ class RayError(Exception):
     """Base for all ray_tpu errors."""
 
 
+def _rebuild_task_error(cls, function_name, traceback_str, cause, args):
+    # Constructor-free rebuild: as_instanceof_cause's derived classes
+    # override __init__ with a no-op (the cause class may demand
+    # arbitrary constructor args), so replaying __init__ here would
+    # either corrupt fields or raise TypeError.
+    e = cls.__new__(cls)
+    e.function_name = function_name
+    e.traceback_str = traceback_str
+    e.cause = cause
+    e.args = args
+    return e
+
+
+def _rebuild_derived_task_error(function_name, traceback_str, cause, args):
+    # The as_instanceof_cause classes are minted at runtime, so plain
+    # pickle cannot find them by name; re-derive from the cause instead.
+    e = RayTaskError(function_name, traceback_str, cause).as_instanceof_cause()
+    e.args = args
+    return e
+
+
 class RayTaskError(RayError):
     """A task raised; re-raised at `ray.get` on the caller.
 
@@ -20,6 +41,30 @@ class RayTaskError(RayError):
         self.traceback_str = traceback_str
         self.cause = cause
         super().__init__(f"{function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        # Default exception pickling replays cls(*args) with args = the
+        # FORMATTED message, which __init__ would shove into
+        # function_name and wrap again — every RPC hop doubles the
+        # "failed:" framing.  Rebuild from the real fields; __dict__
+        # rides along as state so subclass attributes survive.
+        cls = type(self)
+        import sys
+
+        mod = sys.modules.get(cls.__module__)
+        if getattr(mod, cls.__qualname__, None) is not cls:
+            # An as_instanceof_cause dynamic class: unreachable by name,
+            # so ship the fields and re-derive on load.
+            return (
+                _rebuild_derived_task_error,
+                (self.function_name, self.traceback_str, self.cause, self.args),
+                self.__dict__,
+            )
+        return (
+            _rebuild_task_error,
+            (cls, self.function_name, self.traceback_str, self.cause, self.args),
+            self.__dict__,
+        )
 
     @classmethod
     def from_exception(cls, e: BaseException, function_name: str) -> "RayTaskError":
@@ -57,6 +102,11 @@ class RayActorError(RayError):
         self.actor_id = actor_id
         super().__init__(message)
 
+    def __reduce__(self):
+        # args only carries the message; replaying it would drop
+        # actor_id on the far side of the RPC wire.
+        return (type(self), (str(self), self.actor_id))
+
 
 class ActorDiedError(RayActorError):
     pass
@@ -75,6 +125,11 @@ class ObjectLostError(RayError):
         self.object_id = object_id
         super().__init__(message or f"Object {object_id} was lost (evicted or node died).")
 
+    def __reduce__(self):
+        # Default pickling replays cls(message): the message lands in
+        # object_id and gets re-wrapped, drifting on every hop.
+        return (type(self), (self.object_id, str(self)))
+
 
 class ObjectReconstructionFailedError(ObjectLostError):
     pass
@@ -92,6 +147,11 @@ class TaskCancelledError(RayError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task {task_id} was cancelled.")
+
+    def __reduce__(self):
+        # Default pickling replays cls(message), turning task_id into
+        # the formatted message string.
+        return (type(self), (self.task_id,))
 
 
 class RuntimeEnvSetupError(RayError):

@@ -68,6 +68,9 @@ class ReplicaDataplane:
         # dispatch checks this set at start so the cancel can't be lost.
         self._pre_cancelled: set = set()
         self._closed = False
+        # Guards _req: the rx thread binds it after a socket accept while
+        # shutdown (tx thread or event loop) snapshots it for close.
+        self._chan_lock = threading.Lock()
         self._req = None
         self._resp = None
         self._req_listener: Optional[SocketListener] = None
@@ -94,7 +97,9 @@ class ReplicaDataplane:
 
         try:
             if self._req_listener is not None:
-                self._req = self._req_listener.accept("read", timeout=30.0)
+                accepted = self._req_listener.accept("read", timeout=30.0)
+                with self._chan_lock:
+                    self._req = accepted
             while True:
                 try:
                     _tag, frame, tctx = self._req.read_value_traced(timeout=None)
@@ -227,7 +232,9 @@ class ReplicaDataplane:
             return
         self._closed = True
         self._out_q.put(None)
-        for chan in (self._req, self._resp):
+        with self._chan_lock:
+            chans = (self._req, self._resp)
+        for chan in chans:
             try:
                 if chan is not None:
                     chan.close()

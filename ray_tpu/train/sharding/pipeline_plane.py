@@ -323,7 +323,8 @@ class PipelineStage:
         """Last loop-thread failure (None while healthy) — lets the
         driver name a deterministic error (e.g. ChannelCapacityError)
         instead of reporting only its own result timeout."""
-        return self._error
+        with self._state_lock:
+            return self._error
 
     def bind(self, in_specs: Dict[str, dict]) -> Dict[str, Any]:
         """Create this stage's INBOUND endpoints: ring files locally,
@@ -350,7 +351,8 @@ class PipelineStage:
         """Open every endpoint and run the 1F1B loop on a daemon thread
         (joined in stop_loop) so the actor stays responsive."""
         self._stop.clear()
-        self._error = None
+        with self._state_lock:
+            self._error = None
         self._thread = threading.Thread(
             target=self._loop, args=(edge_specs,), daemon=True,
             name=f"pp-stage-{self.index}",
@@ -374,7 +376,8 @@ class PipelineStage:
 
             shutil.rmtree(self._ring_dir, ignore_errors=True)
             self._ring_dir = None
-        return self._error
+        with self._state_lock:
+            return self._error
 
     # -- loop -----------------------------------------------------------
     def _open(self, name: str, spec: dict):
@@ -487,6 +490,11 @@ class PipelineStage:
                 # pipeline bubble.
                 first = self._read(act_in, "act_in")
                 t_step = time.monotonic()
+                # One params snapshot per step: set_state() can swap the
+                # weights concurrently, and mixing old/new params across
+                # the F/B ops of a single step corrupts the gradient.
+                with self._state_lock:
+                    params = self.params
                 for oi, op in enumerate(ops):
                     if op == "F":
                         x_np = first if oi == 0 else self._read(act_in, "act_in")
@@ -496,14 +504,14 @@ class PipelineStage:
                         if self.is_last:
                             tgt = jnp.asarray(self._read(tgt_in, "tgt_in"))
                             loss, dp, dx = self._jits["fwdbwd"](
-                                self.params, x, tgt
+                                params, x, tgt
                             )
                             loss = float(loss)
                             saved.append((dp, dx))
                             losses.append(loss)
                             busy += time.monotonic() - t0
                         else:
-                            y = self._jits["fwd"](self.params, x)
+                            y = self._jits["fwd"](params, x)
                             y_np = _to_wire(y)
                             busy += time.monotonic() - t0
                             act_out.write_value(y_np, timeout=60.0)
@@ -520,10 +528,10 @@ class PipelineStage:
                             x = saved.popleft()
                             t0 = time.monotonic()
                             if self.is_first:
-                                dp = self._jits["bwd"](self.params, x, dy)
+                                dp = self._jits["bwd"](params, x, dy)
                                 dx_np = None
                             else:
-                                dp, dx = self._jits["bwd"](self.params, x, dy)
+                                dp, dx = self._jits["bwd"](params, x, dy)
                                 dx_np = _to_wire(dx)
                             busy += time.monotonic() - t0
                             if dx_np is not None:
@@ -562,7 +570,8 @@ class PipelineStage:
         except Exception as e:  # noqa: BLE001 — surfaced via stop_loop
             if not self._stop.is_set():
                 logger.exception("pipeline stage %d loop failed", self.index)
-                self._error = f"{type(e).__name__}: {e}"
+                with self._state_lock:
+                    self._error = f"{type(e).__name__}: {e}"
         finally:
             for chan in self._chans.values():
                 try:

@@ -20,7 +20,23 @@ __all__ = [
     "PlacementGroupSchedulingStrategy",
     "ActorPool",
     "Queue",
+    "list_named_actors",
 ]
+
+
+def list_named_actors(all_namespaces: bool = False):
+    """Currently alive named actors (reference: ray.util.list_named_actors):
+    their names in the caller's namespace, or
+    ``[{"namespace": ..., "name": ...}]`` across all namespaces."""
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    rows = worker.gcs_client.call(
+        "list_named_actors", (bool(all_namespaces), worker.namespace)
+    ) or []
+    if all_namespaces:
+        return rows
+    return [r["name"] for r in rows]
 
 
 def __getattr__(name):

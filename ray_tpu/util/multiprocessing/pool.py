@@ -99,9 +99,10 @@ class AsyncResult:
 
     def get(self, timeout: Optional[float] = None):
         self._resolve(timeout)
-        if self._error is not None:
-            raise self._error
-        return self._value
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            return self._value
 
     def wait(self, timeout: Optional[float] = None):
         try:
@@ -119,7 +120,8 @@ class AsyncResult:
         if not self.ready():
             raise ValueError("not ready")
         self._resolve(None)
-        return self._error is None
+        with self._lock:
+            return self._error is None
 
 
 class Pool:

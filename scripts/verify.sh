@@ -7,11 +7,36 @@ cd "$(dirname "$0")/.."
 # graftlint (static analysis gate): the ray_tpu/ AND tests/ trees must
 # carry zero unsuppressed invariant violations against .graftlint.toml,
 # with no stale baseline entries (--strict), inside a 30 s budget.  Runs
-# first: it is the cheapest signal and failures are line-precise.
-if ! timeout -k 5 30 python -m ray_tpu.devtools.lint ray_tpu tests --strict; then
+# first: it is the cheapest signal and failures are line-precise.  The
+# JSON report feeds the one-line gate summary (checker/violation counts)
+# and stays in /tmp/_graftlint.json for CI artifacts.
+if ! timeout -k 5 30 python -m ray_tpu.devtools.lint ray_tpu tests --strict --json \
+    > /tmp/_graftlint.json; then
+  python - <<'EOF' 2>/dev/null || cat /tmp/_graftlint.json
+import json
+r = json.load(open("/tmp/_graftlint.json"))
+for v in r["violations"]:
+    if not v.get("suppressed_by"):
+        print(f"{v['path']}:{v['line']}: {v['check']}: {v['message']}")
+for v in r["parse_errors"]:
+    print(f"{v['path']}:{v['line']}: {v['check']}: {v['message']}")
+for e in r["unused_baseline"]:
+    print(f"stale baseline entry: {e['check']} @ {e['path']}")
+EOF
   echo "graftlint gate failed (see docs/static_analysis.md)"
   exit 1
 fi
+python - <<'EOF'
+import json
+r = json.load(open("/tmp/_graftlint.json"))
+firing = {k: n for k, n in r["by_check"].items() if n}
+print(
+    f"GRAFTLINT_GATE checks={len(r['checks_run'])} files={r['files_checked']} "
+    f"unsuppressed={r['unsuppressed']} suppressed={r['suppressed']} "
+    f"cache_hits={r['cache']['hits']} elapsed={r['elapsed_s']}s"
+    + (f" firing={firing}" if firing else "")
+)
+EOF
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

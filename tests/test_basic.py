@@ -165,6 +165,9 @@ class TestActors:
         h = ray_tpu.get_actor("kvstore")
         ray_tpu.get(h.set.remote("x", 42))
         assert ray_tpu.get(h.get.remote("x")) == 42
+        assert "kvstore" in ray_tpu.util.list_named_actors()
+        rows = ray_tpu.util.list_named_actors(all_namespaces=True)
+        assert any(r["name"] == "kvstore" for r in rows)
         ray_tpu.kill(h)
 
     def test_actor_error(self, ray_cluster):

@@ -822,3 +822,391 @@ def test_graftlint_cli_select_and_exit_code(tmp_path):
     assert rc == 0
     assert cli_main(["--list-checks"]) == 0
     assert cli_main([str(f), "--select", "not-a-check"]) == 2
+
+
+# ------------------------------------------------------------ rpc-contract
+
+SERVER_WITH_PING = """
+    class Server:
+        async def rpc_ping(self, payload, conn):
+            return "pong"
+"""
+
+BAD_RPC_TYPO = {
+    "server.py": SERVER_WITH_PING,
+    "client.py": """
+        def use(client):
+            client.call("ping", None)
+            return client.call("pingg", None)
+    """,
+}
+
+GOOD_RPC_WIRED = {
+    "server.py": SERVER_WITH_PING,
+    "client.py": """
+        def use(client):
+            return client.call("ping", None)
+    """,
+}
+
+BAD_RPC_DEAD_ENDPOINT = {
+    "server.py": """
+        class Server:
+            async def rpc_ping(self, payload, conn):
+                return "pong"
+
+            async def rpc_orphan(self, payload, conn):
+                return 1
+    """,
+    "client.py": """
+        def use(client):
+            return client.call("ping", None)
+    """,
+}
+
+BAD_PAYLOAD_DRIFT = {
+    "server.py": """
+        class Server:
+            async def rpc_report(self, payload, conn):
+                a = payload["node_id"]
+                b = payload["available"]
+                return a, b
+    """,
+    "client.py": """
+        def use(client):
+            return client.call("report", {"node_id": b"n"})
+    """,
+}
+
+GOOD_PAYLOAD_COMPLETE = {
+    "server.py": """
+        class Server:
+            async def rpc_report(self, payload, conn):
+                a = payload["node_id"]
+                b = payload["available"]
+                c = payload.get("total")
+                return a, b, c
+    """,
+    "client.py": """
+        def use(client):
+            return client.call("report", {"node_id": b"n", "available": {}})
+    """,
+}
+
+BAD_RETRY_UNSAFE = {
+    "server.py": """
+        class Server:
+            async def rpc_bump(self, payload, conn):
+                self.n += payload["delta"]
+                return self.n
+    """,
+    "client.py": """
+        from ray_tpu._private.rpc import call_idempotent
+
+        def use(client):
+            return call_idempotent(client, "bump", {"delta": 1})
+    """,
+}
+
+GOOD_RETRY_READONLY = {
+    "server.py": '''
+        class Server:
+            async def rpc_peek(self, payload, conn):
+                """rpc-contract: read-only -- lookup only."""
+                return self.n
+    ''',
+    "client.py": """
+        from ray_tpu._private.rpc import call_idempotent
+
+        def use(client):
+            return call_idempotent(client, "peek", None)
+    """,
+}
+
+GOOD_RETRY_TOKEN = {
+    "server.py": """
+        class Server:
+            async def rpc_bump(self, payload, conn):
+                tok = payload["token"]
+                if tok in self.seen:
+                    return self.n
+                self.seen.add(tok)
+                self.n += payload["delta"]
+                return self.n
+    """,
+    "client.py": """
+        from ray_tpu._private.rpc import call_idempotent
+
+        def use(client):
+            return call_idempotent(client, "bump", {"delta": 1, "token": "t1"})
+    """,
+}
+
+BAD_FENCE_MISSING = {
+    "server.py": """
+        class Gcs:
+            def _check_fence(self, method, node_id, incarnation):
+                raise NotImplementedError
+
+            async def rpc_heartbeat(self, payload, conn):
+                node_id = payload["node_id"]
+                self.last_seen[node_id] = 1
+                return True
+    """,
+    "client.py": """
+        def use(client):
+            return client.call("heartbeat", {"node_id": b"n", "incarnation": 1})
+    """,
+}
+
+GOOD_FENCE_FIRST = {
+    "server.py": """
+        class Gcs:
+            def _check_fence(self, method, node_id, incarnation):
+                raise NotImplementedError
+
+            async def rpc_heartbeat(self, payload, conn):
+                node_id = payload["node_id"]
+                self._check_fence("heartbeat", node_id, payload.get("incarnation"))
+                self.last_seen[node_id] = 1
+                return True
+    """,
+    "client.py": """
+        def use(client):
+            return client.call("heartbeat", {"node_id": b"n", "incarnation": 1})
+    """,
+}
+
+
+def test_rpc_contract_flags_typo_endpoint(tmp_path):
+    v = _lint_tree(tmp_path, BAD_RPC_TYPO, ["rpc-contract"])
+    assert [x.tag for x in v] == ["no-handler:method=pingg"], [x.format() for x in v]
+
+
+def test_rpc_contract_passes_wired_endpoint(tmp_path):
+    assert _lint_tree(tmp_path, GOOD_RPC_WIRED, ["rpc-contract"]) == []
+
+
+def test_rpc_contract_flags_dead_endpoint(tmp_path):
+    v = _lint_tree(tmp_path, BAD_RPC_DEAD_ENDPOINT, ["rpc-contract"])
+    assert [x.tag for x in v] == ["dead-endpoint:method=orphan"], [x.format() for x in v]
+    assert v[0].symbol == "Server.rpc_orphan"
+
+
+def test_rpc_contract_flags_payload_key_drift(tmp_path):
+    v = _lint_tree(tmp_path, BAD_PAYLOAD_DRIFT, ["rpc-contract"])
+    assert [x.tag for x in v] == ["payload-drift:method=report:missing=available"]
+    assert v[0].path == "client.py"  # flagged at the call site
+
+
+def test_rpc_contract_passes_complete_payload(tmp_path):
+    # .get()-guarded keys are optional: only bare subscripts are required.
+    assert _lint_tree(tmp_path, GOOD_PAYLOAD_COMPLETE, ["rpc-contract"]) == []
+
+
+def test_rpc_contract_flags_retry_unsafe_idempotent_call(tmp_path):
+    v = _lint_tree(tmp_path, BAD_RETRY_UNSAFE, ["rpc-contract"])
+    assert [x.tag for x in v] == ["retry-unsafe:method=bump"], [x.format() for x in v]
+
+
+def test_rpc_contract_passes_declared_read_only(tmp_path):
+    assert _lint_tree(tmp_path, GOOD_RETRY_READONLY, ["rpc-contract"]) == []
+
+
+def test_rpc_contract_passes_token_consuming_handler(tmp_path):
+    assert _lint_tree(tmp_path, GOOD_RETRY_TOKEN, ["rpc-contract"]) == []
+
+
+def test_rpc_contract_flags_fence_missing(tmp_path):
+    v = _lint_tree(tmp_path, BAD_FENCE_MISSING, ["rpc-contract"])
+    assert [x.tag for x in v] == ["fence-missing:method=heartbeat"], [x.format() for x in v]
+    assert v[0].symbol == "Gcs.rpc_heartbeat"
+
+
+def test_rpc_contract_passes_fence_before_write(tmp_path):
+    assert _lint_tree(tmp_path, GOOD_FENCE_FIRST, ["rpc-contract"]) == []
+
+
+# -------------------------------------------------------- shared-state-race
+
+BAD_CROSS_THREAD_UNLOCKED = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._lock = threading.Lock()
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            while True:
+                self.items.append(1)
+
+        def drain(self):
+            return list(self.items)
+"""
+
+GOOD_LOCKED_TWIN = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._lock = threading.Lock()
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            while True:
+                with self._lock:
+                    self.items.append(1)
+
+        def drain(self):
+            with self._lock:
+                return list(self.items)
+"""
+
+GOOD_SINGLE_WRITER_FLAG = """
+    import threading
+
+    class Task:
+        def __init__(self):
+            self._done = False
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            self._done = True
+
+        def poll(self):
+            return self._done
+"""
+
+GOOD_QUEUE_HANDOFF = """
+    import queue
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.q: "queue.Queue" = queue.Queue()
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            while True:
+                self.q.put(1)
+
+        def drain(self):
+            return self.q.get()
+"""
+
+GOOD_MANUAL_ACQUIRE = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._lock = threading.Lock()
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            with self._lock:
+                self.items.append(1)
+
+        def drain(self):
+            if not self._lock.acquire(blocking=False):
+                return []
+            try:
+                return list(self.items)
+            finally:
+                self._lock.release()
+"""
+
+
+def test_shared_state_race_flags_cross_thread_unlocked_write(tmp_path):
+    v = lint_source(tmp_path, BAD_CROSS_THREAD_UNLOCKED, ["shared-state-race"])
+    assert [x.tag for x in v] == ["attr=Pump.items"], [x.format() for x in v]
+    assert v[0].symbol == "Pump"
+
+
+def test_shared_state_race_passes_locked_twin(tmp_path):
+    assert lint_source(tmp_path, GOOD_LOCKED_TWIN, ["shared-state-race"]) == []
+
+
+def test_shared_state_race_passes_single_writer_flag(tmp_path):
+    assert lint_source(tmp_path, GOOD_SINGLE_WRITER_FLAG, ["shared-state-race"]) == []
+
+
+def test_shared_state_race_passes_queue_handoff(tmp_path):
+    assert lint_source(tmp_path, GOOD_QUEUE_HANDOFF, ["shared-state-race"]) == []
+
+
+def test_shared_state_race_passes_try_finally_release(tmp_path):
+    assert lint_source(tmp_path, GOOD_MANUAL_ACQUIRE, ["shared-state-race"]) == []
+
+
+def test_shared_state_race_skips_tests_tree(tmp_path):
+    f = tmp_path / "tests" / "test_thing.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(BAD_CROSS_THREAD_UNLOCKED))
+    result = core.run_lint([str(f)], root=str(tmp_path),
+                           select=["shared-state-race"])
+    assert result.violations == []
+
+
+# --------------------------------------------------- json output + ast cache
+
+
+def test_graftlint_json_output(tmp_path, capsys):
+    f = tmp_path / "bad.py"
+    f.write_text(textwrap.dedent(BAD_SLEEP_LOOP))
+    import json as json_mod
+
+    rc = cli_main([str(f), "--root", str(tmp_path), "--json"])
+    assert rc == 1
+    report = json_mod.loads(capsys.readouterr().out)
+    assert report["unsuppressed"] == 1
+    assert report["by_check"]["retry-gate"] == 1
+    assert report["by_check"]["rpc-contract"] == 0
+    assert set(report["checks_run"]) >= {"rpc-contract", "shared-state-race"}
+    assert report["cache"]["hits"] + report["cache"]["misses"] == 1
+    v = report["violations"][0]
+    assert v["check"] == "retry-gate" and v["path"] == "bad.py"
+
+
+def test_ast_cache_hits_on_second_run(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(GOOD_POLICY_LOOP))
+    first = core.run_lint([str(f)], root=str(tmp_path), select=["retry-gate"])
+    assert (first.cache_hits, first.cache_misses) == (0, 1)
+    second = core.run_lint([str(f)], root=str(tmp_path), select=["retry-gate"])
+    assert (second.cache_hits, second.cache_misses) == (1, 0)
+    # Same verdict either way.
+    assert second.violations == first.violations
+
+    # An edit changes the content hash: clean miss, fresh tree, and the
+    # new violation is seen (a stale cache would hide it).
+    f.write_text(textwrap.dedent(BAD_SLEEP_LOOP))
+    third = core.run_lint([str(f)], root=str(tmp_path), select=["retry-gate"])
+    assert third.cache_misses == 1
+    assert len(third.unsuppressed) == 1
+
+
+def test_ast_cache_survives_corrupt_entry(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(GOOD_POLICY_LOOP))
+    core.run_lint([str(f)], root=str(tmp_path), select=["retry-gate"])
+    cache_dir = tmp_path / ".graftlint_cache"
+    entries = list(cache_dir.iterdir())
+    assert entries, "cache dir is empty after a miss"
+    for e in entries:
+        e.write_bytes(b"not a pickle")
+    result = core.run_lint([str(f)], root=str(tmp_path), select=["retry-gate"])
+    assert result.parse_errors == []
+    assert result.cache_misses == 1  # fell back to a fresh parse
+
+
+def test_ast_cache_disabled_flag(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(GOOD_POLICY_LOOP))
+    result = core.run_lint([str(f)], root=str(tmp_path),
+                           select=["retry-gate"], use_cache=False)
+    assert (result.cache_hits, result.cache_misses) == (0, 0)
+    assert not (tmp_path / ".graftlint_cache").exists()
